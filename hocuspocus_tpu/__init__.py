@@ -1,0 +1,20 @@
+"""hocuspocus_tpu — a TPU-native collaboration backend.
+
+A brand-new framework with the capabilities of Hocuspocus (the Node.js
+Y.js collaboration backend): a WebSocket CRDT sync server with lifecycle
+hooks, auth, awareness, a multiplexing client provider, persistence
+extensions, Redis multi-instance fan-out, webhooks, document transformers
+and a CLI — plus a JAX batched merge plane that integrates CRDT updates
+for thousands of documents per step on TPU.
+
+Layering (see SURVEY.md):
+  L0/L1  hocuspocus_tpu.crdt      — Y.js-compatible CRDT engine + binary codec
+         hocuspocus_tpu.protocol  — sync/awareness/auth wire protocols
+  L2     hocuspocus_tpu.server    — asyncio server core (hook bus, documents)
+  L3     hocuspocus_tpu.provider  — client provider (reconnect, multiplexing)
+  L4     hocuspocus_tpu.extensions — database/sqlite/s3/redis/logger/throttle/webhook
+  L5     hocuspocus_tpu.transformer — ProseMirror/Tiptap JSON <-> doc
+  L6     hocuspocus_tpu.tpu       — batched TPU merge plane (JAX/Pallas)
+"""
+
+__version__ = "0.1.0"
