@@ -1,0 +1,79 @@
+"""Y.js-compatible CRDT engine (the L0/L1 core of hocuspocus_tpu).
+
+Public API mirrors the yjs surface the reference uses:
+Doc, apply_update, encode_state_as_update, encode_state_vector,
+merge_updates, diff_update, snapshots, and the shared types.
+"""
+
+from .delete_set import DeleteSet, merge_delete_sets
+from .doc import Doc, Observable, Transaction
+from .encoding import Decoder, Encoder, UNDEFINED
+from .ids import ID, compare_ids
+from .structs import GC, Item, Skip, StructStore
+from .types import (
+    AbstractType,
+    YArray,
+    YArrayEvent,
+    YEvent,
+    YMap,
+    YMapEvent,
+    YText,
+    YTextEvent,
+    YXmlElement,
+    YXmlEvent,
+    YXmlFragment,
+    YXmlHook,
+    YXmlText,
+)
+from .update import (
+    Snapshot,
+    apply_update,
+    decode_state_vector,
+    diff_update,
+    encode_state_as_update,
+    encode_state_vector,
+    encode_state_vector_from_update,
+    merge_updates,
+    snapshot,
+    snapshot_contains_update,
+)
+
+__all__ = [
+    "DeleteSet",
+    "merge_delete_sets",
+    "Doc",
+    "Observable",
+    "Transaction",
+    "Decoder",
+    "Encoder",
+    "UNDEFINED",
+    "ID",
+    "compare_ids",
+    "GC",
+    "Item",
+    "Skip",
+    "StructStore",
+    "AbstractType",
+    "YArray",
+    "YArrayEvent",
+    "YEvent",
+    "YMap",
+    "YMapEvent",
+    "YText",
+    "YTextEvent",
+    "YXmlElement",
+    "YXmlEvent",
+    "YXmlFragment",
+    "YXmlHook",
+    "YXmlText",
+    "Snapshot",
+    "apply_update",
+    "decode_state_vector",
+    "diff_update",
+    "encode_state_as_update",
+    "encode_state_vector",
+    "encode_state_vector_from_update",
+    "merge_updates",
+    "snapshot",
+    "snapshot_contains_update",
+]

@@ -1,0 +1,521 @@
+"""YText — shared rich text type (Y.js-compatible).
+
+Implements the YATA text algorithm with formatting attributes
+(ContentFormat begin/negate pairs), Quill-style deltas and incremental
+text events. The aggressive formatting-cleanup passes yjs runs after
+transactions are not yet ported — they reduce tombstone counts but do not
+affect convergence or rendered content.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..content import ContentEmbed, ContentFormat, ContentString, ContentType
+from ..encoding import UNDEFINED
+from ..ids import ID
+from ..structs import Item
+from .base import AbstractType, YTEXT_REF, YEvent, call_type_observers
+
+
+def equal_attrs(a: Any, b: Any) -> bool:
+    if a is b:
+        return True
+    if a is None or b is None:
+        return a is None and b is None
+    return a == b
+
+
+class ItemTextListPosition:
+    __slots__ = ("left", "right", "index", "current_attributes")
+
+    def __init__(self, left: Optional[Item], right: Optional[Item], index: int, current_attributes: dict) -> None:
+        self.left = left
+        self.right = right
+        self.index = index
+        self.current_attributes = current_attributes
+
+    def forward(self) -> None:
+        right = self.right
+        if right is None:
+            raise RuntimeError("unexpected end of item chain")
+        if isinstance(right.content, ContentFormat):
+            if not right.deleted:
+                _update_current_attributes(self.current_attributes, right.content)
+        elif not right.deleted:
+            self.index += right.length
+        self.left = right
+        self.right = right.right
+
+
+def _update_current_attributes(attrs: dict, fmt: ContentFormat) -> None:
+    if fmt.value is None:
+        attrs.pop(fmt.key, None)
+    else:
+        attrs[fmt.key] = fmt.value
+
+
+def _find_next_position(transaction, pos: ItemTextListPosition, count: int) -> ItemTextListPosition:
+    store = transaction.doc.store
+    while pos.right is not None and count > 0:
+        right = pos.right
+        if isinstance(right.content, ContentFormat):
+            if not right.deleted:
+                _update_current_attributes(pos.current_attributes, right.content)
+        elif not right.deleted:
+            if count < right.length:
+                store.get_item_clean_start(transaction, ID(right.id.client, right.id.clock + count))
+            pos.index += right.length
+            count -= right.length
+        pos.left = pos.right
+        pos.right = pos.right.right if pos.right is not None else None
+    return pos
+
+
+def _find_position(transaction, parent: "YText", index: int) -> ItemTextListPosition:
+    pos = ItemTextListPosition(None, parent._start, 0, {})
+    return _find_next_position(transaction, pos, index)
+
+
+def _make_item(transaction, parent, left, right, content) -> Item:
+    doc = transaction.doc
+    item = Item(
+        ID(doc.client_id, doc.store.get_state(doc.client_id)),
+        left,
+        left.last_id if left is not None else None,
+        right,
+        right.id if right is not None else None,
+        parent,
+        None,
+        content,
+    )
+    item.integrate(transaction, 0)
+    return item
+
+
+def _insert_negated_attributes(transaction, parent, pos: ItemTextListPosition, negated: dict) -> None:
+    while pos.right is not None and (
+        pos.right.deleted
+        or (
+            isinstance(pos.right.content, ContentFormat)
+            and equal_attrs(negated.get(pos.right.content.key, UNDEFINED), pos.right.content.value)
+        )
+    ):
+        if not pos.right.deleted:
+            negated.pop(pos.right.content.key, None)  # type: ignore[union-attr]
+        pos.forward()
+    for key, val in negated.items():
+        pos.right = _make_item(transaction, parent, pos.left, pos.right, ContentFormat(key, val))
+        pos.forward()
+
+
+def _minimize_attribute_changes(pos: ItemTextListPosition, attributes: dict) -> None:
+    while pos.right is not None:
+        right = pos.right
+        if right.deleted or (
+            isinstance(right.content, ContentFormat)
+            and equal_attrs(attributes.get(right.content.key), right.content.value)
+        ):
+            pos.forward()
+        else:
+            break
+
+
+def _insert_attributes(transaction, parent, pos: ItemTextListPosition, attributes: dict) -> dict:
+    negated: dict = {}
+    for key, val in attributes.items():
+        current_val = pos.current_attributes.get(key)
+        if not equal_attrs(current_val, val):
+            negated[key] = current_val  # None restores "no attribute"
+            pos.right = _make_item(transaction, parent, pos.left, pos.right, ContentFormat(key, val))
+            pos.forward()
+    return negated
+
+
+def _insert_text(transaction, parent, pos: ItemTextListPosition, text: Any, attributes: dict) -> None:
+    for key in list(pos.current_attributes.keys()):
+        if key not in attributes:
+            attributes[key] = None
+    _minimize_attribute_changes(pos, attributes)
+    negated = _insert_attributes(transaction, parent, pos, attributes)
+    if isinstance(text, str):
+        content = ContentString(text)
+    elif isinstance(text, AbstractType):
+        content = ContentType(text)
+    else:
+        content = ContentEmbed(text)
+    pos.right = _make_item(transaction, parent, pos.left, pos.right, content)
+    pos.forward()
+    _insert_negated_attributes(transaction, parent, pos, negated)
+
+
+def _format_text(transaction, parent, pos: ItemTextListPosition, length: int, attributes: dict) -> None:
+    store = transaction.doc.store
+    _minimize_attribute_changes(pos, attributes)
+    negated = _insert_attributes(transaction, parent, pos, attributes)
+    while pos.right is not None and (
+        length > 0
+        or (negated and (pos.right.deleted or isinstance(pos.right.content, ContentFormat)))
+    ):
+        right = pos.right
+        if not right.deleted:
+            if isinstance(right.content, ContentFormat):
+                key, value = right.content.key, right.content.value
+                if key in attributes:
+                    attr = attributes[key]
+                    if equal_attrs(attr, value):
+                        negated.pop(key, None)
+                    else:
+                        if length == 0:
+                            break
+                        negated[key] = value
+                    right.delete(transaction)
+                else:
+                    _update_current_attributes(pos.current_attributes, right.content)
+            else:
+                if length < right.length:
+                    store.get_item_clean_start(transaction, ID(right.id.client, right.id.clock + length))
+                length -= right.length
+        pos.forward()
+    if length > 0:
+        pos.right = _make_item(transaction, parent, pos.left, pos.right, ContentString("\n" * length))
+        pos.forward()
+    _insert_negated_attributes(transaction, parent, pos, negated)
+
+
+def _delete_text(transaction, pos: ItemTextListPosition, length: int) -> ItemTextListPosition:
+    store = transaction.doc.store
+    while length > 0 and pos.right is not None:
+        right = pos.right
+        if not right.deleted and isinstance(right.content, (ContentType, ContentEmbed, ContentString)):
+            if length < right.length:
+                store.get_item_clean_start(transaction, ID(right.id.client, right.id.clock + length))
+            length -= right.length
+            right.delete(transaction)
+        pos.forward()
+    return pos
+
+
+class YTextEvent(YEvent):
+    def __init__(self, target, transaction, subs: set) -> None:
+        super().__init__(target, transaction)
+        self.child_list_changed = False
+        self.keys_changed: set = set()
+        for sub in subs:
+            if sub is None:
+                self.child_list_changed = True
+            else:
+                self.keys_changed.add(sub)
+
+    @property
+    def changes(self) -> dict:
+        if self._changes is None:
+            self._changes = {
+                "keys": self.keys,
+                "delta": self.delta,
+                "added": set(),
+                "deleted": set(),
+            }
+        return self._changes
+
+    @property
+    def delta(self) -> list[dict]:
+        if self._delta is None:
+            doc = self.target.doc
+            delta: list[dict] = []
+
+            def compute(transaction) -> None:
+                current_attributes: dict = {}
+                old_attributes: dict = {}
+                item = self.target._start
+                action: Optional[str] = None
+                attributes: dict = {}
+                insert: Any = ""
+                retain = 0
+                delete_len = 0
+
+                def add_op() -> None:
+                    nonlocal action, insert, retain, delete_len
+                    if action is None:
+                        return
+                    op: Optional[dict] = None
+                    if action == "delete":
+                        if delete_len > 0:
+                            op = {"delete": delete_len}
+                        delete_len = 0
+                    elif action == "insert":
+                        if not isinstance(insert, str) or len(insert) > 0:
+                            op = {"insert": insert}
+                            if current_attributes:
+                                op["attributes"] = {
+                                    k: v for k, v in current_attributes.items() if v is not None
+                                }
+                                if not op["attributes"]:
+                                    del op["attributes"]
+                        insert = ""
+                    elif action == "retain":
+                        if retain > 0:
+                            op = {"retain": retain}
+                            if attributes:
+                                op["attributes"] = dict(attributes)
+                        retain = 0
+                    if op:
+                        delta.append(op)
+                    action = None
+
+                while item is not None:
+                    content = item.content
+                    if isinstance(content, (ContentType, ContentEmbed)):
+                        if self.adds(item):
+                            if not self.deletes(item):
+                                add_op()
+                                action = "insert"
+                                insert = content.get_content()[0]
+                                add_op()
+                        elif self.deletes(item):
+                            if action != "delete":
+                                add_op()
+                                action = "delete"
+                            delete_len += 1
+                        elif not item.deleted:
+                            if action != "retain":
+                                add_op()
+                                action = "retain"
+                            retain += 1
+                    elif isinstance(content, ContentString):
+                        if self.adds(item):
+                            if not self.deletes(item):
+                                if action != "insert":
+                                    add_op()
+                                    action = "insert"
+                                insert = insert + content.s
+                        elif self.deletes(item):
+                            if action != "delete":
+                                add_op()
+                                action = "delete"
+                            delete_len += item.length
+                        elif not item.deleted:
+                            if action != "retain":
+                                add_op()
+                                action = "retain"
+                            retain += item.length
+                    elif isinstance(content, ContentFormat):
+                        key, value = content.key, content.value
+                        if self.adds(item):
+                            if not self.deletes(item):
+                                cur_val = current_attributes.get(key)
+                                if not equal_attrs(cur_val, value):
+                                    if action == "retain":
+                                        add_op()
+                                    if equal_attrs(value, old_attributes.get(key)):
+                                        attributes.pop(key, None)
+                                    else:
+                                        attributes[key] = value
+                                elif value is not None:
+                                    item.delete(transaction)
+                        elif self.deletes(item):
+                            old_attributes[key] = value
+                            cur_val = current_attributes.get(key)
+                            if not equal_attrs(cur_val, value):
+                                if action == "retain":
+                                    add_op()
+                                attributes[key] = cur_val
+                        elif not item.deleted:
+                            old_attributes[key] = value
+                            if key in attributes:
+                                attr = attributes[key]
+                                if not equal_attrs(attr, value):
+                                    if action == "retain":
+                                        add_op()
+                                    if value is None:
+                                        attributes.pop(key, None)
+                                    else:
+                                        attributes[key] = value
+                                else:
+                                    item.delete(transaction)
+                        if not item.deleted:
+                            if action == "insert":
+                                add_op()
+                            _update_current_attributes(current_attributes, content)
+                    item = item.right
+                add_op()
+                while delta and "retain" in delta[-1] and "attributes" not in delta[-1]:
+                    delta.pop()
+
+            doc.transact(compute)
+            self._delta = delta
+        return self._delta
+
+
+class YText(AbstractType):
+    _type_ref = YTEXT_REF
+
+    def __init__(self, initial: Optional[str] = None) -> None:
+        super().__init__()
+        self._pending: Optional[list] = []
+        if initial:
+            self._pending.append(lambda: self.insert(0, initial))
+
+    def _integrate(self, doc, item: Optional[Item]) -> None:
+        super()._integrate(doc, item)
+        pending = self._pending
+        self._pending = None
+        if pending:
+            for fn in pending:
+                fn()
+
+    def _call_observer(self, transaction, parent_subs) -> None:
+        event = YTextEvent(self, transaction, parent_subs)
+        call_type_observers(self, transaction, event)
+
+    @property
+    def length(self) -> int:
+        return self._length
+
+    def __len__(self) -> int:
+        return self._length
+
+    def insert(self, index: int, text: str, attributes: Optional[dict] = None) -> None:
+        if len(text) == 0:
+            return
+        if self.doc is None:
+            self._pending.append(lambda: self.insert(index, text, attributes))  # type: ignore[union-attr]
+            return
+
+        def run(transaction) -> None:
+            pos = _find_position(transaction, self, index)
+            attrs = dict(attributes) if attributes is not None else dict(pos.current_attributes)
+            _insert_text(transaction, self, pos, text, attrs)
+
+        self._transact(run)
+
+    def insert_embed(self, index: int, embed: Any, attributes: Optional[dict] = None) -> None:
+        if self.doc is None:
+            self._pending.append(lambda: self.insert_embed(index, embed, attributes))  # type: ignore[union-attr]
+            return
+
+        def run(transaction) -> None:
+            pos = _find_position(transaction, self, index)
+            _insert_text(transaction, self, pos, embed, dict(attributes or {}))
+
+        self._transact(run)
+
+    def delete(self, index: int, length: int) -> None:
+        if length == 0:
+            return
+        if self.doc is None:
+            self._pending.append(lambda: self.delete(index, length))  # type: ignore[union-attr]
+            return
+        self._transact(lambda tr: _delete_text(tr, _find_position(tr, self, index), length))
+
+    def format(self, index: int, length: int, attributes: dict) -> None:
+        if length == 0:
+            return
+        if self.doc is None:
+            self._pending.append(lambda: self.format(index, length, attributes))  # type: ignore[union-attr]
+            return
+
+        def run(transaction) -> None:
+            pos = _find_position(transaction, self, index)
+            if pos.right is None:
+                return
+            _format_text(transaction, self, pos, length, dict(attributes))
+
+        self._transact(run)
+
+    def apply_delta(self, delta: list[dict], sanitize: bool = True) -> None:
+        if self.doc is None:
+            self._pending.append(lambda: self.apply_delta(delta, sanitize))  # type: ignore[union-attr]
+            return
+
+        def run(transaction) -> None:
+            pos = ItemTextListPosition(None, self._start, 0, {})
+            for i, op in enumerate(delta):
+                if "insert" in op:
+                    ins = op["insert"]
+                    if (
+                        not sanitize
+                        and isinstance(ins, str)
+                        and i == len(delta) - 1
+                        and pos.right is None
+                        and ins.endswith("\n")
+                    ):
+                        ins = ins[:-1]
+                    if not isinstance(ins, str) or len(ins) > 0:
+                        _insert_text(transaction, self, pos, ins, dict(op.get("attributes", {})))
+                elif "retain" in op:
+                    _format_text(transaction, self, pos, op["retain"], dict(op.get("attributes", {})))
+                elif "delete" in op:
+                    _delete_text(transaction, pos, op["delete"])
+
+        self._transact(run)
+
+    def to_string(self) -> str:
+        parts: list[str] = []
+        item = self._start
+        while item is not None:
+            if not item.deleted and isinstance(item.content, ContentString):
+                parts.append(item.content.s)
+            item = item.right
+        return "".join(parts)
+
+    def __str__(self) -> str:
+        return self.to_string()
+
+    def to_json(self) -> str:
+        return self.to_string()
+
+    def to_delta(self) -> list[dict]:
+        ops: list[dict] = []
+        current_attributes: dict = {}
+        buf: list[str] = []
+
+        def pack() -> None:
+            if buf:
+                op: dict = {"insert": "".join(buf)}
+                if current_attributes:
+                    op["attributes"] = dict(current_attributes)
+                ops.append(op)
+                buf.clear()
+
+        item = self._start
+        while item is not None:
+            if not item.deleted:
+                content = item.content
+                if isinstance(content, ContentString):
+                    buf.append(content.s)
+                elif isinstance(content, (ContentType, ContentEmbed)):
+                    pack()
+                    op = {"insert": content.get_content()[0]}
+                    if current_attributes:
+                        op["attributes"] = dict(current_attributes)
+                    ops.append(op)
+                elif isinstance(content, ContentFormat):
+                    pack()
+                    _update_current_attributes(current_attributes, content)
+            item = item.right
+        pack()
+        return ops
+
+    def get_attributes(self) -> dict:
+        # attributes on the YText itself (stored in _map)
+        from .base import type_map_get
+
+        return {
+            key: type_map_get(self, key)
+            for key, item in self._map.items()
+            if not item.deleted
+        }
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        from .base import type_map_set
+
+        if self.doc is None:
+            self._pending.append(lambda: self.set_attribute(key, value))  # type: ignore[union-attr]
+            return
+        self._transact(lambda tr: type_map_set(tr, self, key, value))
+
+    def get_attribute(self, key: str) -> Any:
+        from .base import type_map_get
+
+        return type_map_get(self, key)

@@ -1,0 +1,443 @@
+"""CRDT core behavior tests: convergence, concurrency, out-of-order sync.
+
+Mirrors the semantics the reference relies on from yjs (convergent text
+editing, state-vector diff sync, pending-struct buffering).
+"""
+
+import random
+
+import pytest
+
+from hocuspocus_tpu.crdt import (
+    Doc,
+    apply_update,
+    diff_update,
+    encode_state_as_update,
+    encode_state_vector,
+    encode_state_vector_from_update,
+    merge_updates,
+    snapshot,
+    snapshot_contains_update,
+)
+
+
+def sync_docs(a: Doc, b: Doc) -> None:
+    """Exchange state-vector diffs both ways."""
+    update_a = encode_state_as_update(a, encode_state_vector(b))
+    update_b = encode_state_as_update(b, encode_state_vector(a))
+    apply_update(b, update_a)
+    apply_update(a, update_b)
+
+
+def test_text_insert_and_read():
+    doc = Doc()
+    text = doc.get_text("t")
+    text.insert(0, "hello")
+    text.insert(5, " world")
+    assert text.to_string() == "hello world"
+    assert len(text) == 11
+
+
+def test_text_delete():
+    doc = Doc()
+    text = doc.get_text("t")
+    text.insert(0, "hello world")
+    text.delete(5, 6)
+    assert text.to_string() == "hello"
+    text.delete(0, 5)
+    assert text.to_string() == ""
+
+
+def test_text_insert_middle():
+    doc = Doc()
+    text = doc.get_text("t")
+    text.insert(0, "ac")
+    text.insert(1, "b")
+    assert text.to_string() == "abc"
+
+
+def test_two_docs_converge_via_full_updates():
+    a, b = Doc(), Doc()
+    ta, tb = a.get_text("t"), b.get_text("t")
+    ta.insert(0, "abc")
+    apply_update(b, encode_state_as_update(a))
+    assert tb.to_string() == "abc"
+    tb.insert(3, "def")
+    apply_update(a, encode_state_as_update(b))
+    assert ta.to_string() == "abcdef"
+
+
+def test_concurrent_inserts_converge():
+    a, b = Doc(), Doc()
+    ta, tb = a.get_text("t"), b.get_text("t")
+    ta.insert(0, "base")
+    apply_update(b, encode_state_as_update(a))
+    # concurrent edits at the same position
+    ta.insert(4, "-A")
+    tb.insert(4, "-B")
+    sync_docs(a, b)
+    assert ta.to_string() == tb.to_string()
+    s = ta.to_string()
+    assert "-A" in s and "-B" in s and s.startswith("base")
+
+
+def test_concurrent_delete_and_insert():
+    a, b = Doc(), Doc()
+    ta, tb = a.get_text("t"), b.get_text("t")
+    ta.insert(0, "hello world")
+    sync_docs(a, b)
+    ta.delete(0, 5)  # remove "hello"
+    tb.insert(5, "!!!")  # insert inside the deleted region boundary
+    sync_docs(a, b)
+    assert ta.to_string() == tb.to_string()
+
+
+def test_incremental_update_events():
+    a, b = Doc(), Doc()
+    ta, tb = a.get_text("t"), b.get_text("t")
+    updates: list[bytes] = []
+    a.on("update", lambda update, origin, doc, tr: updates.append(update))
+    ta.insert(0, "hello")
+    ta.insert(5, " world")
+    ta.delete(0, 1)
+    assert len(updates) == 3
+    for update in updates:
+        apply_update(b, update)
+    assert tb.to_string() == ta.to_string() == "ello world"
+
+
+def test_out_of_order_updates_are_buffered():
+    a, b = Doc(), Doc()
+    ta = a.get_text("t")
+    updates: list[bytes] = []
+    a.on("update", lambda update, *rest: updates.append(update))
+    ta.insert(0, "1")
+    ta.insert(1, "2")
+    ta.insert(2, "3")
+    assert len(updates) == 3
+    # apply in reverse order — later updates must be buffered as pending
+    apply_update(b, updates[2])
+    assert b.get_text("t").to_string() == ""
+    apply_update(b, updates[1])
+    apply_update(b, updates[0])
+    assert b.get_text("t").to_string() == "123"
+
+
+def test_state_vector_diff_sync_only_ships_missing():
+    a, b = Doc(), Doc()
+    ta = a.get_text("t")
+    ta.insert(0, "x" * 1000)
+    apply_update(b, encode_state_as_update(a))
+    ta.insert(1000, "y")
+    diff = encode_state_as_update(a, encode_state_vector(b))
+    full = encode_state_as_update(a)
+    assert len(diff) < len(full) / 2
+    apply_update(b, diff)
+    assert b.get_text("t").to_string() == ta.to_string()
+
+
+def test_map_set_get_delete():
+    doc = Doc()
+    m = doc.get_map("m")
+    m.set("a", 1)
+    m.set("b", "two")
+    m.set("c", {"nested": [1, 2, 3]})
+    assert m.get("a") == 1
+    assert m.get("b") == "two"
+    assert m.get("c") == {"nested": [1, 2, 3]}
+    assert m.has("a") and not m.has("zz")
+    m.delete("a")
+    assert not m.has("a")
+    assert sorted(m.keys()) == ["b", "c"]
+    assert m.to_json() == {"b": "two", "c": {"nested": [1, 2, 3]}}
+
+
+def test_map_concurrent_set_converges():
+    a, b = Doc(), Doc()
+    ma, mb = a.get_map("m"), b.get_map("m")
+    ma.set("k", "from-a")
+    mb.set("k", "from-b")
+    sync_docs(a, b)
+    assert ma.get("k") == mb.get("k")
+
+
+def test_map_last_write_wins_sequential():
+    a, b = Doc(), Doc()
+    ma, mb = a.get_map("m"), b.get_map("m")
+    ma.set("k", 1)
+    sync_docs(a, b)
+    mb.set("k", 2)
+    sync_docs(a, b)
+    assert ma.get("k") == 2
+    assert mb.get("k") == 2
+
+
+def test_array_operations():
+    doc = Doc()
+    arr = doc.get_array("a")
+    arr.insert(0, [1, 2, 3])
+    arr.push([4])
+    arr.unshift([0])
+    assert arr.to_array() == [0, 1, 2, 3, 4]
+    arr.delete(1, 2)
+    assert arr.to_array() == [0, 3, 4]
+    assert arr.get(1) == 3
+    assert arr.slice(1) == [3, 4]
+    assert len(arr) == 3
+
+
+def test_array_concurrent_converges():
+    a, b = Doc(), Doc()
+    aa, ab = a.get_array("a"), b.get_array("a")
+    aa.insert(0, ["x"])
+    ab.insert(0, ["y"])
+    sync_docs(a, b)
+    assert aa.to_array() == ab.to_array()
+    assert sorted(aa.to_array()) == ["x", "y"]
+
+
+def test_nested_types():
+    doc = Doc()
+    from hocuspocus_tpu.crdt import YArray, YMap
+
+    m = doc.get_map("root")
+    inner = YMap()
+    m.set("inner", inner)
+    inner.set("x", 42)
+    arr = YArray()
+    m.set("list", arr)
+    arr.push([1, 2])
+    b = Doc()
+    apply_update(b, encode_state_as_update(doc))
+    assert b.get_map("root").to_json() == {"inner": {"x": 42}, "list": [1, 2]}
+
+
+def test_text_formatting_delta():
+    doc = Doc()
+    text = doc.get_text("t")
+    text.insert(0, "hello world")
+    text.format(0, 5, {"bold": True})
+    delta = text.to_delta()
+    assert delta == [
+        {"insert": "hello", "attributes": {"bold": True}},
+        {"insert": " world"},
+    ]
+
+
+def test_text_insert_with_attributes():
+    doc = Doc()
+    text = doc.get_text("t")
+    text.insert(0, "ab", {"italic": True})
+    delta = text.to_delta()
+    assert delta == [{"insert": "ab", "attributes": {"italic": True}}]
+    # plain insert after formatted run
+    text.insert(2, "c", {})
+    assert text.to_delta() == [
+        {"insert": "ab", "attributes": {"italic": True}},
+        {"insert": "c"},
+    ]
+
+
+def test_formatting_converges():
+    a, b = Doc(), Doc()
+    ta, tb = a.get_text("t"), b.get_text("t")
+    ta.insert(0, "hello world")
+    sync_docs(a, b)
+    ta.format(0, 5, {"bold": True})
+    tb.format(6, 5, {"italic": True})
+    sync_docs(a, b)
+    assert ta.to_delta() == tb.to_delta()
+
+
+def test_observe_text_delta():
+    doc = Doc()
+    text = doc.get_text("t")
+    events = []
+    text.observe(lambda event, tr: events.append(event.delta))
+    text.insert(0, "abc")
+    text.insert(1, "X")
+    assert events[0] == [{"insert": "abc"}]
+    assert events[1] == [{"retain": 1}, {"insert": "X"}]
+
+
+def test_observe_map_keys():
+    doc = Doc()
+    m = doc.get_map("m")
+    events = []
+    m.observe(lambda event, tr: events.append(dict(event.keys)))
+    m.set("a", 1)
+    assert events[-1]["a"]["action"] == "add"
+    m.set("a", 2)
+    assert events[-1]["a"]["action"] == "update"
+    assert events[-1]["a"]["oldValue"] == 1
+    m.delete("a")
+    assert events[-1]["a"]["action"] == "delete"
+    assert events[-1]["a"]["oldValue"] == 2
+
+
+def test_observe_deep():
+    doc = Doc()
+    from hocuspocus_tpu.crdt import YMap
+
+    root = doc.get_map("root")
+    inner = YMap()
+    root.set("inner", inner)
+    events = []
+    root.observe_deep(lambda evts, tr: events.extend(evts))
+    inner.set("x", 1)
+    assert len(events) == 1
+    assert events[0].path == ["inner"]
+
+
+def test_transaction_origin_passed_to_update_event():
+    doc = Doc()
+    text = doc.get_text("t")
+    origins = []
+    doc.on("update", lambda update, origin, *rest: origins.append(origin))
+    doc.transact(lambda tr: text.insert(0, "x"), origin="my-origin")
+    assert origins == ["my-origin"]
+
+
+def test_merge_updates():
+    a = Doc()
+    ta = a.get_text("t")
+    updates = []
+    a.on("update", lambda update, *rest: updates.append(update))
+    ta.insert(0, "hello")
+    ta.insert(5, " world")
+    merged = merge_updates(updates)
+    b = Doc()
+    apply_update(b, merged)
+    assert b.get_text("t").to_string() == "hello world"
+
+
+def test_merge_updates_multiple_clients():
+    a, b = Doc(), Doc()
+    a.get_text("t").insert(0, "aaa")
+    apply_update(b, encode_state_as_update(a))
+    b.get_text("t").insert(3, "bbb")
+    merged = merge_updates([encode_state_as_update(a), encode_state_as_update(b)])
+    c = Doc()
+    apply_update(c, merged)
+    assert c.get_text("t").to_string() == "aaabbb"
+
+
+def test_diff_update():
+    a = Doc()
+    ta = a.get_text("t")
+    ta.insert(0, "hello")
+    sv1 = encode_state_vector(a)
+    ta.insert(5, " world")
+    full = encode_state_as_update(a)
+    diff = diff_update(full, sv1)
+    assert len(diff) < len(full)
+    b = Doc()
+    apply_update(b, encode_state_as_update(a, sv1))  # baseline diff via doc
+    c = Doc()
+    apply_update(c, full)
+    assert c.get_text("t").to_string() == "hello world"
+
+
+def test_encode_state_vector_from_update():
+    a = Doc()
+    a.get_text("t").insert(0, "hello")
+    update = encode_state_as_update(a)
+    sv = encode_state_vector_from_update(update)
+    assert sv == encode_state_vector(a)
+
+
+def test_snapshot_contains_update():
+    a = Doc()
+    ta = a.get_text("t")
+    ta.insert(0, "hello")
+    snap = snapshot(a)
+    update1 = encode_state_as_update(a)
+    assert snapshot_contains_update(snap, update1)
+    ta.insert(5, "!")
+    update2 = encode_state_as_update(a)
+    assert not snapshot_contains_update(snap, update2)
+
+
+def test_deleted_content_is_gcd():
+    doc = Doc(gc=True)
+    text = doc.get_text("t")
+    text.insert(0, "x" * 10000)
+    size_before = len(encode_state_as_update(doc))
+    text.delete(0, 10000)
+    size_after = len(encode_state_as_update(doc))
+    assert size_after < size_before / 10
+
+
+def test_random_convergence_fuzz():
+    random.seed(42)
+    docs = [Doc() for _ in range(3)]
+    texts = [d.get_text("t") for d in docs]
+    queues: dict[int, list[bytes]] = {i: [] for i in range(3)}
+    for i, d in enumerate(docs):
+        d.on(
+            "update",
+            lambda update, origin, doc, tr, i=i: [
+                queues[j].append(update) for j in range(3) if j != i
+            ],
+        )
+    alphabet = "abcdefghij"
+    for step in range(200):
+        i = random.randrange(3)
+        t = texts[i]
+        if random.random() < 0.7 or len(t) == 0:
+            pos = random.randint(0, len(t))
+            t.insert(pos, random.choice(alphabet) * random.randint(1, 3))
+        else:
+            pos = random.randrange(len(t))
+            t.delete(pos, min(random.randint(1, 5), len(t) - pos))
+        if random.random() < 0.3:
+            # deliver some queued updates (possibly out of order)
+            j = random.randrange(3)
+            random.shuffle(queues[j])
+            while queues[j]:
+                apply_update(docs[j], queues[j].pop())
+    for j in range(3):
+        while queues[j]:
+            apply_update(docs[j], queues[j].pop())
+    # everyone must converge
+    contents = {t.to_string() for t in texts}
+    assert len(contents) == 1, contents
+
+
+def test_xml_types():
+    doc = Doc()
+    from hocuspocus_tpu.crdt import YXmlElement, YXmlText
+
+    frag = doc.get_xml_fragment("prosemirror")
+    para = YXmlElement("paragraph")
+    frag.insert(0, [para])
+    text = YXmlText()
+    para.insert(0, [text])
+    text.insert(0, "hello")
+    para.set_attribute("align", "left")
+    assert para.get_attribute("align") == "left"
+    assert frag.to_string() == '<paragraph align="left">hello</paragraph>'
+    b = Doc()
+    apply_update(b, encode_state_as_update(doc))
+    assert b.get_xml_fragment("prosemirror").to_string() == frag.to_string()
+
+
+def test_utf16_lengths():
+    doc = Doc()
+    text = doc.get_text("t")
+    text.insert(0, "a😀b")  # emoji = 2 UTF-16 units
+    assert len(text) == 4
+    b = Doc()
+    apply_update(b, encode_state_as_update(doc))
+    assert b.get_text("t").to_string() == "a😀b"
+    text.delete(1, 2)  # delete the emoji
+    assert text.to_string() == "ab"
+
+
+def test_root_type_upgrade():
+    # A root created generically (e.g. by remote update) upgrades on typed access.
+    a = Doc()
+    a.get_text("t").insert(0, "hi")
+    b = Doc()
+    apply_update(b, encode_state_as_update(a))
+    assert b.get_text("t").to_string() == "hi"
