@@ -1,0 +1,25 @@
+"""WebSocket close events (reference `packages/common/src/CloseEvents.ts`)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class CloseEvent(NamedTuple):
+    code: int
+    reason: str
+
+
+MESSAGE_TOO_BIG = CloseEvent(1009, "Message Too Big")
+RESET_CONNECTION = CloseEvent(4205, "Reset Connection")
+UNAUTHORIZED = CloseEvent(4401, "Unauthorized")
+FORBIDDEN = CloseEvent(4403, "Forbidden")
+CONNECTION_TIMEOUT = CloseEvent(4408, "Connection Timeout")
+
+
+class CloseError(Exception):
+    """Raised to close a connection with a specific close event."""
+
+    def __init__(self, event: CloseEvent) -> None:
+        super().__init__(event.reason)
+        self.event = event

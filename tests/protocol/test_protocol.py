@@ -1,0 +1,121 @@
+"""Sync protocol, awareness and message framing tests."""
+
+from hocuspocus_tpu.crdt import Doc
+from hocuspocus_tpu.crdt.encoding import Decoder, Encoder
+from hocuspocus_tpu.protocol import (
+    Awareness,
+    IncomingMessage,
+    MessageType,
+    OutgoingMessage,
+    read_sync_message,
+)
+from hocuspocus_tpu.protocol.awareness import (
+    apply_awareness_update,
+    encode_awareness_update,
+    remove_awareness_states,
+)
+from hocuspocus_tpu.protocol.sync import (
+    MESSAGE_YJS_SYNC_STEP1,
+    MESSAGE_YJS_SYNC_STEP2,
+    write_sync_step1,
+)
+
+
+def test_sync_handshake_two_docs():
+    server, client = Doc(), Doc()
+    server.get_text("t").insert(0, "server content")
+    client.get_text("t").insert(0, "client content")
+
+    # client sends step1
+    e1 = Encoder()
+    write_sync_step1(e1, client)
+    # server processes, replies step2 + its own step1
+    reply = Encoder()
+    assert read_sync_message(Decoder(e1.to_bytes()), reply, server) == MESSAGE_YJS_SYNC_STEP1
+    # client applies step2
+    reply2 = Encoder()
+    assert read_sync_message(Decoder(reply.to_bytes()), reply2, client) == MESSAGE_YJS_SYNC_STEP2
+    # now client has both contents
+    combined = client.get_text("t").to_string()
+    assert "server content" in combined and "client content" in combined
+
+
+def test_awareness_roundtrip():
+    doc_a, doc_b = Doc(), Doc()
+    a, b = Awareness(doc_a), Awareness(doc_b)
+    a.set_local_state({"user": {"name": "ada"}})
+    update = encode_awareness_update(a, [a.client_id])
+    events = []
+    b.on("change", lambda changes, origin: events.append(changes))
+    apply_awareness_update(b, update, "remote")
+    assert b.states[a.client_id] == {"user": {"name": "ada"}}
+    assert events[0]["added"] == [a.client_id]
+    # removal
+    a.set_local_state(None)
+    apply_awareness_update(b, encode_awareness_update(a, [a.client_id]), "remote")
+    assert a.client_id not in b.states
+    assert events[-1]["removed"] == [a.client_id]
+
+
+def test_awareness_clock_wins():
+    doc_a, doc_b = Doc(), Doc()
+    a, b = Awareness(doc_a), Awareness(doc_b)
+    a.set_local_state({"v": 1})
+    update_old = encode_awareness_update(a, [a.client_id])
+    a.set_local_state({"v": 2})
+    update_new = encode_awareness_update(a, [a.client_id])
+    apply_awareness_update(b, update_new, None)
+    apply_awareness_update(b, update_old, None)  # stale, must not regress
+    assert b.states[a.client_id] == {"v": 2}
+
+
+def test_remove_awareness_states():
+    doc = Doc()
+    a = Awareness(doc)
+    a.states[12345] = {"x": 1}
+    a.meta[12345] = {"clock": 1, "last_updated": 0}
+    remove_awareness_states(a, [12345], "test")
+    assert 12345 not in a.states
+
+
+def test_outgoing_message_framing():
+    doc = Doc()
+    doc.get_text("t").insert(0, "x")
+    msg = OutgoingMessage("my-doc").create_sync_message().write_first_sync_step_for(doc)
+    data = msg.to_bytes()
+    incoming = IncomingMessage(data)
+    assert incoming.read_var_string() == "my-doc"
+    assert incoming.read_var_uint() == MessageType.Sync
+    assert incoming.read_var_uint() == MESSAGE_YJS_SYNC_STEP1
+
+
+def test_outgoing_auth_messages():
+    data = OutgoingMessage("d").write_authenticated(False).to_bytes()
+    m = IncomingMessage(data)
+    assert m.read_var_string() == "d"
+    assert m.read_var_uint() == MessageType.Auth
+    assert m.read_var_uint() == 2  # Authenticated
+    assert m.read_var_string() == "read-write"
+
+    data = OutgoingMessage("d").write_permission_denied("nope").to_bytes()
+    m = IncomingMessage(data)
+    m.read_var_string()
+    assert m.read_var_uint() == MessageType.Auth
+    assert m.read_var_uint() == 1  # PermissionDenied
+    assert m.read_var_string() == "nope"
+
+
+def test_sync_status_message():
+    data = OutgoingMessage("d").write_sync_status(True).to_bytes()
+    m = IncomingMessage(data)
+    m.read_var_string()
+    assert m.read_var_uint() == MessageType.SyncStatus
+    assert m.read_var_uint() == 1
+
+
+def test_stateless_message():
+    data = OutgoingMessage("d").write_stateless("payload-123").to_bytes()
+    m = IncomingMessage(data)
+    m.read_var_string()
+    assert m.read_var_uint() == MessageType.Stateless
+    assert m.read_var_string() == "payload-123"
