@@ -1,0 +1,11 @@
+from .message_receiver import MessageReceiver
+from .provider import AwarenessError, HocuspocusProvider
+from .websocket import HocuspocusProviderWebsocket, WebSocketStatus
+
+__all__ = [
+    "MessageReceiver",
+    "AwarenessError",
+    "HocuspocusProvider",
+    "HocuspocusProviderWebsocket",
+    "WebSocketStatus",
+]

@@ -1,0 +1,226 @@
+"""Shared client websocket (reference `HocuspocusProviderWebsocket.ts`).
+
+Multiplexes many providers over one socket (routing inbound frames by the
+peeked document name), reconnects with exponential backoff + jitter,
+queues outbound messages while disconnected, and closes the socket when
+no message arrives within `message_reconnect_timeout`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from enum import Enum
+from typing import Any, Optional
+
+import aiohttp
+
+from ..crdt.doc import Observable
+from ..crdt.encoding import Decoder
+
+
+class WebSocketStatus(str, Enum):
+    Connecting = "connecting"
+    Connected = "connected"
+    Disconnected = "disconnected"
+
+
+class HocuspocusProviderWebsocket(Observable):
+    def __init__(
+        self,
+        url: str,
+        auto_connect: bool = True,
+        message_reconnect_timeout: float = 30000,
+        delay: float = 1000,
+        initial_delay: float = 0,
+        factor: float = 2,
+        max_attempts: int = 0,
+        min_delay: float = 1000,
+        max_delay: float = 30000,
+        jitter: bool = True,
+        **callbacks: Any,
+    ) -> None:
+        super().__init__()
+        self.url = url.rstrip("/")
+        self.auto_connect = auto_connect
+        self.message_reconnect_timeout = message_reconnect_timeout
+        self.delay = delay
+        self.initial_delay = initial_delay
+        self.factor = factor
+        self.max_attempts = max_attempts
+        self.min_delay = min_delay
+        self.max_delay = max_delay
+        self.jitter = jitter
+
+        self.provider_map: dict[str, Any] = {}
+        self.message_queue: list[bytes] = []
+        self.status = WebSocketStatus.Disconnected
+        self.should_connect = auto_connect
+        self.last_message_received = 0.0
+        self.ws: Optional[aiohttp.ClientWebSocketResponse] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._run_task: Optional[asyncio.Task] = None
+        self._checker_task: Optional[asyncio.Task] = None
+        self._connected_event = asyncio.Event()
+        self._destroyed = False
+
+        for name, fn in callbacks.items():
+            if name.startswith("on_") and callable(fn):
+                self.on(name[3:], fn)
+
+        if auto_connect:
+            self.connect()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def connect(self) -> None:
+        self.should_connect = True
+        if self._run_task is None or self._run_task.done():
+            self._run_task = asyncio.ensure_future(self._run())
+        if self._checker_task is None or self._checker_task.done():
+            self._checker_task = asyncio.ensure_future(self._connection_checker())
+
+    async def wait_connected(self, timeout: float = 30) -> None:
+        await asyncio.wait_for(self._connected_event.wait(), timeout)
+
+    def disconnect(self) -> None:
+        self.should_connect = False
+        self.message_queue = []
+        if self.ws is not None and not self.ws.closed:
+            asyncio.ensure_future(self.ws.close())
+
+    def destroy(self) -> None:
+        if self._destroyed:
+            return
+        self._destroyed = True
+        self.emit("destroy")
+        self.disconnect()
+        for task in (self._run_task, self._checker_task):
+            if task is not None:
+                task.cancel()
+        if self._session is not None:
+            asyncio.ensure_future(self._session.close())
+        self._observers = {}
+
+    # -- provider attachment ----------------------------------------------
+
+    def attach(self, provider) -> None:
+        self.provider_map[provider.name] = provider
+        if self.status == WebSocketStatus.Disconnected and self.should_connect:
+            self.connect()
+        if self.status == WebSocketStatus.Connected:
+            asyncio.ensure_future(provider.on_open())
+
+    def detach(self, provider) -> None:
+        if provider.name in self.provider_map:
+            from ..protocol.message import OutgoingMessage
+
+            provider.send(OutgoingMessage(provider.name).write_close_message("closed"))
+            del self.provider_map[provider.name]
+
+    # -- IO ----------------------------------------------------------------
+
+    def send(self, data: bytes) -> None:
+        if self.ws is not None and not self.ws.closed and self.status == WebSocketStatus.Connected:
+            asyncio.ensure_future(self._send_now(data))
+        else:
+            self.message_queue.append(data)
+
+    async def _send_now(self, data: bytes) -> None:
+        try:
+            if self.ws is not None and not self.ws.closed:
+                await self.ws.send_bytes(data)
+        except Exception:
+            pass
+
+    async def _run(self) -> None:
+        attempt = 0
+        if self.initial_delay:
+            await asyncio.sleep(self.initial_delay / 1000)
+        while self.should_connect and not self._destroyed:
+            if self._session is None or self._session.closed:
+                self._session = aiohttp.ClientSession()
+            self._set_status(WebSocketStatus.Connecting)
+            try:
+                ws = await self._session.ws_connect(
+                    self.url, autoping=True, max_msg_size=0, heartbeat=None
+                )
+            except Exception:
+                attempt += 1
+                if self.max_attempts and attempt >= self.max_attempts:
+                    self._set_status(WebSocketStatus.Disconnected)
+                    return
+                await asyncio.sleep(self._backoff_delay(attempt))
+                continue
+            attempt = 0
+            self.ws = ws
+            self.last_message_received = 0.0
+            self._set_status(WebSocketStatus.Connected)
+            self._connected_event.set()
+            self.emit("open", {})
+            self.emit("connect")
+            # notify providers so they authenticate + start sync
+            for provider in list(self.provider_map.values()):
+                asyncio.ensure_future(provider.on_open())
+            # flush queued messages
+            queue, self.message_queue = self.message_queue, []
+            for data in queue:
+                await self._send_now(data)
+            close_event = {"code": 1000, "reason": ""}
+            try:
+                async for msg in ws:
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        self._on_message(msg.data)
+                    elif msg.type in (aiohttp.WSMsgType.ERROR, aiohttp.WSMsgType.CLOSED):
+                        break
+            except Exception:
+                pass
+            close_event = {"code": ws.close_code or 1000, "reason": ""}
+            self.ws = None
+            self._connected_event.clear()
+            self._set_status(WebSocketStatus.Disconnected)
+            self.emit("close", {"event": close_event})
+            self.emit("disconnect", {"event": close_event})
+            if self.should_connect and not self._destroyed:
+                await asyncio.sleep(self._backoff_delay(max(attempt, 1)))
+
+    def _backoff_delay(self, attempt: int) -> float:
+        delay = min(self.delay * (self.factor ** max(attempt - 1, 0)), self.max_delay)
+        if self.jitter:
+            delay = random.uniform(self.min_delay, max(delay, self.min_delay))
+        return delay / 1000
+
+    def _set_status(self, status: WebSocketStatus) -> None:
+        if self.status != status:
+            self.status = status
+            self.emit("status", {"status": status})
+
+    def _on_message(self, data: bytes) -> None:
+        self.last_message_received = time.monotonic()
+        self.emit("message", {"data": data})
+        try:
+            document_name = Decoder(data).read_var_string()
+        except Exception:
+            return
+        provider = self.provider_map.get(document_name)
+        if provider is not None:
+            provider.on_message(data)
+
+    async def _connection_checker(self) -> None:
+        interval = self.message_reconnect_timeout / 10 / 1000
+        close_tries = 0
+        while not self._destroyed:
+            await asyncio.sleep(interval)
+            if self.status != WebSocketStatus.Connected or not self.last_message_received:
+                continue
+            elapsed_ms = (time.monotonic() - self.last_message_received) * 1000
+            if elapsed_ms <= self.message_reconnect_timeout:
+                continue
+            # No message for too long — not even awareness pings.
+            close_tries += 1
+            if self.ws is not None:
+                self.message_queue = []
+                await self.ws.close()
+            if close_tries > 2:
+                close_tries = 0

@@ -1,0 +1,26 @@
+from .client_connection import ClientConnection
+from .connection import Connection
+from .debounce import Debouncer
+from .direct_connection import DirectConnection
+from .document import Document
+from .hocuspocus import Hocuspocus, RequestInfo, REDIS_ORIGIN
+from .message_receiver import MessageReceiver
+from .server import Server
+from .types import Configuration, ConnectionConfiguration, Extension, Payload
+
+__all__ = [
+    "ClientConnection",
+    "Connection",
+    "Debouncer",
+    "DirectConnection",
+    "Document",
+    "Hocuspocus",
+    "RequestInfo",
+    "REDIS_ORIGIN",
+    "MessageReceiver",
+    "Server",
+    "Configuration",
+    "ConnectionConfiguration",
+    "Extension",
+    "Payload",
+]

@@ -1,0 +1,116 @@
+"""Per-(socket, document) channel (reference `Connection.ts` equivalent)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..protocol.close_events import CloseError, CloseEvent, RESET_CONNECTION
+from ..protocol.message import IncomingMessage, OutgoingMessage
+from . import logger
+from .document import Document
+from .message_receiver import MessageReceiver
+
+
+async def _default_async_callback(*args: Any) -> None:
+    return None
+
+
+class Connection:
+    """One document channel on a (possibly multiplexed) websocket."""
+
+    def __init__(
+        self,
+        transport,
+        request,
+        document: Document,
+        socket_id: str,
+        context: Any,
+        read_only: bool = False,
+    ) -> None:
+        self.transport = transport
+        self.request = request
+        self.document = document
+        self.socket_id = socket_id
+        self.context = context
+        self.read_only = read_only
+        self.callbacks: dict[str, Any] = {
+            "on_close": [],
+            "before_handle_message": _default_async_callback,
+            "before_sync": _default_async_callback,
+            "stateless": _default_async_callback,
+        }
+        self.document.add_connection(self)
+        self.send_current_awareness()
+
+    def on_close(self, callback: Callable) -> "Connection":
+        self.callbacks["on_close"].append(callback)
+        return self
+
+    def on_stateless_callback(self, callback: Callable) -> "Connection":
+        self.callbacks["stateless"] = callback
+        return self
+
+    def before_handle_message(self, callback: Callable) -> "Connection":
+        self.callbacks["before_handle_message"] = callback
+        return self
+
+    def before_sync(self, callback: Callable) -> "Connection":
+        self.callbacks["before_sync"] = callback
+        return self
+
+    def send(self, message: bytes) -> None:
+        if self.transport.is_closed:
+            self.close()
+            return
+        try:
+            self.transport.send(message)
+        except Exception:
+            self.close()
+
+    def send_stateless(self, payload: str) -> None:
+        message = OutgoingMessage(self.document.name).write_stateless(payload)
+        self.send(message.to_bytes())
+
+    def close(self, event: Optional[CloseEvent] = None) -> None:
+        """Graceful close of this document channel (socket stays open —
+        other documents may be multiplexed on it)."""
+        if self.document.has_connection(self):
+            self.document.remove_connection(self)
+            for callback in self.callbacks["on_close"]:
+                callback(self.document, event)
+            close_message = OutgoingMessage(self.document.name).write_close_message(
+                event.reason if event is not None else "Server closed the connection"
+            )
+            self.send(close_message.to_bytes())
+
+    def send_current_awareness(self) -> None:
+        if not self.document.has_awareness_states():
+            return
+        message = OutgoingMessage(self.document.name).create_awareness_update_message(
+            self.document.awareness
+        )
+        self.send(message.to_bytes())
+
+    async def handle_message(self, data: bytes) -> None:
+        message = IncomingMessage(data)
+        document_name = message.read_var_string()
+        if document_name != self.document.name:
+            return
+        message.write_var_string(document_name)
+        try:
+            await self.callbacks["before_handle_message"](self, data)
+            await MessageReceiver(message).apply(self.document, self)
+        except CloseError as error:
+            logger.log_error(
+                f"closing connection {self.socket_id} (while handling "
+                f"{document_name}): {error.event.reason}"
+            )
+            self.close(error.event)
+        except Exception as error:
+            code = getattr(error, "code", RESET_CONNECTION.code)
+            reason = getattr(error, "reason", RESET_CONNECTION.reason)
+            logger.log_error(
+                f"closing connection {self.socket_id} (while handling "
+                f"{document_name}) because of exception: {error!r}"
+            )
+            self.close(CloseEvent(code, reason))

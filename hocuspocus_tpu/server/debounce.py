@@ -1,0 +1,49 @@
+"""Keyed debouncer with max-wait (reference `util/debounce.ts` semantics).
+
+Delays are milliseconds to match the reference configuration surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable, Optional
+
+
+class Debouncer:
+    def __init__(self) -> None:
+        # id -> {"start": float, "handle": TimerHandle, "func": callable}
+        self._timers: dict[str, dict] = {}
+
+    def debounce(
+        self, id: str, fn: Callable[[], Any], delay_ms: float, max_delay_ms: float
+    ) -> Optional[asyncio.Task]:
+        old = self._timers.pop(id, None)
+        start = old["start"] if old else time.monotonic()
+        if old:
+            old["handle"].cancel()
+
+        def run() -> Optional[asyncio.Task]:
+            self._timers.pop(id, None)
+            result = fn()
+            if asyncio.iscoroutine(result):
+                return asyncio.ensure_future(result)
+            return result
+
+        if delay_ms == 0 or (time.monotonic() - start) * 1000 >= max_delay_ms:
+            return run()
+
+        loop = asyncio.get_event_loop()
+        handle = loop.call_later(delay_ms / 1000, run)
+        self._timers[id] = {"start": start, "handle": handle, "func": run}
+        return None
+
+    def execute_now(self, id: str) -> Optional[asyncio.Task]:
+        old = self._timers.get(id)
+        if old:
+            old["handle"].cancel()
+            return old["func"]()
+        return None
+
+    def is_debounced(self, id: str) -> bool:
+        return id in self._timers

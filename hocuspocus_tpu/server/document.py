@@ -1,0 +1,142 @@
+"""Server-side Document: CRDT doc + awareness + connection registry.
+
+Capability parity with reference `packages/server/src/Document.ts`:
+per-socket connection registry with awareness client tracking, update
+broadcast fan-out, stateless broadcast, store mutex.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Iterable, Optional
+
+from ..crdt import Doc, apply_update, encode_state_as_update
+from ..protocol.awareness import (
+    Awareness,
+    apply_awareness_update,
+    remove_awareness_states,
+)
+from ..protocol.message import OutgoingMessage
+
+
+class Document(Doc):
+    def __init__(self, name: str, ydoc_options: Optional[dict] = None) -> None:
+        opts = dict(ydoc_options or {})
+        super().__init__(gc=opts.get("gc", True), gc_filter=opts.get("gc_filter", lambda item: True))
+        self.name = name
+        self.awareness = Awareness(self)
+        self.awareness.set_local_state(None)
+        self.is_loading = True
+        self.is_destroyed = False
+        self.save_mutex = asyncio.Lock()
+        # transport (socket object) -> {"clients": set, "connection": Connection}
+        self.connections: dict[Any, dict] = {}
+        self.direct_connections_count = 0
+        self.callbacks: dict[str, Callable] = {
+            "on_update": lambda document, connection, update: None,
+            "before_broadcast_stateless": lambda document, stateless: None,
+        }
+        self.awareness.on("update", self._handle_awareness_update)
+        self.on("update", self._handle_update)
+
+    # -- registry ----------------------------------------------------------
+
+    def add_connection(self, connection) -> "Document":
+        self.connections[connection.transport] = {"clients": set(), "connection": connection}
+        return self
+
+    def has_connection(self, connection) -> bool:
+        return connection.transport in self.connections
+
+    def remove_connection(self, connection) -> "Document":
+        remove_awareness_states(
+            self.awareness, list(self.get_clients(connection.transport)), None
+        )
+        self.connections.pop(connection.transport, None)
+        return self
+
+    def add_direct_connection(self) -> "Document":
+        self.direct_connections_count += 1
+        return self
+
+    def remove_direct_connection(self) -> "Document":
+        if self.direct_connections_count > 0:
+            self.direct_connections_count -= 1
+        return self
+
+    def get_connections_count(self) -> int:
+        return len(self.connections) + self.direct_connections_count
+
+    def get_connections(self) -> list:
+        return [entry["connection"] for entry in self.connections.values()]
+
+    def get_clients(self, transport) -> set:
+        entry = self.connections.get(transport)
+        return entry["clients"] if entry else set()
+
+    # -- content -----------------------------------------------------------
+
+    def is_empty(self, field_name: str) -> bool:
+        ytype = self.get(field_name)
+        return ytype._start is None and not ytype._map
+
+    def merge(self, documents) -> "Document":
+        for document in documents if isinstance(documents, (list, tuple)) else [documents]:
+            apply_update(self, encode_state_as_update(document))
+        return self
+
+    # -- callbacks ---------------------------------------------------------
+
+    def on_update(self, callback: Callable) -> "Document":
+        self.callbacks["on_update"] = callback
+        return self
+
+    def before_broadcast_stateless(self, callback: Callable) -> "Document":
+        self.callbacks["before_broadcast_stateless"] = callback
+        return self
+
+    # -- awareness ---------------------------------------------------------
+
+    def has_awareness_states(self) -> bool:
+        return len(self.awareness.get_states()) > 0
+
+    def apply_awareness_update(self, connection, update: bytes) -> "Document":
+        apply_awareness_update(self.awareness, update, connection.transport)
+        return self
+
+    def _handle_awareness_update(self, changes: dict, origin: Any) -> None:
+        changed_clients = changes["added"] + changes["updated"] + changes["removed"]
+        if origin is not None and origin in self.connections:
+            entry = self.connections[origin]
+            for client_id in changes["added"]:
+                entry["clients"].add(client_id)
+            for client_id in changes["removed"]:
+                entry["clients"].discard(client_id)
+        message = OutgoingMessage(self.name).create_awareness_update_message(
+            self.awareness, changed_clients
+        )
+        data = message.to_bytes()
+        for connection in self.get_connections():
+            connection.send(data)
+
+    # -- updates -----------------------------------------------------------
+
+    def _handle_update(self, update: bytes, origin: Any, doc, transaction) -> None:
+        self.callbacks["on_update"](self, origin, update)
+        message = OutgoingMessage(self.name).create_sync_message().write_update(update)
+        data = message.to_bytes()
+        for connection in self.get_connections():
+            connection.send(data)
+
+    def broadcast_stateless(self, payload: str, filter: Optional[Callable] = None) -> None:
+        self.callbacks["before_broadcast_stateless"](self, payload)
+        connections = self.get_connections()
+        if filter is not None:
+            connections = [c for c in connections if filter(c)]
+        for connection in connections:
+            connection.send_stateless(payload)
+
+    def destroy(self) -> None:
+        self.awareness.destroy()
+        super().destroy()
+        self.is_destroyed = True

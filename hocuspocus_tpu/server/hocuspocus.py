@@ -1,0 +1,388 @@
+"""The framework-agnostic server core (reference `Hocuspocus.ts` equivalent).
+
+Owns the document registry, the priority-ordered hook chain, the
+debounced store pipeline and document load/unload lifecycle. A rejected
+hook anywhere in the chain aborts the rest — that is how auth denial,
+request interception and distributed store-locks work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Callable, Optional
+
+from .. import __version__
+from ..crdt import Doc, apply_update, encode_state_as_update
+from ..protocol.awareness import awareness_states_to_array
+from ..protocol.close_events import RESET_CONNECTION
+from . import logger
+from .client_connection import ClientConnection
+from .connection import Connection
+from .debounce import Debouncer
+from .direct_connection import DirectConnection
+from .document import Document
+from .types import (
+    _CallbackExtension,
+    Configuration,
+    ConnectionConfiguration,
+    Extension,
+    HOOK_NAMES,
+    Payload,
+)
+
+REDIS_ORIGIN = "__hocuspocus__redis__origin__"
+
+
+class RequestInfo:
+    """Transport-agnostic request metadata passed through hook payloads."""
+
+    __slots__ = ("headers", "url", "parameters", "remote")
+
+    def __init__(
+        self,
+        headers: Optional[dict] = None,
+        url: str = "/",
+        parameters: Optional[dict] = None,
+        remote: Optional[str] = None,
+    ) -> None:
+        self.headers = dict(headers or {})
+        self.url = url
+        if parameters is None:
+            from urllib.parse import parse_qs, urlsplit
+
+            query = urlsplit(url).query
+            parameters = {k: v[-1] for k, v in parse_qs(query).items()}
+        self.parameters = parameters
+        self.remote = remote
+
+
+class Hocuspocus:
+    def __init__(self, configuration: Optional[Configuration] = None, **kwargs: Any) -> None:
+        self.configuration = Configuration()
+        self.documents: dict[str, Document] = {}
+        self.loading_documents: dict[str, asyncio.Future] = {}
+        self.debouncer = Debouncer()
+        self.server = None  # set by Server when hosted
+        self._configured_payload: Optional[Payload] = None
+        self._on_configure_done = False
+        if configuration is not None or kwargs:
+            self.configure(configuration, **kwargs)
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, configuration: Optional[Configuration] = None, **kwargs: Any) -> "Hocuspocus":
+        if configuration is not None:
+            self.configuration = configuration
+        for key, value in kwargs.items():
+            setattr(self.configuration, key, value)
+        extensions = list(self.configuration.extensions)
+        extensions.sort(key=lambda e: getattr(e, "priority", 100) or 100, reverse=True)
+        extensions.append(_CallbackExtension(self.configuration))
+        self._extensions = extensions
+        self._configured_payload = Payload(
+            configuration=self.configuration, version=__version__, instance=self
+        )
+        self._on_configure_done = False
+        return self
+
+    async def ensure_configured(self) -> None:
+        """Run the on_configure hook chain once (lazily, from async context)."""
+        if self._configured_payload is None:
+            self.configure(self.configuration)
+        if not self._on_configure_done:
+            self._on_configure_done = True
+            await self.hooks("on_configure", self._configured_payload)
+
+    # -- hook chain --------------------------------------------------------
+
+    async def hooks(self, name: str, payload: Payload, callback: Optional[Callable] = None) -> Any:
+        """Run hook `name` on every extension, in priority order.
+
+        An exception from any extension aborts the rest of the chain and
+        propagates. `callback` runs after each extension with its return
+        value (used for context merging).
+        """
+        result: Any = None
+        for extension in getattr(self, "_extensions", []):
+            handler = getattr(extension, name, None)
+            if handler is None or not callable(handler):
+                continue
+            try:
+                result = handler(payload)
+                if asyncio.iscoroutine(result):
+                    result = await result
+            except Exception as error:
+                if str(error):
+                    logger.log_error(f"[{name}] {error}")
+                raise
+            if callback is not None:
+                cb_result = callback(result)
+                if asyncio.iscoroutine(cb_result):
+                    await cb_result
+        return result
+
+    # -- metrics -----------------------------------------------------------
+
+    def get_documents_count(self) -> int:
+        return len(self.documents)
+
+    def get_connections_count(self) -> int:
+        unique_socket_ids: set[str] = set()
+        direct = 0
+        for document in self.documents.values():
+            for connection in document.get_connections():
+                unique_socket_ids.add(connection.socket_id)
+            direct += document.direct_connections_count
+        return len(unique_socket_ids) + direct
+
+    def close_connections(self, document_name: Optional[str] = None) -> None:
+        for document in list(self.documents.values()):
+            if document_name is not None and document.name != document_name:
+                continue
+            for connection in document.get_connections():
+                connection.close(RESET_CONNECTION)
+
+    # -- connection handling -----------------------------------------------
+
+    def handle_connection(self, transport, request: RequestInfo, default_context: Optional[dict] = None) -> ClientConnection:
+        client_connection = ClientConnection(
+            transport,
+            request,
+            self,
+            self.hooks,
+            timeout=self.configuration.timeout,
+            default_context=default_context,
+        )
+
+        def handle_close(document: Document, hook_payload: Payload) -> None:
+            # Re-check: hooks may have taken time; a new connection may
+            # have arrived and relies on the registered document.
+            if document.get_connections_count() > 0:
+                return
+            debounce_id = f"onStoreDocument-{document.name}"
+            if not document.is_loading and self.debouncer.is_debounced(debounce_id):
+                if self.configuration.unload_immediately:
+                    self.debouncer.execute_now(debounce_id)
+            else:
+                asyncio.ensure_future(self.unload_document(document))
+
+        client_connection.on_close(handle_close)
+        return client_connection
+
+    # -- update pipeline ---------------------------------------------------
+
+    async def handle_document_update(
+        self,
+        document: Document,
+        connection: Any,
+        update: bytes,
+        request: Optional[RequestInfo] = None,
+    ) -> None:
+        hook_payload = Payload(
+            instance=self,
+            clients_count=document.get_connections_count(),
+            context=getattr(connection, "context", None) or {},
+            document=document,
+            document_name=document.name,
+            request_headers=request.headers if request is not None else {},
+            request_parameters=request.parameters if request is not None else {},
+            socket_id=getattr(connection, "socket_id", ""),
+            update=update,
+            transaction_origin=connection,
+        )
+        asyncio.ensure_future(self._run_on_change(hook_payload))
+        # Updates that did not come through a WebSocket connection are not
+        # ours to store; redis-origin changes are stored by the instance
+        # that received them from its client (reference #730/#696/#606).
+        if connection is None or not isinstance(connection, Connection):
+            return
+        await self.store_document_hooks(document, hook_payload)
+
+    async def _run_on_change(self, payload: Payload) -> None:
+        try:
+            await self.hooks("on_change", payload)
+        except Exception:
+            pass
+
+    def store_document_hooks(
+        self, document: Document, hook_payload: Payload, immediately: bool = False
+    ):
+        debounce_id = f"onStoreDocument-{document.name}"
+
+        async def run() -> None:
+            try:
+                async with document.save_mutex:
+                    await self.hooks("on_store_document", hook_payload)
+                    await self.hooks("after_store_document", hook_payload)
+            except Exception as error:
+                logger.log_error(f"caught error during store_document_hooks: {error!r}")
+                if str(error):
+                    raise
+            finally:
+                has_pending_work = (
+                    self.debouncer.is_debounced(debounce_id) or document.save_mutex.locked()
+                )
+                if document.get_connections_count() == 0 and not has_pending_work:
+                    await self.unload_document(document)
+
+        return self.debouncer.debounce(
+            debounce_id,
+            run,
+            0 if immediately else self.configuration.debounce,
+            self.configuration.max_debounce,
+        )
+
+    # -- document lifecycle ------------------------------------------------
+
+    async def create_document(
+        self,
+        document_name: str,
+        request: RequestInfo,
+        socket_id: str,
+        connection_config: ConnectionConfiguration,
+        context: Any = None,
+    ) -> Document:
+        existing_loading = self.loading_documents.get(document_name)
+        if existing_loading is not None:
+            return await asyncio.shield(existing_loading)
+        existing = self.documents.get(document_name)
+        if existing is not None:
+            return existing
+        future = asyncio.ensure_future(
+            self.load_document(document_name, request, socket_id, connection_config, context)
+        )
+        self.loading_documents[document_name] = future
+        try:
+            document = await asyncio.shield(future)
+            self.documents[document_name] = document
+            return document
+        finally:
+            self.loading_documents.pop(document_name, None)
+
+    async def load_document(
+        self,
+        document_name: str,
+        request: RequestInfo,
+        socket_id: str,
+        connection_config: ConnectionConfiguration,
+        context: Any = None,
+    ) -> Document:
+        await self.ensure_configured()
+        request_headers = request.headers if request is not None else {}
+        request_parameters = request.parameters if request is not None else {}
+
+        ydoc_options = await self.hooks(
+            "on_create_document",
+            Payload(
+                document_name=document_name,
+                request_headers=request_headers,
+                request_parameters=request_parameters,
+                connection_config=connection_config,
+                context=context,
+                socket_id=socket_id,
+                instance=self,
+            ),
+        )
+        document = Document(
+            document_name,
+            {**self.configuration.ydoc_options, **(ydoc_options or {})},
+        )
+
+        hook_payload = Payload(
+            instance=self,
+            context=context,
+            connection_config=connection_config,
+            document=document,
+            document_name=document_name,
+            socket_id=socket_id,
+            request_headers=request_headers,
+            request_parameters=request_parameters,
+        )
+
+        def apply_loaded(loaded: Any) -> None:
+            # A hook may return a Doc whose state seeds the new document.
+            if isinstance(loaded, Doc):
+                apply_update(document, encode_state_as_update(loaded))
+
+        try:
+            await self.hooks("on_load_document", hook_payload, apply_loaded)
+        except Exception:
+            self.close_connections(document_name)
+            await self.unload_document(document)
+            raise
+
+        document.is_loading = False
+        await self.hooks("after_load_document", hook_payload)
+
+        def on_update(document: Document, origin: Any, update: bytes) -> None:
+            request = getattr(origin, "request", None)
+            asyncio.ensure_future(
+                self.handle_document_update(document, origin, update, request)
+            )
+
+        document.on_update(on_update)
+
+        def before_broadcast_stateless(document: Document, stateless: str) -> None:
+            payload = Payload(
+                document=document, document_name=document.name, payload=stateless
+            )
+            asyncio.ensure_future(self._safe_hooks("before_broadcast_stateless", payload))
+
+        document.before_broadcast_stateless(before_broadcast_stateless)
+
+        def on_awareness_update(changes: dict, origin: Any) -> None:
+            asyncio.ensure_future(
+                self._safe_hooks(
+                    "on_awareness_update",
+                    Payload(
+                        **{
+                            **hook_payload.__dict__,
+                            **changes,
+                            "awareness": document.awareness,
+                            "states": awareness_states_to_array(
+                                document.awareness.get_states()
+                            ),
+                        }
+                    ),
+                )
+            )
+
+        document.awareness.on("update", on_awareness_update)
+        return document
+
+    async def _safe_hooks(self, name: str, payload: Payload) -> None:
+        try:
+            await self.hooks(name, payload)
+        except Exception:
+            pass
+
+    async def unload_document(self, document: Document) -> None:
+        document_name = document.name
+        if document_name not in self.documents:
+            return
+        try:
+            await self.hooks(
+                "before_unload_document",
+                Payload(instance=self, document_name=document_name, document=document),
+            )
+        except Exception:
+            return
+        if document.get_connections_count() > 0:
+            return
+        self.documents.pop(document_name, None)
+        document.destroy()
+        await self.hooks(
+            "after_unload_document", Payload(instance=self, document_name=document_name)
+        )
+
+    async def open_direct_connection(self, document_name: str, context: Any = None) -> DirectConnection:
+        connection_config = ConnectionConfiguration(is_authenticated=True, read_only=False)
+        document = await self.create_document(
+            document_name,
+            RequestInfo(),
+            str(uuid.uuid4()),
+            connection_config,
+            context,
+        )
+        return DirectConnection(document, self, context)

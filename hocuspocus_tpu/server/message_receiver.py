@@ -1,0 +1,178 @@
+"""Inbound message dispatch — the server hot path.
+
+Capability parity with reference `packages/server/src/MessageReceiver.ts`:
+sync step handling (server replies SyncStep2 followed by its own
+SyncStep1), awareness, stateless, read-only SyncStatus acks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..crdt import snapshot, snapshot_contains_update
+from ..protocol.awareness import apply_awareness_update
+from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
+from ..protocol.sync import (
+    MESSAGE_YJS_SYNC_STEP1,
+    MESSAGE_YJS_SYNC_STEP2,
+    MESSAGE_YJS_UPDATE,
+    read_sync_step1,
+    read_sync_step2,
+    read_update,
+)
+from .document import Document
+from . import logger as _logger_mod
+
+
+class MessageReceiver:
+    def __init__(self, message: IncomingMessage, default_transaction_origin=None) -> None:
+        self.message = message
+        self.default_transaction_origin = default_transaction_origin
+
+    async def apply(
+        self,
+        document: Document,
+        connection=None,
+        reply: Optional[Callable[[bytes], None]] = None,
+    ) -> None:
+        message = self.message
+        message_type = message.read_var_uint()
+        empty_message_length = message.length
+
+        if message_type in (MessageType.Sync, MessageType.SyncReply):
+            message.write_var_uint(MessageType.Sync)
+            await self.read_sync_message(
+                message,
+                document,
+                connection,
+                reply,
+                request_first_sync=message_type != MessageType.SyncReply,
+            )
+            if message.length > empty_message_length + 1:
+                if reply is not None:
+                    reply(message.to_bytes())
+                elif connection is not None:
+                    connection.send(message.to_bytes())
+        elif message_type == MessageType.Awareness:
+            apply_awareness_update(
+                document.awareness,
+                message.read_var_uint8_array(),
+                connection.transport if connection is not None else None,
+            )
+        elif message_type == MessageType.QueryAwareness:
+            self.apply_query_awareness(document, reply)
+        elif message_type == MessageType.Stateless:
+            if connection is not None:
+                from ..server.types import Payload
+
+                await connection.callbacks["stateless"](
+                    Payload(
+                        connection=connection,
+                        document_name=document.name,
+                        document=document,
+                        payload=message.read_var_string(),
+                    )
+                )
+        elif message_type == MessageType.BroadcastStateless:
+            payload = message.read_var_string()
+            for conn in document.get_connections():
+                conn.send_stateless(payload)
+        elif message_type == MessageType.CLOSE:
+            if connection is not None:
+                from ..protocol.close_events import CloseEvent
+
+                connection.close(CloseEvent(1000, "provider_initiated"))
+        elif message_type == MessageType.Auth:
+            _logger_mod.log_error(
+                "Received an authentication message on an already-authenticated "
+                "connection. Probably your provider was destroyed and recreated "
+                "very fast."
+            )
+        else:
+            _logger_mod.log_error(
+                f"Unable to handle message of type {message_type}: no handler defined!"
+            )
+
+    async def read_sync_message(
+        self,
+        message: IncomingMessage,
+        document: Document,
+        connection=None,
+        reply: Optional[Callable[[bytes], None]] = None,
+        request_first_sync: bool = True,
+    ) -> int:
+        sync_type = message.read_var_uint()
+
+        if connection is not None:
+            from ..server.types import Payload
+
+            await connection.callbacks["before_sync"](
+                connection,
+                Payload(type=sync_type, payload=message.peek_var_uint8_array()),
+            )
+
+        if sync_type == MESSAGE_YJS_SYNC_STEP1:
+            read_sync_step1(message.decoder, message.encoder, document)
+            # The server replies SyncStep2 (already in message.encoder)
+            # immediately followed by its own SyncStep1.
+            if reply is not None and request_first_sync:
+                sync_message = (
+                    OutgoingMessage(document.name)
+                    .create_sync_reply_message()
+                    .write_first_sync_step_for(document)
+                )
+                reply(sync_message.to_bytes())
+            elif connection is not None:
+                sync_message = (
+                    OutgoingMessage(document.name)
+                    .create_sync_message()
+                    .write_first_sync_step_for(document)
+                )
+                connection.send(sync_message.to_bytes())
+        elif sync_type == MESSAGE_YJS_SYNC_STEP2:
+            if connection is not None and connection.read_only:
+                # Read-only: never apply. Ack only when the update brings
+                # nothing new (snapshot containment check).
+                snap = snapshot(document)
+                update = message.read_var_uint8_array()
+                contains = snapshot_contains_update(snap, update)
+                connection.send(
+                    OutgoingMessage(document.name).write_sync_status(contains).to_bytes()
+                )
+                return sync_type
+            read_sync_step2(
+                message.decoder,
+                document,
+                connection if connection is not None else self.default_transaction_origin,
+            )
+            if connection is not None:
+                connection.send(
+                    OutgoingMessage(document.name).write_sync_status(True).to_bytes()
+                )
+        elif sync_type == MESSAGE_YJS_UPDATE:
+            if connection is not None and connection.read_only:
+                connection.send(
+                    OutgoingMessage(document.name).write_sync_status(False).to_bytes()
+                )
+                return sync_type
+            read_update(
+                message.decoder,
+                document,
+                connection if connection is not None else self.default_transaction_origin,
+            )
+            if connection is not None:
+                connection.send(
+                    OutgoingMessage(document.name).write_sync_status(True).to_bytes()
+                )
+        else:
+            raise ValueError(f"received a sync message with unknown type {sync_type}")
+        return sync_type
+
+    def apply_query_awareness(
+        self, document: Document, reply: Optional[Callable[[bytes], None]] = None
+    ) -> None:
+        message = OutgoingMessage(document.name).create_awareness_update_message(
+            document.awareness
+        )
+        if reply is not None:
+            reply(message.to_bytes())

@@ -1,0 +1,213 @@
+"""Built-in HTTP + WebSocket host (reference `Server.ts` equivalent).
+
+Hosts a `Hocuspocus` instance on aiohttp. The core stays
+framework-agnostic: any transport implementing send/close can call
+`hocuspocus.handle_connection` (mirroring how the reference embeds in
+express/koa/hono — `playground/backend/src/*.ts`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Optional
+
+from aiohttp import WSMsgType, web
+
+from . import logger
+from .hocuspocus import Hocuspocus, RequestInfo
+from .types import Configuration, Payload
+
+
+class AiohttpWebSocketTransport:
+    """Queue-backed writer over an aiohttp WebSocketResponse.
+
+    send() is synchronous (called from CRDT transaction callbacks); an
+    async writer task drains the queue preserving order.
+    """
+
+    def __init__(self, ws: web.WebSocketResponse) -> None:
+        self.ws = ws
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        self._writer_task = asyncio.ensure_future(self._writer())
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed or self.ws.closed
+
+    def send(self, data: bytes) -> None:
+        if not self.is_closed:
+            self.queue.put_nowait(("data", data))
+
+    def close(self, code: int = 1000, reason: str = "") -> None:
+        if not self._closed:
+            self._closed = True
+            self.queue.put_nowait(("close", (code, reason)))
+
+    async def _writer(self) -> None:
+        while True:
+            kind, payload = await self.queue.get()
+            try:
+                if kind == "data":
+                    await self.ws.send_bytes(payload)
+                else:
+                    code, reason = payload
+                    await self.ws.close(code=code, message=reason.encode())
+                    return
+            except Exception:
+                self._closed = True
+                return
+
+    def abort(self) -> None:
+        self._closed = True
+        self._writer_task.cancel()
+
+
+class Server:
+    def __init__(self, configuration: Optional[Configuration] = None, **kwargs: Any) -> None:
+        self.hocuspocus = Hocuspocus(configuration, **kwargs)
+        self.hocuspocus.server = self
+        self.host: Optional[str] = None
+        self.port: Optional[int] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+
+    @property
+    def configuration(self) -> Configuration:
+        return self.hocuspocus.configuration
+
+    @property
+    def documents(self) -> dict:
+        return self.hocuspocus.documents
+
+    def get_documents_count(self) -> int:
+        return self.hocuspocus.get_documents_count()
+
+    def get_connections_count(self) -> int:
+        return self.hocuspocus.get_connections_count()
+
+    def close_connections(self, document_name: Optional[str] = None) -> None:
+        self.hocuspocus.close_connections(document_name)
+
+    async def open_direct_connection(self, document_name: str, context: Any = None):
+        return await self.hocuspocus.open_direct_connection(document_name, context)
+
+    @property
+    def address(self) -> dict:
+        return {"host": self.host, "port": self.port}
+
+    @property
+    def http_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def web_socket_url(self) -> str:
+        return f"ws://{self.host}:{self.port}"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def listen(self, port: int = 80, host: str = "127.0.0.1") -> "Server":
+        await self.hocuspocus.ensure_configured()
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle_request)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host, port)
+        await self._site.start()
+        # resolve OS-assigned port (port=0 support for tests)
+        server_sockets = self._site._server.sockets  # type: ignore[union-attr]
+        self.host = host
+        self.port = server_sockets[0].getsockname()[1] if server_sockets else port
+        if not self.configuration.quiet:
+            self._show_start_screen()
+        await self.hocuspocus.hooks(
+            "on_listen",
+            Payload(instance=self.hocuspocus, configuration=self.configuration, port=self.port),
+        )
+        return self
+
+    def _show_start_screen(self) -> None:
+        name = self.configuration.name or "hocuspocus-tpu"
+        extensions = sorted(
+            type(e).__name__
+            for e in getattr(self.hocuspocus, "_extensions", [])
+            if type(e).__name__ != "_CallbackExtension"
+        )
+        logging.getLogger("hocuspocus_tpu").info(
+            "%s v%s running at %s (extensions: %s)",
+            name,
+            __import__("hocuspocus_tpu").__version__,
+            self.web_socket_url,
+            ", ".join(extensions) or "none",
+        )
+
+    async def destroy(self) -> None:
+        # stop accepting new connections, reset existing ones
+        self.close_connections()
+        # wait for all documents to store + unload
+        for _ in range(500):
+            if self.hocuspocus.get_documents_count() == 0:
+                break
+            await asyncio.sleep(0.01)
+        try:
+            await self.hocuspocus.hooks("on_destroy", Payload(instance=self.hocuspocus))
+        finally:
+            if self._runner is not None:
+                await self._runner.cleanup()
+
+    # -- request handling --------------------------------------------------
+
+    async def _handle_request(self, request: web.Request):
+        if (
+            request.headers.get("Upgrade", "").lower() == "websocket"
+            and request.method == "GET"
+        ):
+            return await self._handle_websocket(request)
+        payload = Payload(request=request, instance=self.hocuspocus, response=None)
+        try:
+            await self.hocuspocus.hooks("on_request", payload)
+        except Exception as error:
+            response = getattr(error, "response", None) or payload.get("response")
+            if response is not None:
+                return response
+            return web.Response(status=500, text="Internal Server Error")
+        if payload.get("response") is not None:
+            return payload["response"]
+        return web.Response(text="Welcome to hocuspocus-tpu!")
+
+    async def _handle_websocket(self, request: web.Request):
+        request_info = RequestInfo(
+            headers=dict(request.headers),
+            url=str(request.rel_url),
+            remote=request.remote,
+        )
+        context: dict = {}
+        try:
+            await self.hocuspocus.hooks(
+                "on_upgrade",
+                Payload(request=request, instance=self.hocuspocus, context=context),
+            )
+        except Exception:
+            return web.Response(status=403, text="Forbidden")
+
+        heartbeat = max(self.configuration.timeout / 1000, 1)
+        ws = web.WebSocketResponse(heartbeat=heartbeat, autoping=True, max_msg_size=0)
+        await ws.prepare(request)
+        transport = AiohttpWebSocketTransport(ws)
+        client_connection = self.hocuspocus.handle_connection(transport, request_info, context)
+        close_code = 1000
+        close_reason = ""
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.BINARY:
+                    await client_connection.handle_message(msg.data)
+                elif msg.type == WSMsgType.ERROR:
+                    break
+        except Exception as error:
+            logger.log_error(f"websocket error: {error!r}")
+        finally:
+            close_code = ws.close_code or 1000
+            transport.abort()
+            await client_connection.handle_transport_close(close_code, close_reason)
+        return ws

@@ -1,0 +1,148 @@
+"""E2E: connect, sync, broadcast — the minimum end-to-end slice."""
+
+import asyncio
+
+import pytest
+
+from tests.utils import (
+    EventCollector,
+    wait_synced,
+    new_hocuspocus,
+    new_provider,
+    retryable_assertion,
+    wait_for,
+)
+
+
+async def test_provider_syncs_with_server():
+    server = await new_hocuspocus()
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        assert provider.synced
+        assert server.get_documents_count() == 1
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_edit_propagates_between_two_providers():
+    server = await new_hocuspocus()
+    provider_a = new_provider(server)
+    provider_b = new_provider(server)
+    try:
+        await wait_synced(provider_a, provider_b)
+
+        provider_a.document.get_text("t").insert(0, "hello from A")
+        await retryable_assertion(
+            lambda: _assert_text(provider_b, "hello from A")
+        )
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+def _assert_text(provider, expected):
+    assert provider.document.get_text("t").to_string() == expected
+
+
+async def test_late_joiner_receives_existing_content():
+    server = await new_hocuspocus()
+    provider_a = new_provider(server)
+    try:
+        await wait_synced(provider_a)
+        provider_a.document.get_text("t").insert(0, "existing")
+        await asyncio.sleep(0.1)
+
+        provider_b = new_provider(server)
+        try:
+            await wait_synced(provider_b)
+            await retryable_assertion(lambda: _assert_text(provider_b, "existing"))
+        finally:
+            provider_b.destroy()
+    finally:
+        provider_a.destroy()
+        await server.destroy()
+
+
+async def test_concurrent_edits_converge():
+    server = await new_hocuspocus()
+    provider_a = new_provider(server)
+    provider_b = new_provider(server)
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_text("t").insert(0, "AAA")
+        provider_b.document.get_text("t").insert(0, "BBB")
+
+        def converged():
+            a = provider_a.document.get_text("t").to_string()
+            b = provider_b.document.get_text("t").to_string()
+            assert a == b and "AAA" in a and "BBB" in a
+
+        await retryable_assertion(converged)
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_unsynced_changes_acked():
+    server = await new_hocuspocus()
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "x")
+        assert provider.has_unsynced_changes
+        await wait_for(lambda: not provider.has_unsynced_changes)
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_document_count_and_connection_count():
+    server = await new_hocuspocus()
+    provider_a = new_provider(server, name="doc-1")
+    provider_b = new_provider(server, name="doc-2")
+    try:
+        await wait_synced(provider_a, provider_b)
+        assert server.get_documents_count() == 2
+        assert server.get_connections_count() == 2
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_http_request_default_response():
+    import aiohttp
+
+    server = await new_hocuspocus()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(server.http_url) as response:
+                assert response.status == 200
+                assert "hocuspocus" in (await response.text()).lower()
+    finally:
+        await server.destroy()
+
+
+async def test_awareness_propagates():
+    server = await new_hocuspocus()
+    provider_a = new_provider(server)
+    provider_b = new_provider(server)
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.set_awareness_field("user", {"name": "ada"})
+
+        def b_sees_a():
+            states = provider_b.awareness.get_states()
+            assert any(
+                state.get("user", {}).get("name") == "ada" for state in states.values()
+            )
+
+        await retryable_assertion(b_sees_a)
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()

@@ -1,0 +1,88 @@
+"""E2E test harness: in-process server + real websocket providers.
+
+Mirrors the reference test strategy (`tests/utils/newHocuspocus.ts`):
+every test boots a real server on an OS-assigned port and real provider
+clients over real WebSockets, in one process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+from hocuspocus_tpu.provider import HocuspocusProvider, HocuspocusProviderWebsocket
+from hocuspocus_tpu.server import Configuration, Server
+
+
+async def new_hocuspocus(**options: Any) -> Server:
+    options.setdefault("quiet", True)
+    configuration = Configuration(**options)
+    server = Server(configuration)
+    await server.listen(port=0)
+    return server
+
+
+def new_provider_websocket(server: Server, **options: Any) -> HocuspocusProviderWebsocket:
+    return HocuspocusProviderWebsocket(url=server.web_socket_url, **options)
+
+
+def new_provider(server: Server, name: str = "hocuspocus-test", **options: Any) -> HocuspocusProvider:
+    return HocuspocusProvider(name=name, url=server.web_socket_url, **options)
+
+
+async def retryable_assertion(fn, timeout: float = 10.0, interval: float = 0.05) -> Any:
+    """Poll until `fn` stops raising (eventual-consistency assertions —
+    reference `tests/utils/retryableAssertion.ts`)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            result = fn()
+            if asyncio.iscoroutine(result):
+                result = await result
+            return result
+        except AssertionError:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(interval)
+
+
+async def wait_synced(*providers, timeout: float = 10.0) -> None:
+    """Wait until every provider has completed its first sync handshake."""
+    for provider in providers:
+        await wait_for(lambda p=provider: p.synced, timeout=timeout)
+
+
+async def wait_for(predicate, timeout: float = 10.0, interval: float = 0.02) -> None:
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() > deadline:
+            raise TimeoutError("condition not met in time")
+        await asyncio.sleep(interval)
+
+
+class EventCollector:
+    """Collects event payloads and lets tests await their arrival."""
+
+    def __init__(self) -> None:
+        self.events: list = []
+        self._event = asyncio.Event()
+
+    def __call__(self, *args: Any) -> None:
+        self.events.append(args)
+        self._event.set()
+
+    async def wait(self, count: int = 1, timeout: float = 10.0) -> list:
+        deadline = time.monotonic() + timeout
+        while len(self.events) < count:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"expected {count} events, got {len(self.events)}"
+                )
+            self._event.clear()
+            try:
+                await asyncio.wait_for(self._event.wait(), min(remaining, 0.5))
+            except asyncio.TimeoutError:
+                continue
+        return self.events
