@@ -1,0 +1,90 @@
+"""CLI entrypoint (reference `packages/cli`): `hocuspocus-tpu --port 1234`."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="hocuspocus-tpu",
+        description="Run a TPU-native collaboration backend server.",
+    )
+    parser.add_argument("--port", "-p", type=int, default=1234, help="port to listen on")
+    parser.add_argument("--host", default="0.0.0.0", help="host to bind")
+    parser.add_argument("--webhook", "-w", help="webhook URL to POST document changes to")
+    parser.add_argument(
+        "--sqlite",
+        "-s",
+        nargs="?",
+        const=":memory:",
+        help="store documents in SQLite (optional path, default in-memory)",
+    )
+    parser.add_argument("--s3", action="store_true", help="store documents in S3")
+    parser.add_argument("--s3-bucket", help="S3 bucket")
+    parser.add_argument("--s3-region", default="us-east-1", help="S3 region")
+    parser.add_argument("--s3-prefix", default="", help="S3 key prefix")
+    parser.add_argument("--s3-endpoint", help="S3 endpoint override")
+    parser.add_argument(
+        "--tpu-merge",
+        action="store_true",
+        help="enable the TPU batched merge plane extension",
+    )
+    return parser
+
+
+async def run(args: argparse.Namespace) -> None:
+    from .extensions import Logger, SQLite, S3, Webhook
+    from .server import Configuration, Server
+
+    extensions: list = [Logger()]
+    if args.sqlite is not None:
+        extensions.append(SQLite(database=args.sqlite))
+    if args.s3:
+        if not args.s3_bucket:
+            print("--s3 requires --s3-bucket", file=sys.stderr)
+            sys.exit(2)
+        extensions.append(
+            S3(
+                bucket=args.s3_bucket,
+                region=args.s3_region,
+                prefix=args.s3_prefix,
+                endpoint=args.s3_endpoint,
+            )
+        )
+    if args.webhook:
+        extensions.append(Webhook(url=args.webhook))
+    if args.tpu_merge:
+        from .tpu import TpuMergeExtension
+
+        extensions.append(TpuMergeExtension())
+
+    server = Server(Configuration(extensions=extensions, quiet=False))
+    await server.listen(port=args.port, host=args.host)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    await server.destroy()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    args = build_parser().parse_args()
+    try:
+        asyncio.run(run(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

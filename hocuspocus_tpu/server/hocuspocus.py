@@ -197,7 +197,9 @@ class Hocuspocus:
         # that received them from its client (reference #730/#696/#606).
         if connection is None or not isinstance(connection, Connection):
             return
-        await self.store_document_hooks(document, hook_payload)
+        task = self.store_document_hooks(document, hook_payload)
+        if task is not None:
+            await task
 
     async def _run_on_change(self, payload: Payload) -> None:
         try:

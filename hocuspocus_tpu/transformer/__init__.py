@@ -1,0 +1,4 @@
+from .prosemirror import Prosemirror, ProsemirrorTransformer
+from .tiptap import Tiptap, TiptapTransformer
+
+__all__ = ["Prosemirror", "ProsemirrorTransformer", "Tiptap", "TiptapTransformer"]

@@ -1,0 +1,113 @@
+"""Database + SQLite persistence E2E (reference tests/extension-database,
+tests/server/onStoreDocument.ts patterns)."""
+
+import asyncio
+
+from hocuspocus_tpu.crdt import Doc, apply_update
+from hocuspocus_tpu.extensions import Database, SQLite
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+async def test_database_fetch_applied_on_load():
+    source = Doc()
+    source.get_text("t").insert(0, "from the database")
+    from hocuspocus_tpu.crdt import encode_state_as_update
+
+    stored = encode_state_as_update(source)
+
+    async def fetch(data):
+        return stored
+
+    stores = []
+
+    async def store(data):
+        stores.append((data.document_name, data["state"]))
+
+    server = await new_hocuspocus(extensions=[Database(fetch=fetch, store=store)])
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        await retryable_assertion(
+            lambda: _assert(provider.document.get_text("t").to_string() == "from the database")
+        )
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_database_store_called_with_state():
+    stores = []
+
+    async def fetch(data):
+        return None
+
+    async def store(data):
+        stores.append((data.document_name, data["state"]))
+
+    server = await new_hocuspocus(
+        extensions=[Database(fetch=fetch, store=store)], debounce=50
+    )
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "persist me")
+        await retryable_assertion(lambda: _assert(len(stores) > 0))
+        # stored state must reconstruct the document
+        doc = Doc()
+        apply_update(doc, stores[-1][1])
+        assert doc.get_text("t").to_string() == "persist me"
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_sqlite_roundtrip(tmp_path):
+    path = str(tmp_path / "test.db")
+    server = await new_hocuspocus(extensions=[SQLite(database=path)], debounce=50)
+    provider = new_provider(server, name="sqlite-doc")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "durable")
+        await asyncio.sleep(0.3)
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+    # boot a fresh server on the same file — content must come back
+    server2 = await new_hocuspocus(extensions=[SQLite(database=path)])
+    provider2 = new_provider(server2, name="sqlite-doc")
+    try:
+        await wait_synced(provider2)
+        await retryable_assertion(
+            lambda: _assert(provider2.document.get_text("t").to_string() == "durable")
+        )
+    finally:
+        provider2.destroy()
+        await server2.destroy()
+
+
+async def test_store_debounce_flushed_on_disconnect():
+    stores = []
+
+    async def store(data):
+        stores.append(data.document_name)
+
+    # long debounce: only the disconnect flush can store this fast
+    server = await new_hocuspocus(extensions=[Database(store=store)], debounce=60000)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "x")
+        await asyncio.sleep(0.2)
+        assert stores == []
+        provider.destroy()
+        await retryable_assertion(lambda: _assert(len(stores) == 1))
+        # document unloaded after flush
+        await retryable_assertion(lambda: _assert(server.get_documents_count() == 0))
+    finally:
+        await server.destroy()

@@ -1,0 +1,152 @@
+"""Webhook (HMAC, events, onLoadDocument import) and Throttle tests."""
+
+import asyncio
+import hashlib
+import hmac
+import json
+
+from aiohttp import web
+
+from hocuspocus_tpu.extensions import Events, Throttle, Webhook
+from hocuspocus_tpu.extensions.throttle import ThrottleRejection
+from hocuspocus_tpu.server.types import Payload
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+class FakeWebhookTarget:
+    """In-process HTTP endpoint capturing webhook POSTs."""
+
+    def __init__(self, response_body=None):
+        self.requests: list[dict] = []
+        self.response_body = response_body
+        self.runner = None
+        self.url = None
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_post("/", self.handle)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}/"
+        return self
+
+    async def handle(self, request):
+        body = await request.read()
+        self.requests.append(
+            {
+                "body": json.loads(body),
+                "raw": body,
+                "signature": request.headers.get("X-Hocuspocus-Signature-256"),
+            }
+        )
+        if self.response_body is not None:
+            return web.json_response(self.response_body)
+        return web.json_response({})
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+
+async def test_webhook_on_change_with_signature():
+    target = await FakeWebhookTarget().start()
+    server = await new_hocuspocus(
+        extensions=[Webhook(url=target.url, secret="sec", debounce=10)]
+    )
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        from hocuspocus_tpu.crdt import YXmlElement
+
+        fragment = provider.document.get_xml_fragment("default")
+        fragment.insert(0, [YXmlElement("paragraph")])
+        await retryable_assertion(lambda: _assert(len(target.requests) >= 1))
+        req = target.requests[0]
+        assert req["body"]["event"] == "change"
+        expected = (
+            "sha256=" + hmac.new(b"sec", req["raw"], hashlib.sha256).hexdigest()
+        )
+        assert req["signature"] == expected
+    finally:
+        provider.destroy()
+        await server.destroy()
+        await target.stop()
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_webhook_on_connect_context_and_create_import():
+    doc_json = {
+        "default": {
+            "type": "doc",
+            "content": [
+                {"type": "paragraph", "content": [{"type": "text", "text": "imported"}]}
+            ],
+        }
+    }
+    target = await FakeWebhookTarget(response_body=doc_json).start()
+    server = await new_hocuspocus(
+        extensions=[
+            Webhook(
+                url=target.url,
+                events=[Events.onConnect, Events.onCreate],
+            )
+        ]
+    )
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        await retryable_assertion(
+            lambda: _assert(
+                {r["body"]["event"] for r in target.requests} >= {"connect", "create"}
+            )
+        )
+        # imported content visible to the provider
+        from hocuspocus_tpu.transformer import TiptapTransformer
+
+        def imported():
+            data = TiptapTransformer.from_ydoc(provider.document, "default")
+            assert data == doc_json["default"]
+
+        await retryable_assertion(imported)
+    finally:
+        provider.destroy()
+        await server.destroy()
+        await target.stop()
+
+
+async def test_throttle_bans_after_limit():
+    throttle = Throttle(throttle=3, considered_seconds=60, ban_time=5)
+    payload = Payload(request_headers={"x-real-ip": "1.2.3.4"}, request=None)
+    for _ in range(3):
+        await throttle.on_connect(payload)
+    import pytest
+
+    with pytest.raises(ThrottleRejection):
+        await throttle.on_connect(payload)
+    assert throttle.is_banned("1.2.3.4")
+    # another IP is unaffected
+    await throttle.on_connect(Payload(request_headers={"x-real-ip": "5.6.7.8"}, request=None))
+
+
+async def test_throttle_rejected_connection_gets_permission_denied():
+    server = await new_hocuspocus(extensions=[Throttle(throttle=1, considered_seconds=60)])
+    provider_a = new_provider(server, name="d1")
+    try:
+        await wait_synced(provider_a)
+        # second connection from same IP exceeds limit of 1
+        provider_b = new_provider(server, name="d2")
+        failed = []
+        provider_b.on("authentication_failed", lambda data: failed.append(data))
+        try:
+            await retryable_assertion(lambda: _assert(len(failed) >= 1))
+        finally:
+            provider_b.destroy()
+    finally:
+        provider_a.destroy()
+        await server.destroy()

@@ -1,0 +1,76 @@
+"""ProseMirror/Tiptap transformer tests."""
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.transformer import ProsemirrorTransformer, TiptapTransformer
+
+SIMPLE_DOC = {
+    "type": "doc",
+    "content": [
+        {
+            "type": "paragraph",
+            "content": [{"type": "text", "text": "hello world"}],
+        }
+    ],
+}
+
+RICH_DOC = {
+    "type": "doc",
+    "content": [
+        {
+            "type": "heading",
+            "attrs": {"level": 1},
+            "content": [{"type": "text", "text": "Title"}],
+        },
+        {
+            "type": "paragraph",
+            "content": [
+                {"type": "text", "text": "plain "},
+                {"type": "text", "text": "bold", "marks": [{"type": "bold"}]},
+                {
+                    "type": "text",
+                    "text": " link",
+                    "marks": [{"type": "link", "attrs": {"href": "https://x.test"}}],
+                },
+            ],
+        },
+    ],
+}
+
+
+def test_roundtrip_simple():
+    ydoc = ProsemirrorTransformer.to_ydoc(SIMPLE_DOC, "prosemirror")
+    back = ProsemirrorTransformer.from_ydoc(ydoc, "prosemirror")
+    assert back == SIMPLE_DOC
+
+
+def test_roundtrip_rich_marks_and_attrs():
+    ydoc = ProsemirrorTransformer.to_ydoc(RICH_DOC, "prosemirror")
+    back = ProsemirrorTransformer.from_ydoc(ydoc, "prosemirror")
+    assert back == RICH_DOC
+
+
+def test_transformed_doc_syncs_via_updates():
+    ydoc = ProsemirrorTransformer.to_ydoc(RICH_DOC, "prosemirror")
+    other = Doc()
+    apply_update(other, encode_state_as_update(ydoc))
+    back = ProsemirrorTransformer.from_ydoc(other, "prosemirror")
+    assert back == RICH_DOC
+
+
+def test_from_ydoc_all_fields():
+    ydoc = ProsemirrorTransformer.to_ydoc(SIMPLE_DOC, ["a", "b"])
+    result = ProsemirrorTransformer.from_ydoc(ydoc)
+    assert set(result.keys()) == {"a", "b"}
+    assert result["a"] == SIMPLE_DOC
+
+
+def test_tiptap_default_field():
+    ydoc = TiptapTransformer.to_ydoc(SIMPLE_DOC)
+    assert TiptapTransformer.from_ydoc(ydoc, "default") == SIMPLE_DOC
+
+
+def test_empty_document_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ProsemirrorTransformer.to_ydoc(None)
