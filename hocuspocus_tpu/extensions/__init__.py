@@ -1,8 +1,19 @@
 from .database import Database
 from .logger import Logger
+from .redis import Redis
 from .s3 import S3, S3Client
 from .sqlite import SQLite
 from .throttle import Throttle
 from .webhook import Events, Webhook
 
-__all__ = ["Database", "Logger", "S3", "S3Client", "SQLite", "Throttle", "Events", "Webhook"]
+__all__ = [
+    "Database",
+    "Logger",
+    "Redis",
+    "S3",
+    "S3Client",
+    "SQLite",
+    "Throttle",
+    "Events",
+    "Webhook",
+]

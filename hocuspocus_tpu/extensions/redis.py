@@ -1,0 +1,210 @@
+"""Redis fan-out extension — the horizontal-scaling backbone.
+
+Capability parity with reference `extension-redis/src/Redis.ts`:
+one pub/sub channel per document named `{prefix}:{documentName}`, frames
+prefixed `[1-byte idLen][identifier][payload]` for self-filtering, a
+distributed store lock electing a single storer (SET NX PX + compare-
+and-delete release), join protocol publishing SyncStep1 + QueryAwareness
+on document load, and delayed unsubscribe on disconnect.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Optional
+
+from ..net.resp import RedisClient, RedisSubscriber
+from ..protocol.message import IncomingMessage, OutgoingMessage
+from ..server import REDIS_ORIGIN, logger
+from ..server.message_receiver import MessageReceiver
+from ..server.types import Extension, Payload
+
+
+class LockContention(Exception):
+    """Another instance holds the store lock. Silent: halts the store
+    chain without logging an error (reference throws an empty Error)."""
+
+    def __init__(self) -> None:
+        super().__init__("")
+
+
+class Redis(Extension):
+    # Higher priority so onStoreDocument can intercept the chain before
+    # database extensions store the document.
+    priority = 1000
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        prefix: str = "hocuspocus",
+        identifier: Optional[str] = None,
+        lock_timeout: int = 1000,
+        disconnect_delay: int = 1000,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.prefix = prefix
+        self.identifier = identifier or f"host-{uuid.uuid4()}"
+        self.lock_timeout = lock_timeout
+        self.disconnect_delay = disconnect_delay
+
+        self.redis_transaction_origin = REDIS_ORIGIN
+        self.pub = RedisClient(host, port)
+        self.sub = RedisSubscriber(host, port, on_message=self._handle_incoming_message)
+        self.instance = None
+        self.locks: dict[str, str] = {}  # lock key -> token
+        self._pending_disconnects: dict[str, asyncio.TimerHandle] = {}
+        self._pending_after_store: dict[str, asyncio.TimerHandle] = {}
+        identifier_bytes = self.identifier.encode()
+        self.message_prefix = bytes([len(identifier_bytes)]) + identifier_bytes
+
+    # -- keys / framing ----------------------------------------------------
+
+    def get_key(self, document_name: str) -> str:
+        return f"{self.prefix}:{document_name}"
+
+    def lock_key(self, document_name: str) -> str:
+        return f"{self.get_key(document_name)}:lock"
+
+    def encode_message(self, message: bytes) -> bytes:
+        return self.message_prefix + message
+
+    def decode_message(self, data: bytes) -> tuple[str, bytes]:
+        identifier_length = data[0]
+        identifier = data[1 : identifier_length + 1].decode()
+        return identifier, data[identifier_length + 1 :]
+
+    # -- hooks -------------------------------------------------------------
+
+    async def on_configure(self, data: Payload) -> None:
+        self.instance = data.instance
+
+    async def after_load_document(self, data: Payload) -> None:
+        await self.sub.subscribe(self.get_key(data.document_name))
+        await self.publish_first_sync_step(data.document_name, data.document)
+        await self.request_awareness_from_other_instances(data.document_name)
+
+    async def publish_first_sync_step(self, document_name: str, document) -> None:
+        sync_message = (
+            OutgoingMessage(document_name)
+            .create_sync_message()
+            .write_first_sync_step_for(document)
+        )
+        await self.pub.publish(
+            self.get_key(document_name), self.encode_message(sync_message.to_bytes())
+        )
+
+    async def request_awareness_from_other_instances(self, document_name: str) -> None:
+        message = OutgoingMessage(document_name).write_query_awareness()
+        await self.pub.publish(
+            self.get_key(document_name), self.encode_message(message.to_bytes())
+        )
+
+    async def on_store_document(self, data: Payload) -> None:
+        """Acquire the distributed store lock; losing means another
+        instance stores — halt the chain silently."""
+        resource = self.lock_key(data.document_name)
+        token = str(uuid.uuid4())
+        acquired = await self.pub.acquire_lock(resource, token, self.lock_timeout)
+        if not acquired:
+            raise LockContention()
+        self.locks[resource] = token
+
+    async def after_store_document(self, data: Payload) -> None:
+        resource = self.lock_key(data.document_name)
+        token = self.locks.pop(resource, None)
+        if token is not None:
+            try:
+                await self.pub.release_lock(resource, token)
+            except Exception:
+                pass  # lock expires on its own
+        # Direct-connection stores need a grace period so sync messages
+        # reach the subscription before disconnect tears it down.
+        if data.socket_id == "server":
+            document_name = data.document_name
+            pending = self._pending_after_store.pop(document_name, None)
+            if pending is not None:
+                pending.cancel()
+            waiter: asyncio.Future = asyncio.get_event_loop().create_future()
+
+            def resolve() -> None:
+                self._pending_after_store.pop(document_name, None)
+                if not waiter.done():
+                    waiter.set_result(None)
+
+            self._pending_after_store[document_name] = asyncio.get_event_loop().call_later(
+                self.disconnect_delay / 1000, resolve
+            )
+            await waiter
+
+    async def on_awareness_update(self, data: Payload) -> None:
+        changed_clients = data.added + data.updated + data.removed
+        message = OutgoingMessage(data.document_name).create_awareness_update_message(
+            data.awareness, changed_clients
+        )
+        await self.pub.publish(
+            self.get_key(data.document_name), self.encode_message(message.to_bytes())
+        )
+
+    def _handle_incoming_message(self, channel: bytes, data: bytes) -> None:
+        identifier, message_data = self.decode_message(data)
+        if identifier == self.identifier:
+            return
+        message = IncomingMessage(message_data)
+        document_name = message.read_var_string()
+        message.write_var_string(document_name)
+        if self.instance is None:
+            return
+        document = self.instance.documents.get(document_name)
+        if document is None:
+            return
+
+        def reply(response: bytes) -> None:
+            asyncio.ensure_future(
+                self.pub.publish(
+                    self.get_key(document.name), self.encode_message(response)
+                )
+            )
+
+        receiver = MessageReceiver(message, self.redis_transaction_origin)
+        asyncio.ensure_future(receiver.apply(document, None, reply))
+
+    async def on_change(self, data: Payload) -> None:
+        if data.transaction_origin != self.redis_transaction_origin:
+            await self.publish_first_sync_step(data.document_name, data.document)
+
+    async def on_disconnect(self, data: Payload) -> None:
+        document_name = data.document_name
+        pending = self._pending_disconnects.pop(document_name, None)
+        if pending is not None:
+            pending.cancel()
+
+        def disconnect() -> None:
+            self._pending_disconnects.pop(document_name, None)
+            document = self.instance.documents.get(document_name) if self.instance else None
+            if document is not None and document.get_connections_count() > 0:
+                return
+            asyncio.ensure_future(self.sub.unsubscribe(self.get_key(document_name)))
+            if document is not None:
+                asyncio.ensure_future(self.instance.unload_document(document))
+
+        # Delay to allow last-minute syncs to arrive on the subscription.
+        self._pending_disconnects[document_name] = asyncio.get_event_loop().call_later(
+            self.disconnect_delay / 1000, disconnect
+        )
+
+    async def before_broadcast_stateless(self, data: Payload) -> None:
+        message = OutgoingMessage(data.document_name).write_broadcast_stateless(data.payload)
+        await self.pub.publish(
+            self.get_key(data.document_name), self.encode_message(message.to_bytes())
+        )
+
+    async def on_destroy(self, data: Payload) -> None:
+        for handle in list(self._pending_disconnects.values()):
+            handle.cancel()
+        for handle in list(self._pending_after_store.values()):
+            handle.cancel()
+        self.pub.close()
+        self.sub.close()

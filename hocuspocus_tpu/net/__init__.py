@@ -1,0 +1,3 @@
+from .resp import RedisClient, RedisSubscriber
+
+__all__ = ["RedisClient", "RedisSubscriber"]

@@ -1,0 +1,166 @@
+"""In-process mini Redis server (RESP2) for tests and single-host dev.
+
+Implements the command subset the Redis extension uses: GET/SET(NX/PX)/
+DEL/EXPIRE-via-PX, PUBLISH/SUBSCRIBE/UNSUBSCRIBE, EVAL (compare-and-del
+release script only), PING, FLUSHALL. The reference test-suite runs a
+real Redis container (`docker-compose.yml`); this keeps the two-instance
+fan-out tests self-contained in one process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from .resp import CRLF, RELEASE_LOCK_SCRIPT, read_reply
+
+
+def _bulk(data: Optional[bytes]) -> bytes:
+    if data is None:
+        return b"$-1\r\n"
+    return b"$%d\r\n%s\r\n" % (len(data), data)
+
+
+def _array(items: list[bytes]) -> bytes:
+    return b"*%d\r\n%s" % (len(items), b"".join(items))
+
+
+class MiniRedis:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self.data: dict[bytes, tuple[bytes, Optional[float]]] = {}
+        # channel -> set of writer streams
+        self.subscribers: dict[bytes, set[asyncio.StreamWriter]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "MiniRedis":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def _get(self, key: bytes) -> Optional[bytes]:
+        entry = self.data.get(key)
+        if entry is None:
+            return None
+        value, expires_at = entry
+        if expires_at is not None and time.monotonic() > expires_at:
+            del self.data[key]
+            return None
+        return value
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        subscribed: set[bytes] = set()
+        try:
+            while True:
+                try:
+                    request = await read_reply(reader)
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    break
+                if not isinstance(request, list) or not request:
+                    writer.write(b"-ERR protocol error\r\n")
+                    continue
+                command = request[0].upper()
+                args = request[1:]
+                if command == b"PING":
+                    writer.write(b"+PONG\r\n")
+                elif command == b"SET":
+                    key, value = args[0], args[1]
+                    nx = False
+                    px: Optional[int] = None
+                    i = 2
+                    while i < len(args):
+                        opt = args[i].upper()
+                        if opt == b"NX":
+                            nx = True
+                            i += 1
+                        elif opt == b"PX":
+                            px = int(args[i + 1])
+                            i += 2
+                        elif opt == b"EX":
+                            px = int(args[i + 1]) * 1000
+                            i += 2
+                        else:
+                            i += 1
+                    if nx and self._get(key) is not None:
+                        writer.write(b"$-1\r\n")
+                    else:
+                        expires = time.monotonic() + px / 1000 if px is not None else None
+                        self.data[key] = (value, expires)
+                        writer.write(b"+OK\r\n")
+                elif command == b"GET":
+                    writer.write(_bulk(self._get(args[0])))
+                elif command == b"DEL":
+                    count = 0
+                    for key in args:
+                        if self._get(key) is not None:
+                            del self.data[key]
+                            count += 1
+                    writer.write(b":%d\r\n" % count)
+                elif command == b"EVAL":
+                    script = args[0].decode()
+                    numkeys = int(args[1])
+                    keys = args[2 : 2 + numkeys]
+                    script_args = args[2 + numkeys :]
+                    if script == RELEASE_LOCK_SCRIPT:
+                        if keys and self._get(keys[0]) == (script_args[0] if script_args else None):
+                            del self.data[keys[0]]
+                            writer.write(b":1\r\n")
+                        else:
+                            writer.write(b":0\r\n")
+                    else:
+                        writer.write(b"-ERR unsupported script\r\n")
+                elif command == b"PUBLISH":
+                    channel, payload = args[0], args[1]
+                    receivers = self.subscribers.get(channel, set())
+                    message = _array([_bulk(b"message"), _bulk(channel), _bulk(payload)])
+                    delivered = 0
+                    for sub_writer in list(receivers):
+                        try:
+                            sub_writer.write(message)
+                            delivered += 1
+                        except Exception:
+                            receivers.discard(sub_writer)
+                    writer.write(b":%d\r\n" % delivered)
+                elif command == b"SUBSCRIBE":
+                    for channel in args:
+                        self.subscribers.setdefault(channel, set()).add(writer)
+                        subscribed.add(channel)
+                        writer.write(
+                            _array(
+                                [_bulk(b"subscribe"), _bulk(channel), b":%d\r\n" % len(subscribed)]
+                            )
+                        )
+                elif command == b"UNSUBSCRIBE":
+                    channels = args or list(subscribed)
+                    for channel in channels:
+                        self.subscribers.get(channel, set()).discard(writer)
+                        subscribed.discard(channel)
+                        writer.write(
+                            _array(
+                                [
+                                    _bulk(b"unsubscribe"),
+                                    _bulk(channel),
+                                    b":%d\r\n" % len(subscribed),
+                                ]
+                            )
+                        )
+                elif command == b"FLUSHALL":
+                    self.data.clear()
+                    writer.write(b"+OK\r\n")
+                elif command == b"INFO":
+                    writer.write(_bulk(b"# mini-redis\r\nredis_version:7.0.0-mini"))
+                else:
+                    writer.write(b"-ERR unknown command\r\n")
+                await writer.drain()
+        finally:
+            for channel in subscribed:
+                self.subscribers.get(channel, set()).discard(writer)
+            writer.close()

@@ -1,0 +1,213 @@
+"""Minimal asyncio Redis (RESP2) client.
+
+The runtime image has no redis driver, so the framework ships its own:
+a command client and a dedicated pub/sub subscriber connection — the two
+roles the Redis fan-out extension needs (reference `extension-redis`
+uses ioredis pub + sub clients the same way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Optional, Union
+
+CRLF = b"\r\n"
+
+RELEASE_LOCK_SCRIPT = (
+    'if redis.call("get",KEYS[1]) == ARGV[1] then return redis.call("del",KEYS[1]) '
+    "else return 0 end"
+)
+
+
+def encode_command(*args: Union[bytes, str, int, float]) -> bytes:
+    out = bytearray(b"*%d\r\n" % len(args))
+    for arg in args:
+        if isinstance(arg, (int, float)):
+            arg = str(arg)
+        if isinstance(arg, str):
+            arg = arg.encode()
+        out += b"$%d\r\n" % len(arg)
+        out += arg
+        out += CRLF
+    return bytes(out)
+
+
+class RespError(Exception):
+    pass
+
+
+async def read_reply(reader: asyncio.StreamReader) -> Any:
+    line = await reader.readline()
+    if not line:
+        raise ConnectionError("redis connection closed")
+    kind, rest = line[:1], line[1:-2]
+    if kind == b"+":
+        return rest.decode()
+    if kind == b"-":
+        raise RespError(rest.decode())
+    if kind == b":":
+        return int(rest)
+    if kind == b"$":
+        length = int(rest)
+        if length == -1:
+            return None
+        data = await reader.readexactly(length + 2)
+        return data[:-2]
+    if kind == b"*":
+        count = int(rest)
+        if count == -1:
+            return None
+        return [await read_reply(reader) for _ in range(count)]
+    raise RespError(f"unexpected RESP reply type {kind!r}")
+
+
+class RedisClient:
+    """Request/response command client over one connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379) -> None:
+        self.host = host
+        self.port = port
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> "RedisClient":
+        self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
+        return self
+
+    @property
+    def connected(self) -> bool:
+        return self.writer is not None and not self.writer.is_closing()
+
+    async def execute(self, *args: Union[bytes, str, int, float]) -> Any:
+        if not self.connected:
+            await self.connect()
+        async with self._lock:
+            assert self.writer is not None and self.reader is not None
+            self.writer.write(encode_command(*args))
+            await self.writer.drain()
+            return await read_reply(self.reader)
+
+    # convenience commands -------------------------------------------------
+
+    async def ping(self) -> bool:
+        return await self.execute("PING") == "PONG"
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return await self.execute("GET", key)
+
+    async def set(
+        self,
+        key: str,
+        value: Union[bytes, str],
+        nx: bool = False,
+        px: Optional[int] = None,
+    ) -> Optional[str]:
+        args: list = ["SET", key, value]
+        if px is not None:
+            args += ["PX", px]
+        if nx:
+            args.append("NX")
+        return await self.execute(*args)
+
+    async def delete(self, *keys: str) -> int:
+        return await self.execute("DEL", *keys)
+
+    async def publish(self, channel: str, data: Union[bytes, str]) -> int:
+        return await self.execute("PUBLISH", channel, data)
+
+    async def eval(self, script: str, keys: list[str], args: list) -> Any:
+        return await self.execute("EVAL", script, len(keys), *keys, *args)
+
+    async def flushall(self) -> None:
+        await self.execute("FLUSHALL")
+
+    async def acquire_lock(self, key: str, token: str, ttl_ms: int) -> bool:
+        return await self.set(key, token, nx=True, px=ttl_ms) == "OK"
+
+    async def release_lock(self, key: str, token: str) -> bool:
+        return bool(await self.eval(RELEASE_LOCK_SCRIPT, [key], [token]))
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+            self.reader = None
+
+
+class RedisSubscriber:
+    """Dedicated pub/sub connection; delivers messages to a callback."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        on_message: Optional[Callable[[bytes, bytes], None]] = None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.on_message = on_message
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._subscribed: dict[bytes, asyncio.Future] = {}
+        self.channels: set[bytes] = set()
+
+    async def connect(self) -> "RedisSubscriber":
+        self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    @property
+    def connected(self) -> bool:
+        return self.writer is not None and not self.writer.is_closing()
+
+    async def _read_loop(self) -> None:
+        assert self.reader is not None
+        try:
+            while True:
+                reply = await read_reply(self.reader)
+                if not isinstance(reply, list) or not reply:
+                    continue
+                kind = reply[0]
+                if kind == b"message":
+                    _, channel, payload = reply
+                    if self.on_message is not None:
+                        self.on_message(channel, payload)
+                elif kind in (b"subscribe", b"unsubscribe"):
+                    _, channel, _count = reply
+                    waiter = self._subscribed.pop(channel, None)
+                    if waiter is not None and not waiter.done():
+                        waiter.set_result(True)
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.CancelledError):
+            pass
+
+    async def _send(self, *args: Union[bytes, str]) -> None:
+        if not self.connected:
+            await self.connect()
+        assert self.writer is not None
+        self.writer.write(encode_command(*args))
+        await self.writer.drain()
+
+    async def subscribe(self, channel: str) -> None:
+        key = channel.encode()
+        waiter: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._subscribed[key] = waiter
+        await self._send("SUBSCRIBE", channel)
+        await asyncio.wait_for(waiter, 10)
+        self.channels.add(key)
+
+    async def unsubscribe(self, channel: str) -> None:
+        key = channel.encode()
+        self.channels.discard(key)
+        if self.connected:
+            await self._send("UNSUBSCRIBE", channel)
+
+    def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+            self.reader = None
