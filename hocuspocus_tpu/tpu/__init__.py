@@ -1,0 +1,3 @@
+from .merge_plane import MergePlane, TpuMergeExtension
+
+__all__ = ["MergePlane", "TpuMergeExtension"]

@@ -1,0 +1,262 @@
+"""Batched text-CRDT integration kernels (JAX, TPU-first).
+
+This is the compute core of the TPU merge plane (BASELINE.md north star):
+the per-connection integrate loop of the reference server
+(`packages/server/src/MessageReceiver.ts` readUpdate → yjs integrate)
+reformulated as a dense, data-parallel kernel over thousands of
+documents.
+
+Representation (per document, fixed capacity N — "arena"):
+  APPEND-ONLY storage + RANK ordering. Units are stored in arrival
+  order (slot = arrival index) and never move; the document order is a
+  dense `rank` array. Inserting at logical rank r is then a pure
+  elementwise bump (`rank += run where rank >= r`) instead of a
+  physical shift — no gathers or scatters anywhere in the hot path,
+  which is what lets XLA lower each op to vectorized compares,
+  selects and reductions on the VPU. (A physically-ordered variant
+  needs a batched dynamic gather per op, which serializes on TPU.)
+
+  id_client/id_clock     — the unit's Yjs id (client ids are uint32)
+  origin_client/clock    — YATA left origin id (NONE_CLIENT = doc start)
+  rank                   — current logical position (0..length-1)
+  origin_rank            — current RANK of the left origin, maintained
+                           incrementally so conflict resolution never
+                           searches
+  chars                  — UTF-16 code unit
+  deleted                — tombstone flag
+  length                 — number of occupied arena slots
+  overflow               — capacity exceeded; host falls back to CPU
+
+The YATA conflict rule (Yjs Item.integrate: same-origin siblings ordered
+by ascending client id, nested subtrees skipped transitively) becomes a
+masked reduction over the (leftOrigin, rightOrigin) rank window:
+  skip c while origin_rank[c] > L or (origin_rank[c] == L and client[c] < op.client)
+
+Ops are (kind, client, clock, run_len, left id, right id, chars[RUN]):
+  kind 0 = noop, 1 = insert run, 2 = delete id-range.
+Deletes are pure id-range compares — no position work at all.
+
+Everything is static-shape, vmap-batched over the doc axis and
+lax.scan-ed over op slots; the doc axis shards over a device mesh
+(see sharding.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MAX_RUN = 16  # max UTF-16 units per op run; longer runs are split host-side
+NONE_CLIENT = 0xFFFFFFFF  # "no origin" sentinel (client ids are uint32)
+_INF = jnp.int32(0x7FFFFFFF)
+
+KIND_NOOP = 0
+KIND_INSERT = 1
+KIND_DELETE = 2
+
+
+class DocState(NamedTuple):
+    """Dense arena for a batch of documents. Leading axis = doc."""
+
+    id_client: jax.Array  # (D, N) uint32
+    id_clock: jax.Array  # (D, N) int32
+    origin_client: jax.Array  # (D, N) uint32
+    origin_clock: jax.Array  # (D, N) int32
+    rank: jax.Array  # (D, N) int32 — logical position
+    origin_rank: jax.Array  # (D, N) int32 — rank of left origin (-1 = start)
+    chars: jax.Array  # (D, N) int32 UTF-16 code units
+    deleted: jax.Array  # (D, N) bool
+    length: jax.Array  # (D,) int32 — occupied slots
+    overflow: jax.Array  # (D,) bool
+
+
+class OpBatch(NamedTuple):
+    """One op slot per document. Leading axis = doc (or (K, D) under scan)."""
+
+    kind: jax.Array  # int32
+    client: jax.Array  # uint32
+    clock: jax.Array  # int32
+    run_len: jax.Array  # int32
+    left_client: jax.Array  # uint32 (NONE_CLIENT = doc start)
+    left_clock: jax.Array  # int32
+    right_client: jax.Array  # uint32 (NONE_CLIENT = doc end)
+    right_clock: jax.Array  # int32
+    chars: jax.Array  # (.., MAX_RUN) int32
+
+
+def make_empty_state(num_docs: int, capacity: int) -> DocState:
+    shape = (num_docs, capacity)
+    # distinct buffers per field: integrate steps donate their input
+    # state and XLA rejects donating one buffer twice
+    return DocState(
+        id_client=jnp.full(shape, NONE_CLIENT, jnp.uint32),
+        id_clock=jnp.zeros(shape, jnp.int32),
+        origin_client=jnp.full(shape, NONE_CLIENT, jnp.uint32),
+        origin_clock=jnp.zeros(shape, jnp.int32),
+        rank=jnp.full(shape, _INF, jnp.int32),
+        origin_rank=jnp.full(shape, -1, jnp.int32),
+        chars=jnp.zeros(shape, jnp.int32),
+        deleted=jnp.zeros(shape, bool),
+        length=jnp.zeros((num_docs,), jnp.int32),
+        overflow=jnp.zeros((num_docs,), bool),
+    )
+
+
+def make_noop_batch(num_docs: int) -> OpBatch:
+    zeros = jnp.zeros((num_docs,), jnp.int32)
+    return OpBatch(
+        kind=zeros,
+        client=jnp.zeros((num_docs,), jnp.uint32),
+        clock=zeros,
+        run_len=zeros,
+        left_client=jnp.full((num_docs,), NONE_CLIENT, jnp.uint32),
+        left_clock=zeros,
+        right_client=jnp.full((num_docs,), NONE_CLIENT, jnp.uint32),
+        right_clock=zeros,
+        chars=jnp.zeros((num_docs, MAX_RUN), jnp.int32),
+    )
+
+
+def _integrate_one(state: DocState, op: OpBatch) -> DocState:
+    """Integrate a single op into a single document (unbatched).
+
+    Elementwise compares/selects + reductions only — no gathers.
+    """
+    n = state.id_client.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    occupied = idx < state.length
+
+    # -- resolve origin ids to ranks (masked reductions) -------------------
+    is_left = occupied & (state.id_client == op.left_client) & (state.id_clock == op.left_clock)
+    has_left = op.left_client != jnp.uint32(NONE_CLIENT)
+    left_found = jnp.any(is_left)
+    left_rank = jnp.where(has_left, jnp.max(jnp.where(is_left, state.rank, -1)), -1)
+
+    is_right = occupied & (state.id_client == op.right_client) & (state.id_clock == op.right_clock)
+    has_right = op.right_client != jnp.uint32(NONE_CLIENT)
+    right_found = jnp.any(is_right)
+    right_rank = jnp.where(has_right, jnp.max(jnp.where(is_right, state.rank, -1)), state.length)
+
+    # -- YATA conflict scan over the (left, right) rank window -------------
+    in_window = occupied & (state.rank > left_rank) & (state.rank < right_rank)
+    skip_cond = (state.origin_rank > left_rank) | (
+        (state.origin_rank == left_rank) & (state.id_client < op.client)
+    )
+    blocked = in_window & ~skip_cond
+    first_block_rank = jnp.min(jnp.where(blocked, state.rank, _INF))
+    skipped = jnp.sum((in_window & (state.rank < first_block_rank)).astype(jnp.int32))
+    ins_rank = left_rank + 1 + skipped
+
+    run = op.run_len
+    fits = state.length + run <= n
+    deps_ok = (~has_left | left_found) & (~has_right | right_found)
+    do_insert = (op.kind == KIND_INSERT) & fits & deps_ok
+
+    # -- elementwise insert ------------------------------------------------
+    # bump ranks at/after the insertion rank; append units to free slots
+    bump = do_insert & occupied
+    rank_bumped = jnp.where(bump & (state.rank >= ins_rank), state.rank + run, state.rank)
+    origin_rank_bumped = jnp.where(
+        bump & (state.origin_rank >= ins_rank), state.origin_rank + run, state.origin_rank
+    )
+    slot_off = idx - state.length  # 0..run-1 for the new slots
+    in_new = do_insert & (slot_off >= 0) & (slot_off < run)
+    off = jnp.clip(slot_off, 0, MAX_RUN - 1)
+    # chars lookup as a broadcast compare+sum: dynamic gathers (even from
+    # a 16-entry table) lower to serialized code on TPU; this stays on
+    # the VPU as selects/reductions
+    run_lane = jnp.arange(MAX_RUN, dtype=jnp.int32)
+    new_chars = jnp.sum(
+        jnp.where(off[:, None] == run_lane[None, :], op.chars[None, :], 0), axis=1
+    )
+    is_first = slot_off == 0
+
+    id_client = jnp.where(in_new, op.client, state.id_client)
+    id_clock = jnp.where(in_new, op.clock + slot_off, state.id_clock)
+    origin_client = jnp.where(
+        in_new, jnp.where(is_first, op.left_client, op.client), state.origin_client
+    )
+    origin_clock = jnp.where(
+        in_new, jnp.where(is_first, op.left_clock, op.clock + slot_off - 1), state.origin_clock
+    )
+    rank = jnp.where(in_new, ins_rank + slot_off, rank_bumped)
+    origin_rank = jnp.where(
+        in_new, jnp.where(is_first, left_rank, ins_rank + slot_off - 1), origin_rank_bumped
+    )
+    chars = jnp.where(in_new, new_chars, state.chars)
+    deleted_after_insert = jnp.where(in_new, False, state.deleted)
+
+    # -- delete: id-range tombstones ---------------------------------------
+    do_delete = op.kind == KIND_DELETE
+    in_del_range = (
+        do_delete
+        & occupied
+        & (state.id_client == op.client)
+        & (state.id_clock >= op.clock)
+        & (state.id_clock < op.clock + op.run_len)
+    )
+
+    return DocState(
+        id_client=id_client,
+        id_clock=id_clock,
+        origin_client=origin_client,
+        origin_clock=origin_clock,
+        rank=rank,
+        origin_rank=origin_rank,
+        chars=chars,
+        deleted=deleted_after_insert | in_del_range,
+        length=jnp.where(do_insert, state.length + run, state.length),
+        overflow=state.overflow | ((op.kind == KIND_INSERT) & ~fits),
+    )
+
+
+# Batched over documents.
+_integrate_batch = jax.vmap(_integrate_one)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def integrate_ops(state: DocState, ops: OpBatch) -> DocState:
+    """Integrate one op per document (noop slots pass through)."""
+    return _integrate_batch(state, ops)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def integrate_op_slots(state: DocState, ops: OpBatch) -> tuple[DocState, jax.Array]:
+    """Integrate K op slots per document: ops fields have shape (K, D, ...).
+
+    Returns the new state and the number of integrated (non-noop) ops.
+    """
+
+    def step(carry: DocState, op_slice: OpBatch):
+        return _integrate_batch(carry, op_slice), jnp.sum(op_slice.kind != KIND_NOOP)
+
+    state, counts = jax.lax.scan(step, state, ops)
+    return state, jnp.sum(counts)
+
+
+@jax.jit
+def extract_live_mask(state: DocState) -> jax.Array:
+    """(D, N) bool — live (non-tombstone) units, for host-side decoding."""
+    n = state.id_client.shape[1]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return (idx[None, :] < state.length[:, None]) & ~state.deleted
+
+
+@jax.jit
+def state_vector_diff(
+    doc_clocks: jax.Array, client_clocks: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Batched catch-up computation (BASELINE config 5: catch-up storm).
+
+    doc_clocks:    (D, C) server-side clock per (doc, client-id slot)
+    client_clocks: (D, C) requesting client's known clock per slot
+    Returns (missing_from, missing_len): per (doc, client) the clock
+    range the client is missing — the device-side equivalent of
+    state-vector diff in encode_state_as_update(doc, sv).
+    """
+    missing_from = jnp.minimum(client_clocks, doc_clocks)
+    missing_len = jnp.maximum(doc_clocks - client_clocks, 0)
+    return missing_from, missing_len

@@ -1,0 +1,149 @@
+"""Host-side lowering: Yjs binary updates → dense device ops.
+
+Decodes update structs (same codec as the CPU path) and emits
+causally-ordered (insert-run / delete-range) ops for the TPU arena
+kernels. Documents whose updates contain content the dense text arena
+cannot represent (maps, arrays, formats, embeds, GC'd ranges) are
+flagged unsupported — the CPU path stays authoritative for them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..crdt.content import ContentDeleted, ContentString
+from ..crdt.delete_set import DeleteSet
+from ..crdt.encoding import Decoder
+from ..crdt.ids import ID
+from ..crdt.structs import GC, Item, Skip
+from ..crdt.update import _read_client_struct_refs
+from .kernels import KIND_DELETE, KIND_INSERT, MAX_RUN, NONE_CLIENT
+
+
+@dataclass
+class DenseOp:
+    kind: int
+    client: int
+    clock: int
+    run_len: int
+    left_client: int = NONE_CLIENT
+    left_clock: int = 0
+    right_client: int = NONE_CLIENT
+    right_clock: int = 0
+    chars: tuple = ()
+
+
+@dataclass
+class DocLowerer:
+    """Per-document lowering state: known clocks + pending ops."""
+
+    known: dict[int, int] = field(default_factory=dict)  # client -> next clock
+    pending: list = field(default_factory=list)  # decoded structs waiting on deps
+    pending_deletes: list = field(default_factory=list)  # (client, clock, len)
+    unsupported: bool = False
+
+    def _id_known(self, ref: Optional[ID]) -> bool:
+        if ref is None:
+            return True
+        return ref.clock < self.known.get(ref.client, 0)
+
+    def _struct_ready(self, struct: Item) -> bool:
+        client, clock = struct.id
+        if clock > self.known.get(client, 0):
+            return False  # gap from same client
+        return self._id_known(struct.origin) and self._id_known(struct.right_origin)
+
+    def _emit_struct(self, struct: Item, out: list[DenseOp]) -> None:
+        client, clock = struct.id
+        content = struct.content
+        if clock < self.known.get(client, 0):
+            return  # duplicate
+        if isinstance(content, ContentString):
+            units = _utf16_units(content.s)
+        elif isinstance(content, ContentDeleted):
+            units = [0] * content.length
+        else:
+            self.unsupported = True
+            return
+        left_client = struct.origin.client if struct.origin is not None else NONE_CLIENT
+        left_clock = struct.origin.clock if struct.origin is not None else 0
+        right_client = struct.right_origin.client if struct.right_origin is not None else NONE_CLIENT
+        right_clock = struct.right_origin.clock if struct.right_origin is not None else 0
+        offset = 0
+        while offset < len(units):
+            piece = units[offset : offset + MAX_RUN]
+            out.append(
+                DenseOp(
+                    kind=KIND_INSERT,
+                    client=client,
+                    clock=clock + offset,
+                    run_len=len(piece),
+                    left_client=left_client if offset == 0 else client,
+                    left_clock=left_clock if offset == 0 else clock + offset - 1,
+                    right_client=right_client,
+                    right_clock=right_clock,
+                    chars=tuple(piece),
+                )
+            )
+            offset += len(piece)
+        if isinstance(content, ContentDeleted):
+            out.append(
+                DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=len(units))
+            )
+        self.known[client] = clock + len(units)
+
+    def lower_update(self, update: bytes) -> list[DenseOp]:
+        """Decode one update and emit every op that is causally ready."""
+        decoder = Decoder(update)
+        refs = _read_client_struct_refs(decoder)
+        ds = DeleteSet.read(decoder)
+        for entry in refs.values():
+            for struct in entry["refs"]:
+                if isinstance(struct, Skip):
+                    self.unsupported = True
+                elif isinstance(struct, GC):
+                    # GC structs lose origin info — cannot be re-placed.
+                    self.unsupported = True
+                else:
+                    self.pending.append(struct)
+        for client, clock, length in ds.iterate():
+            self.pending_deletes.append((client, clock, length))
+        if self.unsupported:
+            return []
+        return self._drain()
+
+    def _drain(self) -> list[DenseOp]:
+        out: list[DenseOp] = []
+        progress = True
+        while progress:
+            progress = False
+            remaining = []
+            for struct in self.pending:
+                if self._struct_ready(struct):
+                    self._emit_struct(struct, out)
+                    progress = True
+                else:
+                    remaining.append(struct)
+            self.pending = remaining
+            if self.unsupported:
+                return []
+        # deletes apply once their target range is known
+        remaining_deletes = []
+        for client, clock, length in self.pending_deletes:
+            if clock + length <= self.known.get(client, 0):
+                out.append(DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=length))
+            else:
+                remaining_deletes.append((client, clock, length))
+        self.pending_deletes = remaining_deletes
+        return out
+
+
+def _utf16_units(s: str) -> list[int]:
+    data = s.encode("utf-16-le", errors="replace")
+    return [int.from_bytes(data[i : i + 2], "little") for i in range(0, len(data), 2)]
+
+
+def units_to_text(units) -> str:
+    data = b"".join(int(u).to_bytes(2, "little") for u in units)
+    return data.decode("utf-16-le", errors="replace")

@@ -1,0 +1,262 @@
+"""The TPU merge plane: cross-document update queue + batched integrate.
+
+Replaces the reference's per-connection apply loop (SURVEY.md §3.3 hot
+loop) with a micro-batched device step: updates from ALL documents are
+lowered to dense ops, padded into (K slots, D docs) tensors, and
+integrated by one jitted kernel call. Exposed as `TpuMergeExtension`
+hooking the same onChange boundary the reference's extensions use, with
+the CPU document remaining the authoritative fallback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import numpy as np
+
+from ..server.types import Extension, Payload
+from .kernels import (
+    DocState,
+    MAX_RUN,
+    NONE_CLIENT,
+    OpBatch,
+    extract_live_mask,
+    integrate_op_slots,
+    make_empty_state,
+)
+from .lowering import DenseOp, DocLowerer, units_to_text
+
+
+class MergePlane:
+    """Device-resident arenas for up to `num_docs` documents."""
+
+    def __init__(self, num_docs: int = 256, capacity: int = 4096, max_slots_per_flush: int = 16) -> None:
+        self.num_docs = num_docs
+        self.capacity = capacity
+        self.max_slots_per_flush = max_slots_per_flush
+        self.state: DocState = make_empty_state(num_docs, capacity)
+        self.slots: dict[str, int] = {}
+        self.free: list[int] = list(range(num_docs - 1, -1, -1))
+        self.lowerers: dict[int, DocLowerer] = {}
+        self.queues: dict[int, list[DenseOp]] = {}
+        self.total_integrated = 0
+
+    # -- registry ----------------------------------------------------------
+
+    def register(self, name: str) -> Optional[int]:
+        if name in self.slots:
+            return self.slots[name]
+        if not self.free:
+            return None
+        slot = self.free.pop()
+        self.slots[name] = slot
+        self.lowerers[slot] = DocLowerer()
+        self.queues[slot] = []
+        return slot
+
+    def release(self, name: str) -> None:
+        slot = self.slots.pop(name, None)
+        if slot is None:
+            return
+        self.lowerers.pop(slot, None)
+        self.queues.pop(slot, None)
+        self._clear_slot(slot)
+        self.free.append(slot)
+
+    def _clear_slot(self, slot: int) -> None:
+        empty = make_empty_state(1, self.capacity)
+        self.state = DocState(
+            *(
+                field.at[slot].set(empty_field[0])
+                for field, empty_field in zip(self.state, empty)
+            )
+        )
+
+    def is_supported(self, name: str) -> bool:
+        slot = self.slots.get(name)
+        if slot is None:
+            return False
+        return not self.lowerers[slot].unsupported
+
+    # -- queueing ----------------------------------------------------------
+
+    def enqueue_update(self, name: str, update: bytes) -> None:
+        slot = self.slots.get(name)
+        if slot is None:
+            slot = self.register(name)
+            if slot is None:
+                return
+        lowerer = self.lowerers[slot]
+        if lowerer.unsupported:
+            return
+        self.queues[slot].extend(lowerer.lower_update(update))
+
+    def pending_ops(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    # -- device step -------------------------------------------------------
+
+    def flush(self) -> int:
+        """Integrate queued ops in (K, D) batches. Returns ops integrated."""
+        total = 0
+        while self.pending_ops() > 0:
+            needed = min(
+                max(len(q) for q in self.queues.values()),
+                self.max_slots_per_flush,
+            )
+            # round K up to a power of two to bound jit recompilations
+            k = 1
+            while k < needed:
+                k *= 2
+            ops = self._build_batch(k)
+            self.state, count = integrate_op_slots(self.state, ops)
+            total += int(count)
+        self.total_integrated += total
+        return total
+
+    def _build_batch(self, k: int) -> OpBatch:
+        d = self.num_docs
+        kind = np.zeros((k, d), np.int32)
+        client = np.zeros((k, d), np.uint32)
+        clock = np.zeros((k, d), np.int32)
+        run_len = np.zeros((k, d), np.int32)
+        left_client = np.full((k, d), NONE_CLIENT, np.uint32)
+        left_clock = np.zeros((k, d), np.int32)
+        right_client = np.full((k, d), NONE_CLIENT, np.uint32)
+        right_clock = np.zeros((k, d), np.int32)
+        chars = np.zeros((k, d, MAX_RUN), np.int32)
+        for slot, queue in self.queues.items():
+            take = queue[:k]
+            del queue[:k]
+            for i, op in enumerate(take):
+                kind[i, slot] = op.kind
+                client[i, slot] = op.client
+                clock[i, slot] = op.clock
+                run_len[i, slot] = op.run_len
+                left_client[i, slot] = op.left_client
+                left_clock[i, slot] = op.left_clock
+                right_client[i, slot] = op.right_client
+                right_clock[i, slot] = op.right_clock
+                for j, ch in enumerate(op.chars[:MAX_RUN]):
+                    chars[i, slot, j] = ch
+        import jax.numpy as jnp
+
+        return OpBatch(
+            kind=jnp.asarray(kind),
+            client=jnp.asarray(client),
+            clock=jnp.asarray(clock),
+            run_len=jnp.asarray(run_len),
+            left_client=jnp.asarray(left_client),
+            left_clock=jnp.asarray(left_clock),
+            right_client=jnp.asarray(right_client),
+            right_clock=jnp.asarray(right_clock),
+            chars=jnp.asarray(chars),
+        )
+
+    # -- extraction --------------------------------------------------------
+
+    def text(self, name: str) -> Optional[str]:
+        """Decode a document's live text from device state.
+
+        Surrogate-pair handling mirrors Yjs splice semantics: Yjs
+        replaces both halves with U+FFFD whenever an item split lands
+        inside a pair. The arena never splits (deletes are id-range
+        tombstones), so a pair decodes as a real character only when its
+        two units are id-consecutive from one client AND rank-adjacent
+        (no tombstones between) — every split scenario breaks one of
+        those, yielding the same U+FFFD output as the CPU path.
+        """
+        slot = self.slots.get(name)
+        if slot is None:
+            return None
+        overflow = bool(np.asarray(self.state.overflow)[slot])
+        if overflow:
+            return None
+        live = np.asarray(extract_live_mask(self.state))[slot]
+        occupied = np.nonzero(live)[0]
+        ranks_all = np.asarray(self.state.rank)[slot][occupied]
+        order = np.argsort(ranks_all)
+        sel = occupied[order]
+        ranks = ranks_all[order]
+        chars = np.asarray(self.state.chars)[slot][sel]
+        clients = np.asarray(self.state.id_client)[slot][sel]
+        clocks = np.asarray(self.state.id_clock)[slot][sel]
+        out: list[int] = []
+        i = 0
+        count = len(chars)
+        while i < count:
+            c = int(chars[i])
+            if 0xD800 <= c <= 0xDBFF:
+                if (
+                    i + 1 < count
+                    and 0xDC00 <= int(chars[i + 1]) <= 0xDFFF
+                    and clients[i + 1] == clients[i]
+                    and clocks[i + 1] == clocks[i] + 1
+                    and ranks[i + 1] == ranks[i] + 1
+                ):
+                    out.append(c)
+                    out.append(int(chars[i + 1]))
+                    i += 2
+                    continue
+                out.append(0xFFFD)
+            elif 0xDC00 <= c <= 0xDFFF:
+                out.append(0xFFFD)
+            else:
+                out.append(c)
+            i += 1
+        return units_to_text(out)
+
+
+class TpuMergeExtension(Extension):
+    """Mirrors live documents onto the TPU merge plane via onChange.
+
+    The CPU document stays authoritative for serving in this round; the
+    plane shadows every supported text document and is the substrate for
+    batched merge serving (bench.py drives it directly).
+    """
+
+    priority = 900
+
+    def __init__(
+        self,
+        num_docs: int = 256,
+        capacity: int = 4096,
+        flush_interval_ms: float = 5.0,
+        plane: Optional[MergePlane] = None,
+    ) -> None:
+        self.plane = plane or MergePlane(num_docs=num_docs, capacity=capacity)
+        self.flush_interval_ms = flush_interval_ms
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+
+    async def after_load_document(self, data: Payload) -> None:
+        from ..crdt import encode_state_as_update
+
+        self.plane.register(data.document_name)
+        snapshot = encode_state_as_update(data.document)
+        self.plane.enqueue_update(data.document_name, snapshot)
+        self._schedule_flush()
+
+    async def on_change(self, data: Payload) -> None:
+        self.plane.enqueue_update(data.document_name, data.update)
+        self._schedule_flush()
+
+    async def after_unload_document(self, data: Payload) -> None:
+        self.plane.release(data.document_name)
+
+    async def on_destroy(self, data: Payload) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+        self.plane.flush()
+
+    def _schedule_flush(self) -> None:
+        if self._flush_handle is not None:
+            return
+
+        def run() -> None:
+            self._flush_handle = None
+            self.plane.flush()
+
+        self._flush_handle = asyncio.get_event_loop().call_later(
+            self.flush_interval_ms / 1000, run
+        )

@@ -1,0 +1,89 @@
+"""Multi-chip sharding for the merge plane (jax.sharding + jit).
+
+The doc axis is the data-parallel dimension (SURVEY.md §5.7: documents
+are the scaling dimension); the arena (unit) axis is the
+sequence-parallel dimension. Shardings are annotated and XLA inserts the
+collectives (all-gathers for cross-shard gathers, all-reduce for the
+global op count) — the ICI-riding equivalent of the reference's
+Redis fan-out data plane.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .kernels import DocState, OpBatch, integrate_op_slots, make_empty_state
+
+
+def make_mesh(devices: Optional[list] = None, doc_axis: Optional[int] = None) -> Mesh:
+    """1D or 2D mesh over (doc, unit). Defaults to all devices on doc."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if doc_axis is None:
+        doc_axis = n
+    unit_axis = n // doc_axis
+    device_array = np.asarray(devices).reshape(doc_axis, unit_axis)
+    return Mesh(device_array, ("doc", "unit"))
+
+
+def state_sharding(mesh: Mesh) -> DocState:
+    """NamedShardings for each DocState field."""
+    arena = NamedSharding(mesh, P("doc", "unit"))
+    per_doc = NamedSharding(mesh, P("doc"))
+    return DocState(
+        id_client=arena,
+        id_clock=arena,
+        origin_client=arena,
+        origin_clock=arena,
+        rank=arena,
+        origin_rank=arena,
+        chars=arena,
+        deleted=arena,
+        length=per_doc,
+        overflow=per_doc,
+    )
+
+
+def ops_sharding(mesh: Mesh) -> OpBatch:
+    slot_doc = NamedSharding(mesh, P(None, "doc"))
+    return OpBatch(
+        kind=slot_doc,
+        client=slot_doc,
+        clock=slot_doc,
+        run_len=slot_doc,
+        left_client=slot_doc,
+        left_clock=slot_doc,
+        right_client=slot_doc,
+        right_clock=slot_doc,
+        chars=NamedSharding(mesh, P(None, "doc", None)),
+    )
+
+
+def make_sharded_step(mesh: Mesh):
+    """Jitted multi-chip integrate step with explicit in/out shardings.
+
+    The returned callable takes (DocState, OpBatch with (K, D, ...) op
+    slots) and returns (DocState, integrated-op count). The op count is
+    a global reduction — XLA lowers it to an all-reduce over the mesh.
+    """
+    st_shard = state_sharding(mesh)
+    op_shard = ops_sharding(mesh)
+    count_sharding = NamedSharding(mesh, P())
+    return jax.jit(
+        integrate_op_slots.__wrapped__,  # re-jit with shardings
+        in_shardings=(st_shard, op_shard),
+        out_shardings=(st_shard, count_sharding),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_state(mesh: Mesh, num_docs: int, capacity: int) -> DocState:
+    state = make_empty_state(num_docs, capacity)
+    shardings = state_sharding(mesh)
+    return DocState(
+        *(jax.device_put(field, sharding) for field, sharding in zip(state, shardings))
+    )

@@ -8,12 +8,18 @@ jax import, hence here at conftest import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin registers itself regardless of JAX_PLATFORMS; the
+# config route reliably pins the test backend to the virtual CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio
 import inspect
