@@ -7,6 +7,7 @@ varUint clientID, varUint clock, varString JSON state ("null" = removed).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import Any, Iterable, Optional
@@ -19,16 +20,44 @@ OUTDATED_TIMEOUT = 30.0  # seconds
 
 
 class Awareness(Observable):
-    def __init__(self, doc: Doc) -> None:
+    def __init__(self, doc: Doc, outdated_timeout: float = OUTDATED_TIMEOUT) -> None:
         super().__init__()
         self.doc = doc
         self.client_id = doc.client_id
         self.states: dict[int, dict] = {}
         # client -> {"clock": int, "last_updated": float}
         self.meta: dict[int, dict] = {}
+        self.outdated_timeout = outdated_timeout
+        self._check_task: Optional[asyncio.Task] = None
         self.set_local_state({})
+        # Periodic keepalive: renew the local state (generating awareness
+        # traffic that keeps idle connections alive past the reconnect
+        # timeout) and prune outdated remote clients — the y-protocols
+        # Awareness check interval. Only when a loop is running.
+        try:
+            loop = asyncio.get_running_loop()
+            self._check_task = loop.create_task(self._check_loop())
+        except RuntimeError:
+            pass
+
+    async def _check_loop(self) -> None:
+        interval = self.outdated_timeout / 10
+        while True:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            local_meta = self.meta.get(self.client_id)
+            if (
+                self.get_local_state() is not None
+                and local_meta is not None
+                and self.outdated_timeout / 2 <= now - local_meta["last_updated"]
+            ):
+                self.set_local_state(self.get_local_state())
+            remove_outdated(self, self.outdated_timeout)
 
     def destroy(self) -> None:
+        if self._check_task is not None:
+            self._check_task.cancel()
+            self._check_task = None
         self.emit("destroy", self)
         self.set_local_state(None)
         self._observers = {}

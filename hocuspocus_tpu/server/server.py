@@ -72,6 +72,7 @@ class Server:
         self.port: Optional[int] = None
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
+        self._transports: set = set()
 
     @property
     def configuration(self) -> Configuration:
@@ -111,7 +112,7 @@ class Server:
         await self.hocuspocus.ensure_configured()
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self._handle_request)
-        self._runner = web.AppRunner(app, access_log=None)
+        self._runner = web.AppRunner(app, access_log=None, shutdown_timeout=2)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, host, port)
         await self._site.start()
@@ -150,6 +151,10 @@ class Server:
             if self.hocuspocus.get_documents_count() == 0:
                 break
             await asyncio.sleep(0.01)
+        # actively close remaining sockets so the HTTP runner can stop
+        for transport in list(self._transports):
+            transport.close(4205, "Reset Connection")
+        await asyncio.sleep(0)
         try:
             await self.hocuspocus.hooks("on_destroy", Payload(instance=self.hocuspocus))
         finally:
@@ -195,6 +200,7 @@ class Server:
         ws = web.WebSocketResponse(heartbeat=heartbeat, autoping=True, max_msg_size=0)
         await ws.prepare(request)
         transport = AiohttpWebSocketTransport(ws)
+        self._transports.add(transport)
         client_connection = self.hocuspocus.handle_connection(transport, request_info, context)
         close_code = 1000
         close_reason = ""
@@ -208,6 +214,7 @@ class Server:
             logger.log_error(f"websocket error: {error!r}")
         finally:
             close_code = ws.close_code or 1000
+            self._transports.discard(transport)
             transport.abort()
             await client_connection.handle_transport_close(close_code, close_reason)
         return ws

@@ -1,0 +1,172 @@
+"""Provider behavior: multiplexing, reconnect, readonly, force sync."""
+
+import asyncio
+
+import pytest
+
+from hocuspocus_tpu.provider import HocuspocusProvider, HocuspocusProviderWebsocket
+from tests.utils import (
+    new_hocuspocus,
+    new_provider,
+    new_provider_websocket,
+    retryable_assertion,
+    wait_for,
+    wait_synced,
+)
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_two_documents_multiplexed_on_one_socket():
+    server = await new_hocuspocus()
+    socket = new_provider_websocket(server)
+    provider_a = HocuspocusProvider(name="doc-a", websocket_provider=socket)
+    provider_a.attach()
+    provider_b = HocuspocusProvider(name="doc-b", websocket_provider=socket)
+    provider_b.attach()
+    try:
+        await wait_synced(provider_a, provider_b)
+        assert server.get_documents_count() == 2
+        # one underlying socket => one connection counted
+        assert server.get_connections_count() == 1
+        provider_a.document.get_text("t").insert(0, "A content")
+        provider_b.document.get_text("t").insert(0, "B content")
+        await retryable_assertion(
+            lambda: _assert(
+                server.documents["doc-a"].get_text("t").to_string() == "A content"
+                and server.documents["doc-b"].get_text("t").to_string() == "B content"
+            )
+        )
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        socket.destroy()
+        await server.destroy()
+
+
+async def test_provider_reconnects_and_resyncs():
+    server = await new_hocuspocus()
+    port = server.port
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "before restart")
+        await asyncio.sleep(0.2)
+        # simulate server crash + restart on the same port
+        await server.destroy()
+        assert not provider.websocket_provider.should_connect is False  # still wants to connect
+        from hocuspocus_tpu.server import Configuration, Server
+
+        server2 = Server(Configuration(quiet=True))
+        await server2.listen(port=port)
+        # offline edit while reconnecting
+        provider.document.get_text("t").insert(0, "offline! ")
+        await wait_for(lambda: provider.synced, timeout=20)
+        await retryable_assertion(
+            lambda: _assert(
+                server2.documents["hocuspocus-test"].get_text("t").to_string()
+                == "offline! before restart"
+            ),
+            timeout=15,
+        )
+        await server2.destroy()
+    finally:
+        provider.destroy()
+
+
+async def test_read_only_connection_cannot_write():
+    async def on_authenticate(data):
+        data.connection_config.read_only = True
+
+    server = await new_hocuspocus(on_authenticate=on_authenticate)
+    provider = new_provider(server)
+    try:
+        await wait_for(lambda: provider.is_authenticated)
+        assert provider.authorized_scope == "readonly"
+        provider.document.get_text("t").insert(0, "should not apply")
+        await asyncio.sleep(0.5)
+        doc = server.documents.get("hocuspocus-test")
+        assert doc is not None
+        assert doc.get_text("t").to_string() == ""
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_force_sync():
+    server = await new_hocuspocus()
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        # server-side direct edit does not proactively reach an idle
+        # provider's doc until a sync runs... it does broadcast, so
+        # instead verify force_sync round trip completes
+        provider.force_sync()
+        await wait_for(lambda: provider.synced)
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_has_unsynced_changes_lifecycle():
+    server = await new_hocuspocus()
+    provider = new_provider(server)
+    events = []
+    provider.on("unsynced_changes", lambda data: events.append(data["number"]))
+    try:
+        await wait_synced(provider)
+        assert not provider.has_unsynced_changes
+        provider.document.get_text("t").insert(0, "x")
+        assert provider.has_unsynced_changes
+        await wait_for(lambda: not provider.has_unsynced_changes)
+        assert 0 in events
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_awareness_error_when_disabled():
+    server = await new_hocuspocus()
+    provider = new_provider(server, awareness=None)
+    try:
+        from hocuspocus_tpu.provider import AwarenessError
+
+        with pytest.raises(AwarenessError):
+            provider.set_awareness_field("user", {"name": "x"})
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_observe_via_provider():
+    server = await new_hocuspocus()
+    provider_a = new_provider(server)
+    provider_b = new_provider(server)
+    deltas = []
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_b.document.get_text("t").observe(
+            lambda event, tr: deltas.append(event.delta)
+        )
+        provider_a.document.get_text("t").insert(0, "watched")
+        await retryable_assertion(lambda: _assert(deltas == [[{"insert": "watched"}]]))
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_authentication_scope_read_write():
+    server = await new_hocuspocus()
+    provider = new_provider(server)
+    scopes = []
+    provider.on("authenticated", lambda data: scopes.append(data["scope"]))
+    try:
+        await wait_synced(provider)
+        assert scopes == ["read-write"]
+        assert provider.is_authenticated
+    finally:
+        provider.destroy()
+        await server.destroy()

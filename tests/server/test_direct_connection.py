@@ -1,0 +1,72 @@
+"""DirectConnection: server-side in-process editing (reference
+tests/server/openDirectConnection.ts patterns)."""
+
+import asyncio
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_direct_connection_edits_and_stores():
+    stores = []
+
+    async def on_store_document(data):
+        stores.append(data.socket_id)
+
+    server = await new_hocuspocus(on_store_document=on_store_document)
+    direct = await server.open_direct_connection("direct-doc", {"admin": True})
+    try:
+        await direct.transact(lambda doc: doc.get_text("t").insert(0, "from server"))
+        # direct transact stores immediately (socket_id "server")
+        assert stores == ["server"]
+        assert server.documents["direct-doc"].get_text("t").to_string() == "from server"
+    finally:
+        await direct.disconnect()
+        await server.destroy()
+
+
+async def test_direct_connection_broadcasts_to_clients():
+    server = await new_hocuspocus()
+    provider = new_provider(server, name="shared")
+    direct = await server.open_direct_connection("shared")
+    try:
+        await wait_synced(provider)
+        await direct.transact(lambda doc: doc.get_text("t").insert(0, "server says hi"))
+        await retryable_assertion(
+            lambda: _assert(
+                provider.document.get_text("t").to_string() == "server says hi"
+            )
+        )
+    finally:
+        await direct.disconnect()
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_direct_connection_disconnect_unloads():
+    server = await new_hocuspocus()
+    direct = await server.open_direct_connection("ephemeral")
+    assert server.get_documents_count() == 1
+    assert server.get_connections_count() == 1
+    await direct.disconnect()
+    await retryable_assertion(lambda: _assert(server.get_documents_count() == 0))
+    assert server.get_connections_count() == 0
+    await server.destroy()
+
+
+async def test_direct_connection_counts_as_connection_keeping_doc_loaded():
+    server = await new_hocuspocus()
+    provider = new_provider(server, name="kept")
+    direct = await server.open_direct_connection("kept")
+    try:
+        await wait_synced(provider)
+        provider.destroy()
+        await asyncio.sleep(0.3)
+        # provider gone but the direct connection keeps the doc loaded
+        assert server.get_documents_count() == 1
+    finally:
+        await direct.disconnect()
+        await server.destroy()

@@ -1,0 +1,418 @@
+"""Per-hook server behavior (mirrors reference tests/server/* taxonomy)."""
+
+import asyncio
+
+import pytest
+
+from hocuspocus_tpu.server import Extension, Payload
+from tests.utils import (
+    new_hocuspocus,
+    new_provider,
+    retryable_assertion,
+    wait_for,
+    wait_synced,
+)
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_on_connect_and_connected_fire():
+    events = []
+
+    async def on_connect(data):
+        events.append(("on_connect", data.document_name))
+
+    async def connected(data):
+        events.append(("connected", data.document_name))
+
+    server = await new_hocuspocus(on_connect=on_connect, connected=connected)
+    provider = new_provider(server, name="doc")
+    try:
+        await wait_synced(provider)
+        assert ("on_connect", "doc") in events
+        assert ("connected", "doc") in events
+        # onConnect runs before connected
+        assert events.index(("on_connect", "doc")) < events.index(("connected", "doc"))
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_authenticate_receives_token():
+    tokens = []
+
+    async def on_authenticate(data):
+        tokens.append(data.token)
+
+    server = await new_hocuspocus(on_authenticate=on_authenticate)
+    provider = new_provider(server, token="secret-token-123")
+    try:
+        await wait_synced(provider)
+        assert tokens == ["secret-token-123"]
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_authenticate_rejection_denies_connection():
+    async def on_authenticate(data):
+        raise Exception("not allowed")
+
+    server = await new_hocuspocus(on_authenticate=on_authenticate)
+    provider = new_provider(server, token="bad")
+    failures = []
+    provider.on("authentication_failed", lambda data: failures.append(data))
+    try:
+        await retryable_assertion(lambda: _assert(len(failures) >= 1))
+        assert not provider.synced
+        assert not provider.is_authenticated
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_context_merging_across_hooks():
+    seen_contexts = []
+
+    async def on_connect(data):
+        return {"user_id": 42}
+
+    async def on_authenticate(data):
+        return {"role": "admin"}
+
+    async def connected(data):
+        seen_contexts.append(dict(data.context))
+
+    server = await new_hocuspocus(
+        on_connect=on_connect, on_authenticate=on_authenticate, connected=connected
+    )
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        assert seen_contexts == [{"user_id": 42, "role": "admin"}]
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_load_document_seeds_content():
+    from hocuspocus_tpu.crdt import Doc
+
+    async def on_load_document(data):
+        seed = Doc()
+        seed.get_text("t").insert(0, "seeded")
+        return seed
+
+    server = await new_hocuspocus(on_load_document=on_load_document)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        await retryable_assertion(
+            lambda: _assert(provider.document.get_text("t").to_string() == "seeded")
+        )
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_load_document_failure_closes_connection():
+    async def on_load_document(data):
+        raise Exception("load failed")
+
+    server = await new_hocuspocus(on_load_document=on_load_document)
+    provider = new_provider(server)
+    try:
+        await asyncio.sleep(0.5)
+        assert not provider.synced
+        assert server.get_documents_count() == 0
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_before_handle_message_rejection_blocks_updates():
+    reject = False
+    rejected = []
+
+    async def before_handle_message(data):
+        if reject:
+            rejected.append(data.document_name)
+            raise Exception("rejected")
+
+    server = await new_hocuspocus(before_handle_message=before_handle_message)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        reject = True
+        provider.document.get_text("t").insert(0, "x")
+        await retryable_assertion(lambda: _assert(len(rejected) >= 1))
+        # server must not have applied the change
+        doc = server.documents.get("hocuspocus-test")
+        if doc is not None:
+            assert doc.get_text("t").to_string() == ""
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_before_sync_sees_payload():
+    seen = []
+
+    async def before_sync(data):
+        seen.append((data.type, bytes(data.payload)))
+
+    server = await new_hocuspocus(before_sync=before_sync)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        await retryable_assertion(lambda: _assert(len(seen) >= 1))
+        assert seen[0][0] == 0  # SyncStep1 first
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_change_payload():
+    changes = []
+
+    async def on_change(data):
+        changes.append(
+            {
+                "name": data.document_name,
+                "clients_count": data.clients_count,
+                "update_len": len(data.update),
+                "socket_id": data.socket_id,
+            }
+        )
+
+    server = await new_hocuspocus(on_change=on_change)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "change me")
+        await retryable_assertion(lambda: _assert(len(changes) >= 1))
+        assert changes[0]["name"] == "hocuspocus-test"
+        assert changes[0]["clients_count"] == 1
+        assert changes[0]["update_len"] > 0
+        assert changes[0]["socket_id"]
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_store_document_debounce_collapses_edits():
+    stores = []
+
+    async def on_store_document(data):
+        stores.append(data.document_name)
+
+    server = await new_hocuspocus(on_store_document=on_store_document, debounce=200)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        for i in range(5):
+            provider.document.get_text("t").insert(0, "x")
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(0.5)
+        assert len(stores) == 1  # five edits, one debounced store
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_after_store_document_follows_store():
+    order = []
+
+    async def on_store_document(data):
+        order.append("store")
+
+    async def after_store_document(data):
+        order.append("after")
+
+    server = await new_hocuspocus(
+        on_store_document=on_store_document,
+        after_store_document=after_store_document,
+        debounce=50,
+    )
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "x")
+        await retryable_assertion(lambda: _assert(order == ["store", "after"]))
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_disconnect_fires():
+    disconnects = []
+
+    async def on_disconnect(data):
+        disconnects.append(data.document_name)
+
+    server = await new_hocuspocus(on_disconnect=on_disconnect)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.destroy()
+        await retryable_assertion(lambda: _assert(disconnects == ["hocuspocus-test"]))
+    finally:
+        await server.destroy()
+
+
+async def test_unload_document_after_last_disconnect():
+    unloads = []
+
+    async def after_unload_document(data):
+        unloads.append(data.document_name)
+
+    server = await new_hocuspocus(after_unload_document=after_unload_document)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        assert server.get_documents_count() == 1
+        provider.destroy()
+        await retryable_assertion(lambda: _assert(server.get_documents_count() == 0))
+        assert "hocuspocus-test" in unloads
+    finally:
+        await server.destroy()
+
+
+async def test_before_unload_document_veto_keeps_document():
+    async def before_unload_document(data):
+        raise Exception("keep it")
+
+    server = await new_hocuspocus(before_unload_document=before_unload_document)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.destroy()
+        await asyncio.sleep(0.3)
+        assert server.get_documents_count() == 1  # veto kept it loaded
+    finally:
+        server.hocuspocus.configuration.before_unload_document = None
+        server.hocuspocus.configure(server.hocuspocus.configuration)
+        await server.destroy()
+
+
+async def test_on_request_hook_custom_response():
+    import aiohttp
+    from aiohttp import web
+
+    async def on_request(data):
+        data["response"] = web.Response(status=418, text="teapot")
+
+    server = await new_hocuspocus(on_request=on_request)
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(server.http_url) as response:
+                assert response.status == 418
+                assert await response.text() == "teapot"
+    finally:
+        await server.destroy()
+
+
+async def test_extension_priority_order():
+    order = []
+
+    class First(Extension):
+        priority = 1000
+
+        async def on_connect(self, data):
+            order.append("first")
+
+    class Second(Extension):
+        priority = 10
+
+        async def on_connect(self, data):
+            order.append("second")
+
+    async def on_connect(data):  # inline callback runs last
+        order.append("inline")
+
+    server = await new_hocuspocus(extensions=[Second(), First()], on_connect=on_connect)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        assert order == ["first", "second", "inline"]
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_close_connections_resets_clients():
+    server = await new_hocuspocus()
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        closes = []
+        provider.on("close", lambda *args: closes.append(args))
+        server.close_connections()
+        await retryable_assertion(lambda: _assert(len(closes) >= 1))
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_stateless_roundtrip():
+    received_server = []
+
+    async def on_stateless(data):
+        received_server.append(data.payload)
+        # reply to the client
+        data.connection.send_stateless("pong:" + data.payload)
+
+    server = await new_hocuspocus(on_stateless=on_stateless)
+    provider = new_provider(server)
+    received_client = []
+    provider.on("stateless", lambda data: received_client.append(data["payload"]))
+    try:
+        await wait_synced(provider)
+        provider.send_stateless("ping-1")
+        await retryable_assertion(lambda: _assert(received_server == ["ping-1"]))
+        await retryable_assertion(lambda: _assert(received_client == ["pong:ping-1"]))
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_broadcast_stateless_reaches_all_clients():
+    server = await new_hocuspocus()
+    provider_a = new_provider(server)
+    provider_b = new_provider(server)
+    received = {"a": [], "b": []}
+    provider_a.on("stateless", lambda data: received["a"].append(data["payload"]))
+    provider_b.on("stateless", lambda data: received["b"].append(data["payload"]))
+    try:
+        await wait_synced(provider_a, provider_b)
+        document = server.documents["hocuspocus-test"]
+        document.broadcast_stateless("hello-everyone")
+        await retryable_assertion(
+            lambda: _assert(
+                received["a"] == ["hello-everyone"] and received["b"] == ["hello-everyone"]
+            )
+        )
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_on_create_document_ydoc_options():
+    seen = []
+
+    async def on_create_document(data):
+        seen.append(data.document_name)
+        return {"gc": False}
+
+    server = await new_hocuspocus(on_create_document=on_create_document)
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        assert seen == ["hocuspocus-test"]
+        assert server.documents["hocuspocus-test"].gc is False
+    finally:
+        provider.destroy()
+        await server.destroy()
