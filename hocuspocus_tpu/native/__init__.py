@@ -1,0 +1,66 @@
+"""Native (C++) codec with automatic build and pure-Python fallback.
+
+`get_codec()` returns the compiled `_codec` module, building it with
+g++ on first use if needed, or None when no toolchain is available —
+callers fall back to the pure-Python decoder in hocuspocus_tpu.crdt.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "codec.cpp")
+_SO = os.path.join(_DIR, f"_codec{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
+
+_codec = None
+_build_attempted = False
+
+
+def build(force: bool = False) -> bool:
+    """Compile codec.cpp into an extension module. Returns success."""
+    if not force and os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        f"-I{include}",
+        _SRC,
+        "-o",
+        _SO,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_codec():
+    """The compiled codec module, or None if unavailable."""
+    global _codec, _build_attempted
+    if _codec is not None:
+        return _codec
+    if os.environ.get("HOCUSPOCUS_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SO) and not _build_attempted:
+        _build_attempted = True
+        build()
+    if os.path.exists(_SO):
+        try:
+            if _DIR not in sys.path:
+                sys.path.insert(0, _DIR)
+            import _codec as codec_module  # type: ignore[import-not-found]
+
+            _codec = codec_module
+        except Exception:
+            _codec = None
+    return _codec

@@ -1,0 +1,324 @@
+// Native update codec for hocuspocus_tpu.
+//
+// C++ implementation of the Yjs v1 update *decode* hot path (lib0
+// varints, struct sections, delete sets) feeding the TPU merge plane's
+// host-side lowering. Replaces the reference's lib0/yjs JavaScript
+// decode layer (SURVEY.md §2.2 "native equivalents"); the pure-Python
+// decoder in hocuspocus_tpu.crdt remains the fallback and the
+// correctness reference.
+//
+// Exposes:
+//   decode_update(bytes) -> (structs, deletes)
+//     structs: list of (client, clock, kind, origin_client, origin_clock,
+//              right_client, right_clock, payload)
+//              kind 0 = string run (payload: str)
+//                   1 = deleted run (payload: length int)
+//                   2 = GC run (payload: length int)
+//                   3 = Skip run (payload: length int)
+//                   4 = other content (payload: length int) — caller
+//                       falls back to the Python path for this doc
+//     deletes: list of (client, clock, length)
+//   utf16_len(str) -> int      (JS string .length semantics)
+//
+// Build: g++ -O2 -shared -fPIC (see build.py); no external deps.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Reader {
+    const uint8_t* buf;
+    Py_ssize_t len;
+    Py_ssize_t pos = 0;
+
+    bool eof() const { return pos >= len; }
+
+    uint8_t u8() {
+        if (pos >= len) throw std::runtime_error("unexpected end of buffer");
+        return buf[pos++];
+    }
+
+    uint64_t var_uint() {
+        uint64_t num = 0;
+        int shift = 0;
+        while (true) {
+            uint8_t b = u8();
+            num |= static_cast<uint64_t>(b & 0x7F) << shift;
+            if (b < 0x80) return num;
+            shift += 7;
+            if (shift > 63) throw std::runtime_error("varint too long");
+        }
+    }
+
+    void skip(Py_ssize_t n) {
+        if (pos + n > len) throw std::runtime_error("unexpected end of buffer");
+        pos += n;
+    }
+
+    const char* bytes(Py_ssize_t n) {
+        if (pos + n > len) throw std::runtime_error("unexpected end of buffer");
+        const char* p = reinterpret_cast<const char*>(buf + pos);
+        pos += n;
+        return p;
+    }
+
+    // lib0 readVarString: utf-8 bytes with varuint length prefix
+    std::pair<const char*, Py_ssize_t> var_string() {
+        Py_ssize_t n = static_cast<Py_ssize_t>(var_uint());
+        return {bytes(n), n};
+    }
+
+    void skip_var_string() {
+        Py_ssize_t n = static_cast<Py_ssize_t>(var_uint());
+        skip(n);
+    }
+
+    void skip_var_bytes() {
+        Py_ssize_t n = static_cast<Py_ssize_t>(var_uint());
+        skip(n);
+    }
+
+    // lib0 readAny (tags 116-127) — value discarded, cursor advanced
+    void skip_any() {
+        uint8_t tag = u8();
+        switch (tag) {
+            case 127:  // undefined
+            case 126:  // null
+            case 121:  // false
+            case 120:  // true
+                return;
+            case 125: {  // varint
+                uint8_t b = u8();
+                while (b & 0x80) b = u8();
+                return;
+            }
+            case 124: skip(4); return;  // float32
+            case 123: skip(8); return;  // float64
+            case 122: skip(8); return;  // bigint64
+            case 119: skip_var_string(); return;
+            case 118: {  // object
+                uint64_t n = var_uint();
+                for (uint64_t i = 0; i < n; i++) {
+                    skip_var_string();
+                    skip_any();
+                }
+                return;
+            }
+            case 117: {  // array
+                uint64_t n = var_uint();
+                for (uint64_t i = 0; i < n; i++) skip_any();
+                return;
+            }
+            case 116: skip_var_bytes(); return;
+            default:
+                throw std::runtime_error("unknown Any tag");
+        }
+    }
+};
+
+constexpr uint8_t BIT_ORIGIN = 0x80;
+constexpr uint8_t BIT_RIGHT_ORIGIN = 0x40;
+constexpr uint8_t BIT_PARENT_SUB = 0x20;
+constexpr int64_t NONE_CLIENT = 0xFFFFFFFFll;
+
+// UTF-16 code-unit count of a UTF-8 byte range (JS string length).
+Py_ssize_t utf8_to_utf16_len(const char* s, Py_ssize_t n) {
+    Py_ssize_t units = 0;
+    for (Py_ssize_t i = 0; i < n;) {
+        uint8_t c = static_cast<uint8_t>(s[i]);
+        if (c < 0x80) { i += 1; units += 1; }
+        else if (c < 0xE0) { i += 2; units += 1; }
+        else if (c < 0xF0) { i += 3; units += 1; }
+        else { i += 4; units += 2; }  // astral -> surrogate pair
+    }
+    return units;
+}
+
+PyObject* decode_update(PyObject* /*self*/, PyObject* arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return nullptr;
+    Reader r{static_cast<const uint8_t*>(view.buf), view.len};
+
+    PyObject* structs = PyList_New(0);
+    PyObject* deletes = PyList_New(0);
+    if (!structs || !deletes) {
+        PyBuffer_Release(&view);
+        Py_XDECREF(structs);
+        Py_XDECREF(deletes);
+        return nullptr;
+    }
+
+    try {
+        uint64_t num_clients = r.var_uint();
+        for (uint64_t ci = 0; ci < num_clients; ci++) {
+            uint64_t num_structs = r.var_uint();
+            int64_t client = static_cast<int64_t>(r.var_uint());
+            int64_t clock = static_cast<int64_t>(r.var_uint());
+            for (uint64_t si = 0; si < num_structs; si++) {
+                uint8_t info = r.u8();
+                uint8_t ref = info & 0x1F;
+                int64_t kind;
+                int64_t origin_client = NONE_CLIENT, origin_clock = 0;
+                int64_t right_client = NONE_CLIENT, right_clock = 0;
+                PyObject* payload = nullptr;
+                int64_t length = 0;
+
+                if (ref == 0) {  // GC
+                    length = static_cast<int64_t>(r.var_uint());
+                    kind = 2;
+                    payload = PyLong_FromLongLong(length);
+                } else if (ref == 10) {  // Skip
+                    length = static_cast<int64_t>(r.var_uint());
+                    kind = 3;
+                    payload = PyLong_FromLongLong(length);
+                } else {
+                    if (info & BIT_ORIGIN) {
+                        origin_client = static_cast<int64_t>(r.var_uint());
+                        origin_clock = static_cast<int64_t>(r.var_uint());
+                    }
+                    if (info & BIT_RIGHT_ORIGIN) {
+                        right_client = static_cast<int64_t>(r.var_uint());
+                        right_clock = static_cast<int64_t>(r.var_uint());
+                    }
+                    if (!(info & (BIT_ORIGIN | BIT_RIGHT_ORIGIN))) {
+                        // parent info
+                        if (r.var_uint() == 1) {
+                            r.skip_var_string();  // root key
+                        } else {
+                            r.var_uint();  // parent id client
+                            r.var_uint();  // parent id clock
+                        }
+                        if (info & BIT_PARENT_SUB) r.skip_var_string();
+                    }
+                    switch (ref) {
+                        case 1: {  // ContentDeleted
+                            length = static_cast<int64_t>(r.var_uint());
+                            kind = 1;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        }
+                        case 4: {  // ContentString
+                            auto [p, n] = r.var_string();
+                            length = utf8_to_utf16_len(p, n);
+                            kind = 0;
+                            payload = PyUnicode_DecodeUTF8(p, n, "replace");
+                            break;
+                        }
+                        case 2: {  // ContentJSON
+                            uint64_t n = r.var_uint();
+                            for (uint64_t i = 0; i < n; i++) r.skip_var_string();
+                            length = static_cast<int64_t>(n);
+                            kind = 4;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        }
+                        case 3:  // ContentBinary
+                            r.skip_var_bytes();
+                            length = 1;
+                            kind = 4;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        case 5:  // ContentEmbed
+                            r.skip_var_string();
+                            length = 1;
+                            kind = 4;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        case 6:  // ContentFormat
+                            r.skip_var_string();
+                            r.skip_var_string();
+                            length = 1;
+                            kind = 4;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        case 7: {  // ContentType
+                            uint64_t type_ref = r.var_uint();
+                            if (type_ref == 3 || type_ref == 5) r.skip_var_string();
+                            length = 1;
+                            kind = 4;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        }
+                        case 8: {  // ContentAny
+                            uint64_t n = r.var_uint();
+                            for (uint64_t i = 0; i < n; i++) r.skip_any();
+                            length = static_cast<int64_t>(n);
+                            kind = 4;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        }
+                        case 9:  // ContentDoc
+                            r.skip_var_string();
+                            r.skip_any();
+                            length = 1;
+                            kind = 4;
+                            payload = PyLong_FromLongLong(length);
+                            break;
+                        default:
+                            throw std::runtime_error("unknown content ref");
+                    }
+                }
+                if (!payload) throw std::runtime_error("payload alloc failed");
+                PyObject* tup = Py_BuildValue(
+                    "(LLLLLLLN)", client, clock, kind, origin_client, origin_clock,
+                    right_client, right_clock, payload);
+                if (!tup) throw std::runtime_error("tuple alloc failed");
+                PyList_Append(structs, tup);
+                Py_DECREF(tup);
+                clock += length;
+            }
+        }
+        // delete set
+        uint64_t ds_clients = r.var_uint();
+        for (uint64_t i = 0; i < ds_clients; i++) {
+            int64_t client = static_cast<int64_t>(r.var_uint());
+            uint64_t ranges = r.var_uint();
+            for (uint64_t j = 0; j < ranges; j++) {
+                int64_t clock = static_cast<int64_t>(r.var_uint());
+                int64_t dlen = static_cast<int64_t>(r.var_uint());
+                PyObject* tup = Py_BuildValue("(LLL)", client, clock, dlen);
+                if (!tup) throw std::runtime_error("tuple alloc failed");
+                PyList_Append(deletes, tup);
+                Py_DECREF(tup);
+            }
+        }
+    } catch (const std::exception& e) {
+        PyBuffer_Release(&view);
+        Py_DECREF(structs);
+        Py_DECREF(deletes);
+        PyErr_SetString(PyExc_ValueError, e.what());
+        return nullptr;
+    }
+
+    PyBuffer_Release(&view);
+    return Py_BuildValue("(NN)", structs, deletes);
+}
+
+PyObject* utf16_len(PyObject* /*self*/, PyObject* arg) {
+    Py_ssize_t n;
+    const char* s = PyUnicode_AsUTF8AndSize(arg, &n);
+    if (!s) return nullptr;
+    return PyLong_FromSsize_t(utf8_to_utf16_len(s, n));
+}
+
+PyMethodDef methods[] = {
+    {"decode_update", decode_update, METH_O,
+     "Decode a Yjs v1 update into (structs, deletes) tuples."},
+    {"utf16_len", utf16_len, METH_O, "UTF-16 code unit count of a string."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_codec",
+    "Native Yjs v1 update codec (C++)", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__codec(void) { return PyModule_Create(&module); }

@@ -1,10 +1,12 @@
 """Host-side lowering: Yjs binary updates → dense device ops.
 
-Decodes update structs (same codec as the CPU path) and emits
-causally-ordered (insert-run / delete-range) ops for the TPU arena
-kernels. Documents whose updates contain content the dense text arena
-cannot represent (maps, arrays, formats, embeds, GC'd ranges) are
-flagged unsupported — the CPU path stays authoritative for them.
+Decodes update structs and emits causally-ordered (insert-run /
+delete-range) ops for the TPU arena kernels. Decoding uses the native
+C++ codec (hocuspocus_tpu.native) when available, with the pure-Python
+crdt decoder as fallback. Documents whose updates contain content the
+dense text arena cannot represent (maps, arrays, formats, embeds, GC'd
+ranges) are flagged unsupported — the CPU path stays authoritative for
+them.
 """
 
 from __future__ import annotations
@@ -15,10 +17,17 @@ from typing import Optional
 from ..crdt.content import ContentDeleted, ContentString
 from ..crdt.delete_set import DeleteSet
 from ..crdt.encoding import Decoder
-from ..crdt.ids import ID
 from ..crdt.structs import GC, Item, Skip
 from ..crdt.update import _read_client_struct_refs
+from ..native import get_codec
 from .kernels import KIND_DELETE, KIND_INSERT, MAX_RUN, NONE_CLIENT
+
+# struct kinds produced by decoding (matching the native codec)
+STRUCT_STRING = 0
+STRUCT_DELETED = 1
+STRUCT_GC = 2
+STRUCT_SKIP = 3
+STRUCT_OTHER = 4
 
 
 @dataclass
@@ -35,41 +44,117 @@ class DenseOp:
 
 
 @dataclass
+class LoweredStruct:
+    """Decoder-neutral struct record (native tuples or Python Items)."""
+
+    client: int
+    clock: int
+    kind: int
+    length: int
+    text: Optional[str]
+    origin: Optional[tuple]  # (client, clock)
+    right_origin: Optional[tuple]
+
+
+def _decode_update(update: bytes) -> tuple[list[LoweredStruct], list[tuple]]:
+    codec = get_codec()
+    if codec is not None:
+        raw_structs, deletes = codec.decode_update(update)
+        structs = []
+        for client, clock, kind, oc, ok, rc, rk, payload in raw_structs:
+            if kind == STRUCT_STRING:
+                text = payload
+                length = _utf16_len(payload)
+            else:
+                text = None
+                length = payload
+            structs.append(
+                LoweredStruct(
+                    client=client,
+                    clock=clock,
+                    kind=kind,
+                    length=length,
+                    text=text,
+                    origin=None if oc == NONE_CLIENT else (oc, ok),
+                    right_origin=None if rc == NONE_CLIENT else (rc, rk),
+                )
+            )
+        return structs, [tuple(d) for d in deletes]
+
+    # pure-Python fallback
+    decoder = Decoder(update)
+    refs = _read_client_struct_refs(decoder)
+    ds = DeleteSet.read(decoder)
+    structs = []
+    for entry in refs.values():
+        for struct in entry["refs"]:
+            if isinstance(struct, Skip):
+                kind, text, length = STRUCT_SKIP, None, struct.length
+                origin = right_origin = None
+            elif isinstance(struct, GC):
+                kind, text, length = STRUCT_GC, None, struct.length
+                origin = right_origin = None
+            else:
+                assert isinstance(struct, Item)
+                content = struct.content
+                origin = tuple(struct.origin) if struct.origin is not None else None
+                right_origin = (
+                    tuple(struct.right_origin) if struct.right_origin is not None else None
+                )
+                if isinstance(content, ContentString):
+                    kind, text, length = STRUCT_STRING, content.s, content.get_length()
+                elif isinstance(content, ContentDeleted):
+                    kind, text, length = STRUCT_DELETED, None, content.length
+                else:
+                    kind, text, length = STRUCT_OTHER, None, content.get_length()
+            structs.append(
+                LoweredStruct(
+                    client=struct.id.client,
+                    clock=struct.id.clock,
+                    kind=kind,
+                    length=length,
+                    text=text,
+                    origin=origin,
+                    right_origin=right_origin,
+                )
+            )
+    return structs, list(ds.iterate())
+
+
+@dataclass
 class DocLowerer:
     """Per-document lowering state: known clocks + pending ops."""
 
     known: dict[int, int] = field(default_factory=dict)  # client -> next clock
-    pending: list = field(default_factory=list)  # decoded structs waiting on deps
+    pending: list = field(default_factory=list)  # LoweredStructs waiting on deps
     pending_deletes: list = field(default_factory=list)  # (client, clock, len)
     unsupported: bool = False
 
-    def _id_known(self, ref: Optional[ID]) -> bool:
+    def _id_known(self, ref: Optional[tuple]) -> bool:
         if ref is None:
             return True
-        return ref.clock < self.known.get(ref.client, 0)
+        return ref[1] < self.known.get(ref[0], 0)
 
-    def _struct_ready(self, struct: Item) -> bool:
-        client, clock = struct.id
-        if clock > self.known.get(client, 0):
+    def _struct_ready(self, struct: LoweredStruct) -> bool:
+        if struct.clock > self.known.get(struct.client, 0):
             return False  # gap from same client
         return self._id_known(struct.origin) and self._id_known(struct.right_origin)
 
-    def _emit_struct(self, struct: Item, out: list[DenseOp]) -> None:
-        client, clock = struct.id
-        content = struct.content
+    def _emit_struct(self, struct: LoweredStruct, out: list[DenseOp]) -> None:
+        client, clock = struct.client, struct.clock
         if clock < self.known.get(client, 0):
             return  # duplicate
-        if isinstance(content, ContentString):
-            units = _utf16_units(content.s)
-        elif isinstance(content, ContentDeleted):
-            units = [0] * content.length
+        if struct.kind == STRUCT_STRING:
+            units = _utf16_units(struct.text or "")
+        elif struct.kind == STRUCT_DELETED:
+            units = [0] * struct.length
         else:
             self.unsupported = True
             return
-        left_client = struct.origin.client if struct.origin is not None else NONE_CLIENT
-        left_clock = struct.origin.clock if struct.origin is not None else 0
-        right_client = struct.right_origin.client if struct.right_origin is not None else NONE_CLIENT
-        right_clock = struct.right_origin.clock if struct.right_origin is not None else 0
+        left_client, left_clock = struct.origin if struct.origin is not None else (NONE_CLIENT, 0)
+        right_client, right_clock = (
+            struct.right_origin if struct.right_origin is not None else (NONE_CLIENT, 0)
+        )
         offset = 0
         while offset < len(units):
             piece = units[offset : offset + MAX_RUN]
@@ -87,7 +172,7 @@ class DocLowerer:
                 )
             )
             offset += len(piece)
-        if isinstance(content, ContentDeleted):
+        if struct.kind == STRUCT_DELETED:
             out.append(
                 DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=len(units))
             )
@@ -95,20 +180,19 @@ class DocLowerer:
 
     def lower_update(self, update: bytes) -> list[DenseOp]:
         """Decode one update and emit every op that is causally ready."""
-        decoder = Decoder(update)
-        refs = _read_client_struct_refs(decoder)
-        ds = DeleteSet.read(decoder)
-        for entry in refs.values():
-            for struct in entry["refs"]:
-                if isinstance(struct, Skip):
-                    self.unsupported = True
-                elif isinstance(struct, GC):
-                    # GC structs lose origin info — cannot be re-placed.
-                    self.unsupported = True
-                else:
-                    self.pending.append(struct)
-        for client, clock, length in ds.iterate():
-            self.pending_deletes.append((client, clock, length))
+        try:
+            structs, deletes = _decode_update(update)
+        except Exception:
+            self.unsupported = True
+            return []
+        for struct in structs:
+            if struct.kind in (STRUCT_SKIP, STRUCT_GC, STRUCT_OTHER):
+                # GC structs lose origin info and cannot be re-placed;
+                # Skips and non-text content are host-only.
+                self.unsupported = True
+            else:
+                self.pending.append(struct)
+        self.pending_deletes.extend(deletes)
         if self.unsupported:
             return []
         return self._drain()
@@ -137,6 +221,14 @@ class DocLowerer:
                 remaining_deletes.append((client, clock, length))
         self.pending_deletes = remaining_deletes
         return out
+
+
+def _utf16_len(s: str) -> int:
+    n = len(s)
+    for ch in s:
+        if ord(ch) > 0xFFFF:
+            n += 1
+    return n
 
 
 def _utf16_units(s: str) -> list[int]:

@@ -1,0 +1,123 @@
+"""Native C++ codec: build, parity with the Python decoder, speed."""
+
+import random
+
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, encode_state_as_update
+from hocuspocus_tpu.native import build, get_codec
+
+
+@pytest.fixture(scope="module")
+def codec():
+    assert build(), "native codec failed to build"
+    codec = get_codec()
+    assert codec is not None
+    return codec
+
+
+def test_utf16_len(codec):
+    assert codec.utf16_len("hello") == 5
+    assert codec.utf16_len("a😀b") == 4
+    assert codec.utf16_len("") == 0
+    assert codec.utf16_len("é") == 1
+
+
+def test_decode_simple_update(codec):
+    doc = Doc()
+    doc.get_text("t").insert(0, "hello world")
+    update = encode_state_as_update(doc)
+    structs, deletes = codec.decode_update(update)
+    assert len(structs) == 1
+    client, clock, kind, oc, ok, rc, rk, payload = structs[0]
+    assert client == doc.client_id
+    assert clock == 0
+    assert kind == 0  # string
+    assert payload == "hello world"
+    assert deletes == []
+
+
+def test_decode_with_deletes(codec):
+    doc = Doc(gc=False)
+    text = doc.get_text("t")
+    text.insert(0, "hello world")
+    text.delete(0, 6)
+    update = encode_state_as_update(doc)
+    structs, deletes = codec.decode_update(update)
+    assert len(deletes) == 1
+    assert deletes[0][2] == 6  # deleted length
+
+
+def test_decode_parity_with_python(codec):
+    """Native and Python decode paths produce identical lowered ops."""
+    import os
+
+    from hocuspocus_tpu.tpu.lowering import DocLowerer
+
+    random.seed(5)
+    doc = Doc()
+    text = doc.get_text("t")
+    updates = []
+    doc.on("update", lambda update, *rest: updates.append(update))
+    for _ in range(60):
+        if random.random() < 0.7 or len(text) == 0:
+            text.insert(random.randint(0, len(text)), random.choice("abcé😀") * random.randint(1, 25))
+        else:
+            pos = random.randrange(len(text))
+            text.delete(pos, min(random.randint(1, 6), len(text) - pos))
+
+    native_lowerer = DocLowerer()
+    native_ops = []
+    for update in updates:
+        native_ops.extend(native_lowerer.lower_update(update))
+
+    os.environ["HOCUSPOCUS_TPU_NO_NATIVE"] = "1"
+    try:
+        py_lowerer = DocLowerer()
+        py_ops = []
+        for update in updates:
+            py_ops.extend(py_lowerer.lower_update(update))
+    finally:
+        del os.environ["HOCUSPOCUS_TPU_NO_NATIVE"]
+
+    assert not native_lowerer.unsupported and not py_lowerer.unsupported
+    assert native_ops == py_ops
+    assert len(native_ops) > 0
+
+
+def test_decode_unsupported_content_flagged(codec):
+    doc = Doc()
+    doc.get_map("m").set("k", {"nested": [1, 2]})
+    update = encode_state_as_update(doc)
+    structs, deletes = codec.decode_update(update)
+    assert any(s[2] == 4 for s in structs)  # kind 4 = other content
+
+
+def test_native_speedup(codec):
+    """The native decoder should beat the Python one comfortably."""
+    import time
+
+    doc = Doc()
+    text = doc.get_text("t")
+    for i in range(200):
+        text.insert(len(text), f"chunk {i} of text content ")
+    update = encode_state_as_update(doc)
+
+    n = 300
+    t0 = time.perf_counter()
+    for _ in range(n):
+        codec.decode_update(update)
+    native_time = time.perf_counter() - t0
+
+    from hocuspocus_tpu.crdt.delete_set import DeleteSet
+    from hocuspocus_tpu.crdt.encoding import Decoder
+    from hocuspocus_tpu.crdt.update import _read_client_struct_refs
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        d = Decoder(update)
+        _read_client_struct_refs(d)
+        DeleteSet.read(d)
+    python_time = time.perf_counter() - t0
+
+    assert native_time < python_time, (native_time, python_time)
