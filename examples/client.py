@@ -1,0 +1,36 @@
+"""Provider usage: connect, edit, observe, awareness.
+
+Run examples/default.py first, then: python examples/client.py
+"""
+
+import asyncio
+
+from hocuspocus_tpu.provider import HocuspocusProvider
+
+
+async def main() -> None:
+    provider = HocuspocusProvider(
+        name="example-document",
+        url="ws://127.0.0.1:8000",
+        token="my-access-token",
+        on_synced=lambda data: print("synced!"),
+        on_authenticated=lambda data: print("authenticated:", data["scope"]),
+        on_stateless=lambda data: print("stateless message:", data["payload"]),
+    )
+
+    text = provider.document.get_text("content")
+    text.observe(lambda event, tr: print("delta:", event.delta))
+
+    while not provider.synced:
+        await asyncio.sleep(0.05)
+
+    text.insert(0, "Hello from Python! ")
+    provider.set_awareness_field("user", {"name": "example", "color": "#ffcc00"})
+
+    await asyncio.sleep(2)
+    print("document is now:", text.to_string())
+    provider.destroy()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,27 @@
+"""Default server: SQLite persistence + logging on port 8000.
+
+Equivalent of reference `playground/backend/src/default.ts`.
+Run: python examples/default.py
+"""
+
+import asyncio
+import logging
+
+from hocuspocus_tpu import Configuration, Server
+from hocuspocus_tpu.extensions import Logger, SQLite
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    server = Server(
+        Configuration(
+            name="playground-default",
+            extensions=[Logger(), SQLite(database="playground.db")],
+        )
+    )
+    await server.listen(port=8000)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,54 @@
+"""Embedding the collaboration core in your own aiohttp application.
+
+Equivalent of reference `playground/backend/src/express.ts` /
+`koa.ts` / `hono.ts`: the framework-agnostic core is driven through
+`hocuspocus.handle_connection(transport, request_info, context)` —
+any web framework that can hand you a websocket works.
+
+Run: python examples/embed_aiohttp.py
+"""
+
+import asyncio
+
+from aiohttp import WSMsgType, web
+
+from hocuspocus_tpu.server import Hocuspocus, RequestInfo
+from hocuspocus_tpu.server.server import AiohttpWebSocketTransport
+
+hocuspocus = Hocuspocus()
+
+
+async def collab(request: web.Request) -> web.WebSocketResponse:
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+    transport = AiohttpWebSocketTransport(ws)
+    request_info = RequestInfo(headers=dict(request.headers), url=str(request.rel_url))
+    # anything you put in context is visible to every hook
+    connection = hocuspocus.handle_connection(transport, request_info, {"via": "embedded"})
+    try:
+        async for msg in ws:
+            if msg.type == WSMsgType.BINARY:
+                await connection.handle_message(msg.data)
+    finally:
+        transport.abort()
+        await connection.handle_transport_close(ws.close_code or 1000, "")
+    return ws
+
+
+async def index(request: web.Request) -> web.Response:
+    return web.Response(text="my app with embedded collaboration at /collab")
+
+
+async def main() -> None:
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/collab", collab)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", 8000).start()
+    print("listening on http://127.0.0.1:8000 (ws at /collab)")
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

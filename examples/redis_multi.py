@@ -1,0 +1,39 @@
+"""Two server instances sharing documents through Redis fan-out.
+
+Equivalent of reference `playground/backend/src/redis.ts`, with the
+in-process mini-redis so the example is self-contained — point `host`/
+`port` at a real Redis in production.
+
+Run: python examples/redis_multi.py
+"""
+
+import asyncio
+
+from hocuspocus_tpu import Configuration, Server
+from hocuspocus_tpu.extensions import Redis
+from hocuspocus_tpu.net.mini_redis import MiniRedis
+
+
+async def main() -> None:
+    redis = await MiniRedis().start()
+    server_a = Server(
+        Configuration(
+            name="instance-a",
+            extensions=[Redis(port=redis.port, identifier="instance-a")],
+        )
+    )
+    server_b = Server(
+        Configuration(
+            name="instance-b",
+            extensions=[Redis(port=redis.port, identifier="instance-b")],
+        )
+    )
+    await server_a.listen(port=8001)
+    await server_b.listen(port=8002)
+    print("connect clients to ws://127.0.0.1:8001 or ws://127.0.0.1:8002 —")
+    print("edits to the same document name sync across both instances")
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,32 @@
+"""Server with the TPU batched merge plane enabled.
+
+Every supported text document is mirrored onto device-resident arenas;
+updates from all documents are integrated in micro-batched kernel steps
+(see docs/tpu/merge-plane.md and bench.py).
+
+Run: python examples/tpu_merge.py
+"""
+
+import asyncio
+
+from hocuspocus_tpu import Configuration, Server
+from hocuspocus_tpu.extensions import Logger
+from hocuspocus_tpu.tpu import TpuMergeExtension
+
+
+async def main() -> None:
+    server = Server(
+        Configuration(
+            name="tpu-merge",
+            extensions=[
+                Logger(),
+                TpuMergeExtension(num_docs=1024, capacity=4096, flush_interval_ms=5),
+            ],
+        )
+    )
+    await server.listen(port=8000)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -9,12 +9,51 @@ for thousands of documents per step on TPU.
 
 Layering (see SURVEY.md):
   L0/L1  hocuspocus_tpu.crdt      — Y.js-compatible CRDT engine + binary codec
+         hocuspocus_tpu.native    — C++ update codec (auto-built, optional)
          hocuspocus_tpu.protocol  — sync/awareness/auth wire protocols
   L2     hocuspocus_tpu.server    — asyncio server core (hook bus, documents)
   L3     hocuspocus_tpu.provider  — client provider (reconnect, multiplexing)
   L4     hocuspocus_tpu.extensions — database/sqlite/s3/redis/logger/throttle/webhook
   L5     hocuspocus_tpu.transformer — ProseMirror/Tiptap JSON <-> doc
-  L6     hocuspocus_tpu.tpu       — batched TPU merge plane (JAX/Pallas)
+  L6     hocuspocus_tpu.tpu       — batched TPU merge plane (JAX)
 """
 
 __version__ = "0.1.0"
+
+# Convenience top-level API (heavier modules stay lazy).
+from .server import (  # noqa: E402
+    Configuration,
+    Extension,
+    Hocuspocus,
+    Payload,
+    Server,
+)
+
+
+def __getattr__(name):
+    if name == "HocuspocusProvider":
+        from .provider import HocuspocusProvider
+
+        return HocuspocusProvider
+    if name == "HocuspocusProviderWebsocket":
+        from .provider import HocuspocusProviderWebsocket
+
+        return HocuspocusProviderWebsocket
+    if name == "Doc":
+        from .crdt import Doc
+
+        return Doc
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "Configuration",
+    "Extension",
+    "Hocuspocus",
+    "Payload",
+    "Server",
+    "HocuspocusProvider",
+    "HocuspocusProviderWebsocket",
+    "Doc",
+    "__version__",
+]

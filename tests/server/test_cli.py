@@ -1,0 +1,50 @@
+"""CLI smoke test: boot the binary, connect a provider, shut down."""
+
+import asyncio
+import os
+import signal
+import sys
+
+from hocuspocus_tpu.provider import HocuspocusProvider
+from tests.utils import wait_for
+
+
+async def test_cli_serves_connections(tmp_path, unused_tcp_port=None):
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    process = await asyncio.create_subprocess_exec(
+        sys.executable,
+        "-m",
+        "hocuspocus_tpu.cli",
+        "--port",
+        str(port),
+        "--host",
+        "127.0.0.1",
+        "--sqlite",
+        str(tmp_path / "cli.db"),
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        env=env,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT,
+    )
+    provider = None
+    try:
+        provider = HocuspocusProvider(name="cli-doc", url=f"ws://127.0.0.1:{port}")
+        await wait_for(lambda: provider.synced, timeout=20)
+        provider.document.get_text("t").insert(0, "via cli")
+        await wait_for(lambda: not provider.has_unsynced_changes, timeout=10)
+    finally:
+        if provider is not None:
+            provider.destroy()
+        process.send_signal(signal.SIGTERM)
+        try:
+            await asyncio.wait_for(process.wait(), 10)
+        except asyncio.TimeoutError:
+            process.kill()
