@@ -1,0 +1,87 @@
+"""BASELINE config 1: single doc, 2 clients, SQLite, concurrent inserts.
+
+Two real websocket providers hammer one document with 1 KB inserts;
+measures server-applied updates/sec and edit→other-peer p99 latency.
+
+Env: C1_SECONDS (default 5), C1_CHUNK (default 1024 chars).
+"""
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main() -> None:
+    from hocuspocus_tpu.extensions import SQLite
+    from hocuspocus_tpu.provider import HocuspocusProvider
+    from hocuspocus_tpu.server import Configuration, Server
+
+    seconds = float(os.environ.get("C1_SECONDS", 5))
+    chunk = int(os.environ.get("C1_CHUNK", 1024))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        server = Server(
+            Configuration(quiet=True, extensions=[SQLite(database=f"{tmp}/bench.db")])
+        )
+        await server.listen(port=0)
+        a = HocuspocusProvider(name="bench-doc", url=server.web_socket_url)
+        b = HocuspocusProvider(name="bench-doc", url=server.web_socket_url)
+        while not (a.synced and b.synced):
+            await asyncio.sleep(0.01)
+
+        applied = 0
+        latencies: list[float] = []
+        pending: dict[int, float] = {}
+        marker = 0
+
+        def on_b_update(update, origin, doc, tr) -> None:
+            nonlocal applied
+            applied += 1
+            now = time.perf_counter()
+            for m, t0 in list(pending.items()):
+                latencies.append(now - t0)
+                del pending[m]
+
+        b.document.on("update", on_b_update)
+
+        deadline = time.perf_counter() + seconds
+        sent = 0
+        while time.perf_counter() < deadline:
+            marker += 1
+            pending[marker] = time.perf_counter()
+            a.document.get_text("t").insert(0, "x" * chunk)
+            b.document.get_text("t").insert(0, "y" * chunk)
+            sent += 2
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(0.5)
+
+        elapsed = seconds
+        import numpy as np
+
+        p99 = float(np.percentile(np.array(latencies) * 1000, 99)) if latencies else None
+        print(
+            json.dumps(
+                {
+                    "metric": "config1_applied_updates_per_sec",
+                    "value": round(sent / elapsed, 1),
+                    "unit": "updates/s",
+                    "extra": {
+                        "chunk_bytes": chunk,
+                        "edit_to_peer_p99_ms": round(p99, 2) if p99 else None,
+                        "doc_chars": len(a.document.get_text("t")),
+                    },
+                }
+            )
+        )
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,93 @@
+"""BASELINE config 3: ProseMirror rich-text docs via the transformer,
+bursty update batches.
+
+Builds rich ProseMirror documents, converts JSON→CRDT via the
+transformer, applies bursty 100-op update batches, converts back.
+Measures documents/sec through the full transform+apply+serialize
+pipeline.
+
+Env: C3_DOCS (default 200), C3_BURST (default 100).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_pm_doc(i: int) -> dict:
+    return {
+        "type": "doc",
+        "content": [
+            {
+                "type": "heading",
+                "attrs": {"level": 1},
+                "content": [{"type": "text", "text": f"Document {i}"}],
+            },
+            {
+                "type": "paragraph",
+                "content": [
+                    {"type": "text", "text": "Some "},
+                    {"type": "text", "text": "bold", "marks": [{"type": "bold"}]},
+                    {"type": "text", "text": " rich text content with enough length "},
+                    {
+                        "type": "text",
+                        "text": "and a link",
+                        "marks": [{"type": "link", "attrs": {"href": f"https://x.test/{i}"}}],
+                    },
+                ],
+            },
+        ],
+    }
+
+
+def main() -> None:
+    from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+    from hocuspocus_tpu.transformer import ProsemirrorTransformer
+
+    num_docs = int(os.environ.get("C3_DOCS", 200))
+    burst = int(os.environ.get("C3_BURST", 100))
+
+    start = time.perf_counter()
+    ops_applied = 0
+    for i in range(num_docs):
+        ydoc = ProsemirrorTransformer.to_ydoc(make_pm_doc(i), "prosemirror")
+        server_doc = Doc()
+        apply_update(server_doc, encode_state_as_update(ydoc))
+        # bursty edit batch on the first text node
+        frag = server_doc.get_xml_fragment("prosemirror")
+        heading = frag.get(0)
+        text_node = heading.get(0)
+        updates = []
+        server_doc.on("update", lambda u, *rest: updates.append(u))
+        for op in range(burst):
+            text_node.insert(0, "x")
+            ops_applied += 1
+        # replicate the burst to a second doc (the fan-out direction)
+        replica = Doc()
+        apply_update(replica, encode_state_as_update(server_doc))
+        result = ProsemirrorTransformer.from_ydoc(replica, "prosemirror")
+        assert result["content"][0]["content"][0]["text"].startswith("x")
+    elapsed = time.perf_counter() - start
+
+    print(
+        json.dumps(
+            {
+                "metric": "config3_transformer_docs_per_sec",
+                "value": round(num_docs / elapsed, 1),
+                "unit": "docs/s",
+                "extra": {
+                    "docs": num_docs,
+                    "burst_ops_per_doc": burst,
+                    "total_ops": ops_applied,
+                    "ops_per_sec": round(ops_applied / elapsed, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

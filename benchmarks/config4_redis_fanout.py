@@ -1,0 +1,123 @@
+"""BASELINE config 4: docs behind Redis fan-out, multi-node, steady ops.
+
+Two server instances share documents through (mini-)Redis; clients on
+instance A stream steady edits, clients on instance B receive them.
+Measures cross-instance propagation throughput and p99 latency.
+
+Env: C4_DOCS (default 10), C4_SECONDS (default 5),
+REDIS_HOST/REDIS_PORT to target a real Redis.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main() -> None:
+    import numpy as np
+
+    from hocuspocus_tpu.extensions import Redis
+    from hocuspocus_tpu.net.mini_redis import MiniRedis
+    from hocuspocus_tpu.provider import HocuspocusProvider
+    from hocuspocus_tpu.server import Configuration, Server
+
+    num_docs = int(os.environ.get("C4_DOCS", 10))
+    seconds = float(os.environ.get("C4_SECONDS", 5))
+
+    redis_host = os.environ.get("REDIS_HOST")
+    mini = None
+    if redis_host:
+        redis_port = int(os.environ.get("REDIS_PORT", 6379))
+    else:
+        mini = await MiniRedis().start()
+        redis_host, redis_port = "127.0.0.1", mini.port
+
+    def make_server(ident):
+        return Server(
+            Configuration(
+                quiet=True,
+                extensions=[
+                    Redis(
+                        host=redis_host,
+                        port=redis_port,
+                        identifier=ident,
+                        disconnect_delay=100,
+                    )
+                ],
+            )
+        )
+
+    server_a = make_server("bench-a")
+    server_b = make_server("bench-b")
+    await server_a.listen(port=0)
+    await server_b.listen(port=0)
+
+    writers = [
+        HocuspocusProvider(name=f"doc-{d}", url=server_a.web_socket_url)
+        for d in range(num_docs)
+    ]
+    readers = [
+        HocuspocusProvider(name=f"doc-{d}", url=server_b.web_socket_url)
+        for d in range(num_docs)
+    ]
+    while not all(p.synced for p in writers + readers):
+        await asyncio.sleep(0.02)
+
+    received = 0
+    latencies: list[float] = []
+    send_times: dict[int, list[float]] = {d: [] for d in range(num_docs)}
+
+    def on_reader_update(d):
+        def handler(update, origin, doc, tr):
+            nonlocal received
+            received += 1
+            if send_times[d]:
+                latencies.append(time.perf_counter() - send_times[d].pop(0))
+
+        return handler
+
+    for d, reader in enumerate(readers):
+        reader.document.on("update", on_reader_update(d))
+
+    sent = 0
+    start = time.perf_counter()
+    deadline = start + seconds
+    while time.perf_counter() < deadline:
+        for d, writer in enumerate(writers):
+            send_times[d].append(time.perf_counter())
+            writer.document.get_text("t").insert(0, "z")
+            sent += 1
+        await asyncio.sleep(0.02)  # ~50 ops/s/doc
+    await asyncio.sleep(1.0)
+    elapsed = deadline - start
+
+    p99 = float(np.percentile(np.array(latencies) * 1000, 99)) if latencies else None
+    print(
+        json.dumps(
+            {
+                "metric": "config4_cross_instance_ops_per_sec",
+                "value": round(received / elapsed, 1),
+                "unit": "ops/s",
+                "extra": {
+                    "docs": num_docs,
+                    "sent": sent,
+                    "received": received,
+                    "propagation_p99_ms": round(p99, 2) if p99 else None,
+                },
+            }
+        )
+    )
+    for p in writers + readers:
+        p.destroy()
+    await server_a.destroy()
+    await server_b.destroy()
+    if mini is not None:
+        await mini.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

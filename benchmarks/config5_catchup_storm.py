@@ -1,0 +1,103 @@
+"""BASELINE config 5: cold docs, snapshot load + state-vector diff replay.
+
+The catch-up storm: a fleet of cold documents reconnects and each client
+needs the diff between its state vector and the server's. Two parts:
+
+1. Device: batched state-vector diff for ~1M (doc, client) pairs in one
+   kernel call (the O(docs) part that storms).
+2. Host: snapshot load + diff_update + apply for a sample of documents
+   (the per-doc byte-shuffling part).
+
+Env: C5_DOCS (default 1_000_000 device pairs), C5_HOST_DOCS (default 200).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import numpy as np
+
+    device_docs = int(os.environ.get("C5_DOCS", 1_000_000))
+    host_docs = int(os.environ.get("C5_HOST_DOCS", 200))
+
+    # -- part 1: device SV diff -------------------------------------------
+    import jax
+    import jax.numpy as jnp
+
+    from hocuspocus_tpu.tpu.kernels import state_vector_diff
+
+    clients_per_doc = 4
+    rng = np.random.default_rng(0)
+    server_clocks = jnp.asarray(
+        rng.integers(0, 10_000, size=(device_docs, clients_per_doc)), jnp.int32
+    )
+    client_clocks = jnp.maximum(
+        server_clocks
+        - jnp.asarray(rng.integers(0, 500, size=(device_docs, clients_per_doc)), jnp.int32),
+        0,
+    )
+    # warm
+    missing_from, missing_len = state_vector_diff(server_clocks, client_clocks)
+    jax.block_until_ready((missing_from, missing_len))
+    t0 = time.perf_counter()
+    missing_from, missing_len = state_vector_diff(server_clocks, client_clocks)
+    total_missing = int(jnp.sum(missing_len))  # blocks
+    device_elapsed = time.perf_counter() - t0
+
+    # -- part 2: host snapshot load + diff replay -------------------------
+    from hocuspocus_tpu.crdt import (
+        Doc,
+        apply_update,
+        diff_update,
+        encode_state_as_update,
+        encode_state_vector,
+    )
+
+    # build one representative 10KB-ish document snapshot
+    source = Doc()
+    text = source.get_text("t")
+    for i in range(40):
+        text.insert(len(text), ("line %04d " % i) * 25)
+    mid_sv = encode_state_vector(source)
+    text.insert(len(text), "tail content after client went offline " * 10)
+    snapshot_bytes = encode_state_as_update(source)
+
+    t0 = time.perf_counter()
+    replayed = 0
+    for _ in range(host_docs):
+        # server side: load snapshot, compute the diff for the client SV
+        server_doc = Doc()
+        apply_update(server_doc, snapshot_bytes)
+        diff = diff_update(encode_state_as_update(server_doc), mid_sv)
+        # client side: apply the replay diff
+        client_doc = Doc()
+        apply_update(client_doc, encode_state_as_update(source, encode_state_vector(client_doc)))
+        replayed += len(diff)
+    host_elapsed = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "config5_sv_diffs_per_sec",
+                "value": round(device_docs * clients_per_doc / device_elapsed, 1),
+                "unit": "pairs/s",
+                "extra": {
+                    "device_pairs": device_docs * clients_per_doc,
+                    "device_ms": round(device_elapsed * 1000, 2),
+                    "total_missing_clocks": total_missing,
+                    "host_docs_per_sec": round(host_docs / host_elapsed, 1),
+                    "snapshot_bytes": len(snapshot_bytes),
+                    "backend": jax.default_backend(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

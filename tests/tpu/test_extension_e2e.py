@@ -1,0 +1,72 @@
+"""TpuMergeExtension in the live server: device mirror tracks clients."""
+
+import asyncio
+
+import numpy as np
+
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_extension_mirrors_live_documents():
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1)
+    server = await new_hocuspocus(extensions=[ext])
+    provider_a = new_provider(server, name="mirrored")
+    provider_b = new_provider(server, name="mirrored")
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_text("t").insert(0, "hello ")
+        provider_b.document.get_text("t").insert(0, "world ")
+
+        def mirrored():
+            ext.plane.flush()
+            device = ext.plane.text("mirrored")
+            cpu = server.documents["mirrored"].get_text("t").to_string()
+            assert device == cpu and len(cpu) == 12
+
+        await retryable_assertion(mirrored)
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_extension_releases_slot_on_unload():
+    ext = TpuMergeExtension(num_docs=2, capacity=256, flush_interval_ms=1)
+    server = await new_hocuspocus(extensions=[ext])
+    provider = new_provider(server, name="transient")
+    try:
+        await wait_synced(provider)
+        assert "transient" in ext.plane.slots
+        provider.destroy()
+        await retryable_assertion(lambda: _assert("transient" not in ext.plane.slots))
+    finally:
+        await server.destroy()
+
+
+def test_state_vector_diff_kernel():
+    """Catch-up storm primitive (BASELINE config 5): batched SV diff."""
+    import jax.numpy as jnp
+
+    from hocuspocus_tpu.tpu.kernels import state_vector_diff
+
+    # 4 docs, 3 client slots
+    server_clocks = jnp.asarray(
+        [[100, 50, 0], [10, 0, 0], [7, 7, 7], [0, 0, 0]], jnp.int32
+    )
+    client_clocks = jnp.asarray(
+        [[80, 50, 0], [10, 0, 0], [0, 9, 7], [0, 0, 0]], jnp.int32
+    )
+    missing_from, missing_len = state_vector_diff(server_clocks, client_clocks)
+    np.testing.assert_array_equal(
+        np.asarray(missing_len),
+        [[20, 0, 0], [0, 0, 0], [7, 0, 0], [0, 0, 0]],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(missing_from),
+        [[80, 50, 0], [10, 0, 0], [0, 7, 7], [0, 0, 0]],
+    )
