@@ -17,11 +17,12 @@ Representation (per document, fixed capacity N — "arena"):
   needs a batched dynamic gather per op, which serializes on TPU.)
 
   id_client/id_clock     — the unit's Yjs id (client ids are uint32)
-  origin_client/clock    — YATA left origin id (NONE_CLIENT = doc start)
   rank                   — current logical position (0..length-1)
   origin_rank            — current RANK of the left origin, maintained
                            incrementally so conflict resolution never
-                           searches
+                           searches (origin *ids* are not kept on
+                           device — they are write-only for the kernel
+                           and live host-side in the lowerer)
   chars                  — UTF-16 code unit
   deleted                — tombstone flag
   length                 — number of occupied arena slots
@@ -63,8 +64,6 @@ class DocState(NamedTuple):
 
     id_client: jax.Array  # (D, N) uint32
     id_clock: jax.Array  # (D, N) int32
-    origin_client: jax.Array  # (D, N) uint32
-    origin_clock: jax.Array  # (D, N) int32
     rank: jax.Array  # (D, N) int32 — logical position
     origin_rank: jax.Array  # (D, N) int32 — rank of left origin (-1 = start)
     chars: jax.Array  # (D, N) int32 UTF-16 code units
@@ -94,8 +93,6 @@ def make_empty_state(num_docs: int, capacity: int) -> DocState:
     return DocState(
         id_client=jnp.full(shape, NONE_CLIENT, jnp.uint32),
         id_clock=jnp.zeros(shape, jnp.int32),
-        origin_client=jnp.full(shape, NONE_CLIENT, jnp.uint32),
-        origin_clock=jnp.zeros(shape, jnp.int32),
         rank=jnp.full(shape, _INF, jnp.int32),
         origin_rank=jnp.full(shape, -1, jnp.int32),
         chars=jnp.zeros(shape, jnp.int32),
@@ -176,12 +173,6 @@ def _integrate_one(state: DocState, op: OpBatch) -> DocState:
 
     id_client = jnp.where(in_new, op.client, state.id_client)
     id_clock = jnp.where(in_new, op.clock + slot_off, state.id_clock)
-    origin_client = jnp.where(
-        in_new, jnp.where(is_first, op.left_client, op.client), state.origin_client
-    )
-    origin_clock = jnp.where(
-        in_new, jnp.where(is_first, op.left_clock, op.clock + slot_off - 1), state.origin_clock
-    )
     rank = jnp.where(in_new, ins_rank + slot_off, rank_bumped)
     origin_rank = jnp.where(
         in_new, jnp.where(is_first, left_rank, ins_rank + slot_off - 1), origin_rank_bumped
@@ -202,8 +193,6 @@ def _integrate_one(state: DocState, op: OpBatch) -> DocState:
     return DocState(
         id_client=id_client,
         id_clock=id_clock,
-        origin_client=origin_client,
-        origin_clock=origin_clock,
         rank=rank,
         origin_rank=origin_rank,
         chars=chars,

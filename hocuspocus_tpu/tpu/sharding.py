@@ -37,8 +37,6 @@ def state_sharding(mesh: Mesh) -> DocState:
     return DocState(
         id_client=arena,
         id_clock=arena,
-        origin_client=arena,
-        origin_clock=arena,
         rank=arena,
         origin_rank=arena,
         chars=arena,
