@@ -43,6 +43,10 @@ def __getattr__(name):
         from .crdt import Doc
 
         return Doc
+    if name == "Metrics":
+        from .observability import Metrics
+
+        return Metrics
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -55,5 +59,6 @@ __all__ = [
     "HocuspocusProvider",
     "HocuspocusProviderWebsocket",
     "Doc",
+    "Metrics",
     "__version__",
 ]

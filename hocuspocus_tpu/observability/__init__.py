@@ -1,0 +1,21 @@
+"""Observability: span tracing, metrics registry, Prometheus endpoint.
+
+New capability beyond the reference (SURVEY.md §5.1/§5.5 record that the
+reference ships no tracing and no metrics exporter).
+"""
+
+from .extension import Metrics
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .tracing import Tracer, disable_tracing, enable_tracing, get_tracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Metrics",
+    "MetricsRegistry",
+    "Tracer",
+    "disable_tracing",
+    "enable_tracing",
+    "get_tracer",
+]

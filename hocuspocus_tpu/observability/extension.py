@@ -1,0 +1,171 @@
+"""`Metrics` extension: lifecycle counters + `/metrics` endpoint.
+
+Fills the observability hole called out in SURVEY.md §5.5 (the reference
+has "No Prometheus/OTel"; its only counters are
+`getDocumentsCount`/`getConnectionsCount`, reference
+`packages/server/src/Hocuspocus.ts:138-160`). Add to a server like any
+other extension::
+
+    Server(extensions=[Metrics()])
+
+and scrape `GET /metrics`. Load/store latencies are measured between the
+on_*/after_* hook pairs; live gauges (connections, documents) read the
+instance at scrape time.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..server.types import Extension, Payload
+from .metrics import MetricsRegistry
+from .tracing import get_tracer
+
+
+class Metrics(Extension):
+    # run before ordinary extensions so latency measurement brackets them
+    priority = 1000
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        path: str = "/metrics",
+        expose_tracer: bool = False,
+    ) -> None:
+        self.registry = registry or MetricsRegistry()
+        self.path = path
+        self.expose_tracer = expose_tracer
+        self._instance = None
+        self._load_started: dict[str, float] = {}
+        self._store_started: dict[str, float] = {}
+
+        reg = self.registry
+        self.connects = reg.counter(
+            "hocuspocus_connects_total", "WebSocket connections accepted"
+        )
+        self.disconnects = reg.counter(
+            "hocuspocus_disconnects_total", "WebSocket connections closed"
+        )
+        self.auth_denied = reg.counter(
+            "hocuspocus_auth_denied_total", "Connections denied by onAuthenticate"
+        )
+        self.changes = reg.counter(
+            "hocuspocus_document_changes_total", "Document change events"
+        )
+        self.loads = reg.counter(
+            "hocuspocus_document_loads_total", "Documents loaded into memory"
+        )
+        self.stores = reg.counter(
+            "hocuspocus_document_stores_total", "Document store (persist) events"
+        )
+        self.unloads = reg.counter(
+            "hocuspocus_document_unloads_total", "Documents unloaded from memory"
+        )
+        self.awareness_updates = reg.counter(
+            "hocuspocus_awareness_updates_total", "Awareness update events"
+        )
+        self.stateless = reg.counter(
+            "hocuspocus_stateless_messages_total", "Stateless messages received"
+        )
+        self.http_requests = reg.counter(
+            "hocuspocus_http_requests_total", "Non-websocket HTTP requests"
+        )
+        self.load_seconds = reg.histogram(
+            "hocuspocus_document_load_seconds", "onLoadDocument → afterLoadDocument"
+        )
+        self.store_seconds = reg.histogram(
+            "hocuspocus_document_store_seconds", "onStoreDocument → afterStoreDocument"
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def on_configure(self, data: Payload) -> None:
+        instance = data.instance
+        self._instance = instance
+        self.registry.gauge(
+            "hocuspocus_documents",
+            "Documents currently in memory",
+            fn=lambda: instance.get_documents_count(),
+        )
+        self.registry.gauge(
+            "hocuspocus_connections",
+            "Open connections (websocket + direct)",
+            fn=lambda: instance.get_connections_count(),
+        )
+
+    async def connected(self, data: Payload) -> None:
+        self.connects.inc()
+
+    async def on_disconnect(self, data: Payload) -> None:
+        self.disconnects.inc()
+
+    async def on_change(self, data: Payload) -> None:
+        self.changes.inc()
+
+    async def on_load_document(self, data: Payload) -> None:
+        self._load_started[data.document_name] = time.perf_counter()
+
+    async def after_load_document(self, data: Payload) -> None:
+        self.loads.inc()
+        started = self._load_started.pop(data.document_name, None)
+        if started is not None:
+            self.load_seconds.observe(time.perf_counter() - started)
+
+    async def on_store_document(self, data: Payload) -> None:
+        self._store_started[data.document_name] = time.perf_counter()
+
+    async def after_store_document(self, data: Payload) -> None:
+        self.stores.inc()
+        started = self._store_started.pop(data.document_name, None)
+        if started is not None:
+            self.store_seconds.observe(time.perf_counter() - started)
+
+    async def after_unload_document(self, data: Payload) -> None:
+        self.unloads.inc()
+        self._load_started.pop(data.document_name, None)
+        self._store_started.pop(data.document_name, None)
+
+    async def on_awareness_update(self, data: Payload) -> None:
+        self.awareness_updates.inc()
+
+    async def on_stateless(self, data: Payload) -> None:
+        self.stateless.inc()
+
+    # -- scrape endpoint ---------------------------------------------------
+
+    async def on_request(self, data: Payload) -> None:
+        request = data.request
+        path = getattr(getattr(request, "rel_url", None), "path", None) or getattr(
+            request, "path", ""
+        )
+        if path != self.path:
+            self.http_requests.inc()
+            return
+        body = self.registry.expose()
+        if self.expose_tracer:
+            import json
+
+            spans = get_tracer().export()
+            body += "\n# tracer\n" + "\n".join(
+                "# " + json.dumps(span) for span in spans[-100:]
+            ) + "\n"
+        from aiohttp import web
+
+        data.response = web.Response(
+            text=body, content_type="text/plain", charset="utf-8"
+        )
+        # Raising aborts the rest of the hook chain and the default
+        # "Welcome" response; the server serves `data.response` instead
+        # (same mechanism as reference request interception,
+        # `packages/server/src/Server.ts:114-137`).
+        error = _ServeMetrics()
+        error.response = data.response
+        raise error
+
+
+class _ServeMetrics(Exception):
+    """Internal: short-circuits the on_request chain with a response."""
+
+    def __str__(self) -> str:  # suppress hook-chain error logging
+        return ""

@@ -1,0 +1,191 @@
+"""Metrics primitives + Prometheus text exposition.
+
+The reference exposes only ad-hoc counters (`getDocumentsCount`,
+`getConnectionsCount` — reference `packages/server/src/Hocuspocus.ts:138-160`)
+and has "No Prometheus/OTel" (SURVEY.md §5.5). This registry is the
+framework-native replacement: counters, gauges and fixed-bucket
+histograms rendered in the Prometheus text format, served by the
+`Metrics` extension at `/metrics`.
+
+Everything runs on the asyncio event-loop thread; increments are plain
+float adds (no locks needed under the GIL).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterable, Optional, Sequence
+
+
+def _escape_label_value(value: str) -> str:
+    return (
+        str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(value: float) -> str:
+    if value == math.inf:
+        return "+Inf"
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+class Counter:
+    """Monotonically increasing counter, optionally labelled."""
+
+    def __init__(self, name: str, help: str) -> None:
+        self.name = name
+        self.help = help
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        if not self._values:
+            yield f"{self.name} 0"
+            return
+        for key, value in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(value)}"
+
+
+class Gauge:
+    """Settable value; can also track a live callable (e.g. connection
+    counts read straight off the instance at scrape time)."""
+
+    def __init__(
+        self, name: str, help: str, fn: Optional[Callable[[], float]] = None
+    ) -> None:
+        self.name = name
+        self.help = help
+        self._fn = fn
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._value -= amount
+
+    def value(self) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        return self._value
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        yield f"{self.name} {_fmt_value(self.value())}"
+
+
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram (seconds by convention, like Prometheus)."""
+
+    def __init__(
+        self, name: str, help: str, buckets: Sequence[float] = DEFAULT_BUCKETS
+    ) -> None:
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._total = 0
+
+    def observe(self, value: float) -> None:
+        self._sum += value
+        self._total += 1
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                self._counts[i] += 1
+                return
+        self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        cumulative = 0
+        for bound, count in zip(self.buckets, self._counts):
+            cumulative += count
+            yield f'{self.name}_bucket{{le="{_fmt_value(bound)}"}} {cumulative}'
+        cumulative += self._counts[-1]
+        yield f'{self.name}_bucket{{le="+Inf"}} {cumulative}'
+        yield f"{self.name}_sum {_fmt_value(self._sum)}"
+        yield f"{self.name}_count {self._total}"
+
+
+class MetricsRegistry:
+    """Holds metrics and renders the exposition document."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        metric = self._metrics.get(name)
+        if metric is None:
+            metric = Counter(name, help)
+            self._metrics[name] = metric
+        if not isinstance(metric, Counter):
+            raise TypeError(f"metric {name!r} already registered as {type(metric).__name__}")
+        return metric
+
+    def gauge(
+        self, name: str, help: str = "", fn: Optional[Callable[[], float]] = None
+    ) -> Gauge:
+        metric = self._metrics.get(name)
+        if metric is None:
+            metric = Gauge(name, help, fn)
+            self._metrics[name] = metric
+        if not isinstance(metric, Gauge):
+            raise TypeError(f"metric {name!r} already registered as {type(metric).__name__}")
+        if fn is not None:
+            metric._fn = fn
+        return metric
+
+    def histogram(
+        self, name: str, help: str = "", buckets: Sequence[float] = DEFAULT_BUCKETS
+    ) -> Histogram:
+        metric = self._metrics.get(name)
+        if metric is None:
+            metric = Histogram(name, help, buckets)
+            self._metrics[name] = metric
+        if not isinstance(metric, Histogram):
+            raise TypeError(f"metric {name!r} already registered as {type(metric).__name__}")
+        return metric
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].expose())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"

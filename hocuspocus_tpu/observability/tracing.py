@@ -1,0 +1,162 @@
+"""Lightweight span tracing for the server hot path.
+
+The reference has no tracing (SURVEY.md §5.1 — closest is the provider's
+onMessage/onOutgoingMessage taps, reference
+`packages/provider/src/HocuspocusProvider.ts:156-157`, and a commented-out
+message logger in `packages/server/src/MessageReceiver.ts:54-59`). This
+module is the "real tracing" the TPU build adds: per-message spans, hook
+chain spans, and merge-plane device-step spans, exportable as plain dicts
+(one JSON-able event per span) and bridged into the JAX profiler when one
+is active.
+
+Design constraints:
+- Near-zero cost when disabled: one attribute read + truth test per
+  span site, no object allocation.
+- No global locks on the hot path: spans complete on the event loop
+  thread; the ring buffer is a `collections.deque(maxlen=...)` whose
+  append is atomic under the GIL.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+
+class Span:
+    """One completed (or in-flight) span."""
+
+    __slots__ = ("name", "start", "end", "attributes")
+
+    def __init__(self, name: str, attributes: Optional[dict] = None) -> None:
+        self.name = name
+        self.start = time.perf_counter()
+        self.end: Optional[float] = None
+        self.attributes = attributes
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.end is None:
+            return None
+        return (self.end - self.start) * 1000.0
+
+    def set(self, key: str, value: Any) -> None:
+        if self.attributes is None:
+            self.attributes = {}
+        self.attributes[key] = value
+
+    def finish(self) -> "Span":
+        self.end = time.perf_counter()
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "start": self.start,
+            "duration_ms": self.duration_ms,
+            "attributes": self.attributes or {},
+        }
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def set(self, key: str, value: Any) -> None:
+        pass
+
+    def finish(self) -> "_NoopSpan":
+        return self
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Collects spans into a bounded ring buffer.
+
+    Usage::
+
+        tracer = Tracer(enabled=True)
+        with tracer.span("message.apply", doc="report") as sp:
+            ...
+            sp.set("bytes", 123)
+        tracer.export()  # -> list of dicts, oldest first
+    """
+
+    def __init__(self, enabled: bool = True, max_spans: int = 4096) -> None:
+        self.enabled = enabled
+        self._spans: deque[Span] = deque(maxlen=max_spans)
+        self._jax_annotation = None  # lazily resolved TraceAnnotation class
+
+    # -- recording ---------------------------------------------------------
+
+    @contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[Any]:
+        if not self.enabled:
+            yield _NOOP_SPAN
+            return
+        sp = Span(name, attributes or None)
+        try:
+            yield sp
+        finally:
+            self._spans.append(sp.finish())
+
+    @contextmanager
+    def device_span(self, name: str, **attributes: Any) -> Iterator[Any]:
+        """A span that also shows up in a `jax.profiler` trace when one is
+        being captured (merge-plane device steps)."""
+        if not self.enabled:
+            yield _NOOP_SPAN
+            return
+        annotation = self._resolve_jax_annotation()
+        if annotation is None:
+            with self.span(name, **attributes) as sp:
+                yield sp
+            return
+        with annotation(name), self.span(name, **attributes) as sp:
+            yield sp
+
+    def _resolve_jax_annotation(self):
+        if self._jax_annotation is None:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._jax_annotation = TraceAnnotation
+            except Exception:
+                self._jax_annotation = False
+        return self._jax_annotation or None
+
+    # -- reading -----------------------------------------------------------
+
+    def export(self, clear: bool = False) -> list[dict]:
+        spans = [sp.to_dict() for sp in self._spans]
+        if clear:
+            self._spans.clear()
+        return spans
+
+    def clear(self) -> None:
+        self._spans.clear()
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+
+# The default tracer every instrumentation site uses. Disabled by default:
+# span sites cost one attribute read + branch until somebody enables it.
+_default = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def enable_tracing(max_spans: int = 4096) -> Tracer:
+    _default.enabled = True
+    _default._spans = deque(_default._spans, maxlen=max_spans)
+    return _default
+
+
+def disable_tracing() -> None:
+    _default.enabled = False

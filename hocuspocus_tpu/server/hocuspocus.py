@@ -14,6 +14,7 @@ from typing import Any, Callable, Optional
 
 from .. import __version__
 from ..crdt import Doc, apply_update, encode_state_as_update
+from ..observability.tracing import get_tracer
 from ..protocol.awareness import awareness_states_to_array
 from ..protocol.close_events import RESET_CONNECTION
 from . import logger
@@ -103,6 +104,13 @@ class Hocuspocus:
         propagates. `callback` runs after each extension with its return
         value (used for context merging).
         """
+        tracer = get_tracer()
+        if tracer.enabled:
+            with tracer.span(f"hooks.{name}"):
+                return await self._run_hooks(name, payload, callback)
+        return await self._run_hooks(name, payload, callback)
+
+    async def _run_hooks(self, name: str, payload: Payload, callback: Optional[Callable]) -> Any:
         result: Any = None
         for extension in getattr(self, "_extensions", []):
             handler = getattr(extension, name, None)

@@ -20,6 +20,7 @@ from ..protocol.sync import (
     read_sync_step2,
     read_update,
 )
+from ..observability.tracing import get_tracer
 from .document import Document
 from . import logger as _logger_mod
 
@@ -35,8 +36,28 @@ class MessageReceiver:
         connection=None,
         reply: Optional[Callable[[bytes], None]] = None,
     ) -> None:
+        tracer = get_tracer()
+        if tracer.enabled:
+            with tracer.span(
+                "message.apply",
+                document=document.name,
+                bytes=len(self.message.decoder.buf),
+            ) as span:
+                await self._apply(document, connection, reply, span)
+        else:
+            await self._apply(document, connection, reply, None)
+
+    async def _apply(
+        self,
+        document: Document,
+        connection=None,
+        reply: Optional[Callable[[bytes], None]] = None,
+        span=None,
+    ) -> None:
         message = self.message
         message_type = message.read_var_uint()
+        if span is not None:
+            span.set("type", int(message_type))
         empty_message_length = message.length
 
         if message_type in (MessageType.Sync, MessageType.SyncReply):

@@ -99,6 +99,9 @@ class MergePlane:
 
     def flush(self) -> int:
         """Integrate queued ops in (K, D) batches. Returns ops integrated."""
+        from ..observability.tracing import get_tracer
+
+        tracer = get_tracer()
         total = 0
         while self.pending_ops() > 0:
             needed = min(
@@ -110,8 +113,11 @@ class MergePlane:
             while k < needed:
                 k *= 2
             ops = self._build_batch(k)
-            self.state, count = integrate_op_slots(self.state, ops)
-            total += int(count)
+            with tracer.device_span("merge_plane.integrate", slots=k) as span:
+                self.state, count = integrate_op_slots(self.state, ops)
+                count = int(count)
+                span.set("integrated", count)
+            total += count
         self.total_integrated += total
         return total
 

@@ -27,6 +27,10 @@ import inspect
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio_auto: run coroutine test via asyncio.run")
+
+
 def pytest_collection_modifyitems(items):
     for item in items:
         if inspect.iscoroutinefunction(getattr(item, "function", None)):

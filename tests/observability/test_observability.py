@@ -1,0 +1,162 @@
+"""Observability: tracer spans, metrics registry, /metrics endpoint.
+
+The reference has no equivalent subsystem (SURVEY.md §5.1/§5.5); these
+tests cover the capability the TPU build adds on top.
+"""
+
+from __future__ import annotations
+
+import aiohttp
+
+from hocuspocus_tpu.observability import (
+    Metrics,
+    MetricsRegistry,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+)
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def test_tracer_records_spans_with_attributes():
+    tracer = Tracer(enabled=True, max_spans=8)
+    with tracer.span("outer", document="doc-a") as span:
+        span.set("bytes", 42)
+    spans = tracer.export()
+    assert len(spans) == 1
+    assert spans[0]["name"] == "outer"
+    assert spans[0]["attributes"] == {"document": "doc-a", "bytes": 42}
+    assert spans[0]["duration_ms"] >= 0
+
+
+def test_tracer_disabled_is_noop():
+    tracer = Tracer(enabled=False)
+    with tracer.span("nope") as span:
+        span.set("ignored", 1)
+    assert len(tracer) == 0
+
+
+def test_tracer_ring_buffer_bounded():
+    tracer = Tracer(enabled=True, max_spans=4)
+    for i in range(10):
+        with tracer.span(f"s{i}"):
+            pass
+    spans = tracer.export()
+    assert len(spans) == 4
+    assert [s["name"] for s in spans] == ["s6", "s7", "s8", "s9"]
+
+
+def test_device_span_works_without_profiler():
+    tracer = Tracer(enabled=True)
+    with tracer.device_span("merge", slots=4) as span:
+        span.set("integrated", 128)
+    assert tracer.export()[0]["attributes"]["integrated"] == 128
+
+
+def test_global_tracer_enable_disable():
+    tracer = enable_tracing(max_spans=16)
+    try:
+        assert get_tracer() is tracer
+        with tracer.span("x"):
+            pass
+        assert len(tracer) == 1
+    finally:
+        disable_tracing()
+        tracer.clear()
+
+
+def test_metrics_counter_and_gauge_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("demo_total", "Demo counter")
+    c.inc()
+    c.inc(2, kind="sync")
+    g = reg.gauge("demo_current", "Demo gauge", fn=lambda: 3)
+    text = reg.expose()
+    assert "# TYPE demo_total counter" in text
+    assert "demo_total 1" in text
+    assert 'demo_total{kind="sync"} 2' in text
+    assert "demo_current 3" in text
+    assert g.value() == 3
+
+
+def test_metrics_histogram_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "Latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.expose()
+    assert 'lat_seconds_bucket{le="0.01"} 1' in text
+    assert 'lat_seconds_bucket{le="0.1"} 2' in text
+    assert 'lat_seconds_bucket{le="1"} 3' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+    assert "lat_seconds_count 4" in text
+    assert h.count == 4
+
+
+async def test_metrics_extension_counts_lifecycle_and_serves_endpoint():
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics])
+    provider = new_provider(server, name="metrics-doc")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "hello")
+
+        await retryable_assertion(lambda: _assert_positive(metrics.changes.value()))
+        assert metrics.connects.value() == 1
+        assert metrics.loads.value() == 1
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/metrics") as response:
+                assert response.status == 200
+                body = await response.text()
+        assert "hocuspocus_connections 1" in body
+        assert "hocuspocus_documents 1" in body
+        assert "hocuspocus_connects_total 1" in body
+        assert "hocuspocus_document_loads_total 1" in body
+        assert "hocuspocus_document_load_seconds_count 1" in body
+
+        # non-metrics requests still get the default response
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/other") as response:
+                assert response.status == 200
+                assert "Welcome" in await response.text()
+        assert metrics.http_requests.value() == 1
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+    assert metrics.disconnects.value() == 1
+    assert metrics.unloads.value() == 1
+
+
+async def test_tracing_captures_message_spans_end_to_end():
+    tracer = enable_tracing(max_spans=512)
+    tracer.clear()
+    server = await new_hocuspocus()
+    provider = new_provider(server, name="traced-doc")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "traced")
+
+        def has_spans():
+            names = {s["name"] for s in tracer.export()}
+            assert "message.apply" in names, names
+            assert any(n.startswith("hooks.") for n in names), names
+
+        await retryable_assertion(has_spans)
+        apply_spans = [
+            s for s in tracer.export() if s["name"] == "message.apply"
+        ]
+        assert all(s["attributes"]["document"] == "traced-doc" for s in apply_spans)
+        assert all(s["attributes"]["bytes"] > 0 for s in apply_spans)
+    finally:
+        disable_tracing()
+        tracer.clear()
+        provider.destroy()
+        await server.destroy()
+
+
+def _assert_positive(value: float) -> None:
+    assert value > 0
