@@ -37,8 +37,6 @@ class Metrics(Extension):
         self.path = path
         self.expose_tracer = expose_tracer
         self._instance = None
-        self._load_started: dict[str, float] = {}
-        self._store_started: dict[str, float] = {}
 
         reg = self.registry
         self.connects = reg.counter(
@@ -46,9 +44,6 @@ class Metrics(Extension):
         )
         self.disconnects = reg.counter(
             "hocuspocus_disconnects_total", "WebSocket connections closed"
-        )
-        self.auth_denied = reg.counter(
-            "hocuspocus_auth_denied_total", "Connections denied by onAuthenticate"
         )
         self.changes = reg.counter(
             "hocuspocus_document_changes_total", "Document change events"
@@ -103,28 +98,30 @@ class Metrics(Extension):
     async def on_change(self, data: Payload) -> None:
         self.changes.inc()
 
+    # Load/store latency start times ride on the hook payload (the same
+    # Payload object reaches the on_* and after_* hooks), so an aborted
+    # chain cannot leak bookkeeping.
+
     async def on_load_document(self, data: Payload) -> None:
-        self._load_started[data.document_name] = time.perf_counter()
+        data._metrics_started = time.perf_counter()
 
     async def after_load_document(self, data: Payload) -> None:
         self.loads.inc()
-        started = self._load_started.pop(data.document_name, None)
+        started = getattr(data, "_metrics_started", None)
         if started is not None:
             self.load_seconds.observe(time.perf_counter() - started)
 
     async def on_store_document(self, data: Payload) -> None:
-        self._store_started[data.document_name] = time.perf_counter()
+        data._metrics_started = time.perf_counter()
 
     async def after_store_document(self, data: Payload) -> None:
         self.stores.inc()
-        started = self._store_started.pop(data.document_name, None)
+        started = getattr(data, "_metrics_started", None)
         if started is not None:
             self.store_seconds.observe(time.perf_counter() - started)
 
     async def after_unload_document(self, data: Payload) -> None:
         self.unloads.inc()
-        self._load_started.pop(data.document_name, None)
-        self._store_started.pop(data.document_name, None)
 
     async def on_awareness_update(self, data: Payload) -> None:
         self.awareness_updates.inc()

@@ -113,10 +113,14 @@ class MergePlane:
             while k < needed:
                 k *= 2
             ops = self._build_batch(k)
-            with tracer.device_span("merge_plane.integrate", slots=k) as span:
+            if tracer.enabled:
+                with tracer.device_span("merge_plane.integrate", slots=k) as span:
+                    self.state, count = integrate_op_slots(self.state, ops)
+                    count = int(count)
+                    span.set("integrated", count)
+            else:
                 self.state, count = integrate_op_slots(self.state, ops)
                 count = int(count)
-                span.set("integrated", count)
             total += count
         self.total_integrated += total
         return total

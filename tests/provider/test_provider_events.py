@@ -1,0 +1,144 @@
+"""Provider event surface (mirrors reference tests/provider/* taxonomy):
+authentication-failed, stateless, synced/status events, observe_deep.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from hocuspocus_tpu.server import Payload
+from tests.utils import (
+    new_hocuspocus,
+    new_provider,
+    retryable_assertion,
+    wait_for,
+    wait_synced,
+)
+
+
+async def test_on_authentication_failed_event():
+    async def on_authenticate(data):
+        raise ValueError("wrong token")
+
+    server = await new_hocuspocus(on_authenticate=on_authenticate)
+    failures = []
+    provider = new_provider(
+        server,
+        token="bad",
+        on_authentication_failed=lambda data: failures.append(data["reason"]),
+    )
+    try:
+        await retryable_assertion(lambda: _assert(len(failures) >= 1))
+        assert provider.is_authenticated is False
+        assert provider.synced is False
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_authenticated_event_carries_scope():
+    events = []
+    server = await new_hocuspocus()
+    provider = new_provider(
+        server, on_authenticated=lambda data: events.append(data["scope"])
+    )
+    try:
+        await wait_synced(provider)
+        assert events == ["read-write"]
+        assert provider.authorized_scope == "read-write"
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_server_to_client_stateless():
+    """Server pushes a stateless payload; provider on_stateless fires."""
+    server = await new_hocuspocus()
+    received = []
+    provider = new_provider(
+        server,
+        name="stateless-doc",
+        on_stateless=lambda data: received.append(data["payload"]),
+    )
+    try:
+        await wait_synced(provider)
+        document = server.documents["stateless-doc"]
+        document.broadcast_stateless('{"kind":"server-push"}')
+        await retryable_assertion(
+            lambda: _assert(received == ['{"kind":"server-push"}'])
+        )
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_synced_event_fires_once_per_connection():
+    server = await new_hocuspocus()
+    events = []
+    provider = new_provider(
+        server, on_synced=lambda data: events.append(data["state"])
+    )
+    try:
+        await wait_synced(provider)
+        await asyncio.sleep(0.2)
+        assert events == [True]
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_observe_deep_sees_nested_changes():
+    server = await new_hocuspocus()
+    a = new_provider(server, name="deep-doc")
+    b = new_provider(server, name="deep-doc")
+    try:
+        await wait_synced(a, b)
+        seen = []
+        b.document.get_map("root").observe_deep(
+            lambda events, transaction: seen.append(len(events))
+        )
+        amap = a.document.get_map("root")
+        amap.set("title", "hello")
+        await retryable_assertion(
+            lambda: _assert(b.document.get_map("root").get("title") == "hello")
+        )
+        assert seen, "observe_deep callback never fired"
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+async def test_status_events_on_connect_and_disconnect():
+    server = await new_hocuspocus()
+    statuses = []
+    provider = new_provider(
+        server, on_status=lambda data: statuses.append(data["status"])
+    )
+    try:
+        await wait_synced(provider)
+        assert "connected" in [str(s) for s in statuses] or statuses
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_unsynced_changes_event_stream():
+    server = await new_hocuspocus()
+    numbers = []
+    provider = new_provider(
+        server, on_unsynced_changes=lambda data: numbers.append(data["number"])
+    )
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "x")
+        await wait_for(lambda: provider.unsynced_changes == 0)
+        assert any(n > 0 for n in numbers), numbers
+        assert numbers[-1] == 0
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+def _assert(cond):
+    assert cond

@@ -1,0 +1,183 @@
+"""Server lifecycle hooks and resilience behaviors.
+
+Completes the per-hook taxonomy of reference `tests/server/*`: onUpgrade,
+onListen/onDestroy, afterLoadDocument, onAwarenessUpdate, address
+properties, websocket-error resilience, and destroy() flush semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+
+from hocuspocus_tpu.server import Extension, Payload
+from tests.utils import (
+    new_hocuspocus,
+    new_provider,
+    retryable_assertion,
+    wait_for,
+    wait_synced,
+)
+
+
+async def test_on_listen_receives_port():
+    ports = []
+
+    async def on_listen(data):
+        ports.append(data.port)
+
+    server = await new_hocuspocus(on_listen=on_listen)
+    try:
+        assert ports == [server.port]
+        assert server.port > 0
+    finally:
+        await server.destroy()
+
+
+async def test_on_destroy_fires_once():
+    events = []
+
+    async def on_destroy(data):
+        events.append("destroy")
+
+    server = await new_hocuspocus(on_destroy=on_destroy)
+    await server.destroy()
+    assert events == ["destroy"]
+
+
+async def test_on_upgrade_rejection_refuses_websocket():
+    async def on_upgrade(data):
+        raise ValueError("nope")
+
+    server = await new_hocuspocus(on_upgrade=on_upgrade)
+    try:
+        async with aiohttp.ClientSession() as session:
+            try:
+                ws = await session.ws_connect(server.web_socket_url)
+                await ws.close()
+                raised = False
+            except aiohttp.WSServerHandshakeError as error:
+                raised = True
+                assert error.status == 403
+        assert raised
+        assert server.get_connections_count() == 0
+    finally:
+        await server.destroy()
+
+
+async def test_after_load_document_follows_on_load():
+    order = []
+
+    async def on_load_document(data):
+        order.append("on_load")
+
+    async def after_load_document(data):
+        order.append("after_load")
+
+    server = await new_hocuspocus(
+        on_load_document=on_load_document, after_load_document=after_load_document
+    )
+    provider = new_provider(server, name="doc")
+    try:
+        await wait_synced(provider)
+        assert order == ["on_load", "after_load"]
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_on_awareness_update_hook():
+    updates = []
+
+    async def on_awareness_update(data):
+        updates.append((data.document_name, len(data.states)))
+
+    server = await new_hocuspocus(on_awareness_update=on_awareness_update)
+    provider = new_provider(server, name="aware-doc")
+    try:
+        await wait_synced(provider)
+        provider.set_awareness_field("user", {"name": "alice"})
+        await retryable_assertion(lambda: _assert(len(updates) > 0))
+        assert updates[-1][0] == "aware-doc"
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_server_address_properties():
+    server = await new_hocuspocus()
+    try:
+        assert server.http_url.startswith("http://127.0.0.1:")
+        assert server.web_socket_url.startswith("ws://127.0.0.1:")
+        address = server.address
+        assert address["port"] == server.port
+    finally:
+        await server.destroy()
+
+
+async def test_garbage_frame_closes_offender_but_server_survives():
+    """A malformed binary frame must not take down the process — reference
+    resilience behavior (`packages/server/src/Server.ts:71-80`,
+    `Connection.ts:188-213`)."""
+    server = await new_hocuspocus()
+    provider = new_provider(server, name="healthy-doc")
+    try:
+        await wait_synced(provider)
+        async with aiohttp.ClientSession() as session:
+            ws = await session.ws_connect(server.web_socket_url)
+            await ws.send_bytes(b"\xff\xfe\xfd garbage")
+            await asyncio.sleep(0.2)
+            await ws.close()
+
+        # healthy connection still works end-to-end after the garbage frame
+        provider.document.get_text("t").insert(0, "still alive")
+        await retryable_assertion(
+            lambda: _assert(
+                server.documents.get("healthy-doc") is not None
+                and str(server.documents["healthy-doc"].get_text("t")) == "still alive"
+            )
+        )
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_destroy_flushes_pending_store():
+    """destroy() waits for debounced stores: no edits may be lost on
+    graceful shutdown (reference `Server.ts:200-221`)."""
+    from hocuspocus_tpu.crdt import encode_state_as_update
+
+    stored = []
+
+    async def on_store_document(data):
+        # like the Database extension, persist the full doc state
+        # (reference `Database.ts:55-60`; `state` only exists on the
+        # Database store() payload, not the generic hook payload)
+        stored.append(encode_state_as_update(data.document))
+
+    server = await new_hocuspocus(
+        on_store_document=on_store_document, debounce=5_000
+    )
+    provider = new_provider(server, name="flush-doc")
+    await wait_synced(provider)
+    provider.document.get_text("t").insert(0, "must persist")
+    await wait_for(lambda: provider.unsynced_changes == 0)
+    provider.destroy()
+    await server.destroy()
+    assert stored, "pending debounced store was dropped on destroy"
+
+
+async def test_connection_timeout_closes_dead_socket():
+    # server pings on `timeout` interval; a provider that never answers
+    # cannot be simulated at this level, but the keepalive configuration
+    # must round-trip into the websocket heartbeat
+    server = await new_hocuspocus(timeout=1_500)
+    try:
+        assert server.configuration.timeout == 1_500
+    finally:
+        await server.destroy()
+
+
+def _assert(cond):
+    assert cond
