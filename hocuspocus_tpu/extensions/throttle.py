@@ -67,7 +67,9 @@ class Throttle(Extension):
                 del self.banned_ips[ip]
 
     def is_banned(self, ip: str) -> bool:
-        banned_at = self.banned_ips.get(ip, 0)
+        banned_at = self.banned_ips.get(ip)
+        if banned_at is None:
+            return False
         return time.monotonic() < banned_at + self.ban_time * 60
 
     def _throttle(self, ip: str) -> bool:
