@@ -31,12 +31,13 @@ def main() -> None:
     import jax.numpy as jnp
 
     from hocuspocus_tpu.tpu.kernels import (
-        MAX_RUN,
         NONE_CLIENT,
         OpBatch,
         integrate_op_slots,
         make_empty_state,
     )
+
+    MAX_RUN = 16  # UTF-16 units per synthetic insert op (typing-burst sized)
 
     num_docs = int(os.environ.get("BENCH_DOCS", 8192))
     capacity = int(os.environ.get("BENCH_CAPACITY", 2048))
@@ -79,7 +80,6 @@ def main() -> None:
                 left_clock=jnp.maximum(origin - 1, 0),
                 right_client=jnp.full((num_docs,), NONE_CLIENT, jnp.uint32),
                 right_clock=jnp.zeros((num_docs,), jnp.int32),
-                chars=jnp.full((num_docs, MAX_RUN), 97, jnp.int32),
             )
             next_clock = jnp.where(deletes, next_clock, next_clock + MAX_RUN)
             return next_clock, op

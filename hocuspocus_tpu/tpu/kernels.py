@@ -23,17 +23,25 @@ Representation (per document, fixed capacity N — "arena"):
                            searches (origin *ids* are not kept on
                            device — they are write-only for the kernel
                            and live host-side in the lowerer)
-  chars                  — UTF-16 code unit
   deleted                — tombstone flag
   length                 — number of occupied arena slots
   overflow               — capacity exceeded; host falls back to CPU
+
+CHARACTER PAYLOADS LIVE ON THE HOST, not in device state: conflict
+resolution never reads them, and append-only slot assignment is
+deterministic (slot = arrival index), so the host lowerer keeps a
+per-document char log indexed by arena slot (merge_plane.MergePlane).
+Keeping payloads off-device removes ~40% of the per-op HBM traffic and
+unbounds run length: one Yjs string struct of any length is ONE op
+(rank bump by run_len + elementwise slot fill), where a device-side
+chars buffer would force splitting runs into fixed-width pieces.
 
 The YATA conflict rule (Yjs Item.integrate: same-origin siblings ordered
 by ascending client id, nested subtrees skipped transitively) becomes a
 masked reduction over the (leftOrigin, rightOrigin) rank window:
   skip c while origin_rank[c] > L or (origin_rank[c] == L and client[c] < op.client)
 
-Ops are (kind, client, clock, run_len, left id, right id, chars[RUN]):
+Ops are (kind, client, clock, run_len, left id, right id):
   kind 0 = noop, 1 = insert run, 2 = delete id-range.
 Deletes are pure id-range compares — no position work at all.
 
@@ -50,7 +58,6 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-MAX_RUN = 16  # max UTF-16 units per op run; longer runs are split host-side
 NONE_CLIENT = 0xFFFFFFFF  # "no origin" sentinel (client ids are uint32)
 _INF = jnp.int32(0x7FFFFFFF)
 
@@ -66,7 +73,6 @@ class DocState(NamedTuple):
     id_clock: jax.Array  # (D, N) int32
     rank: jax.Array  # (D, N) int32 — logical position
     origin_rank: jax.Array  # (D, N) int32 — rank of left origin (-1 = start)
-    chars: jax.Array  # (D, N) int32 UTF-16 code units
     deleted: jax.Array  # (D, N) bool
     length: jax.Array  # (D,) int32 — occupied slots
     overflow: jax.Array  # (D,) bool
@@ -83,7 +89,6 @@ class OpBatch(NamedTuple):
     left_clock: jax.Array  # int32
     right_client: jax.Array  # uint32 (NONE_CLIENT = doc end)
     right_clock: jax.Array  # int32
-    chars: jax.Array  # (.., MAX_RUN) int32
 
 
 def make_empty_state(num_docs: int, capacity: int) -> DocState:
@@ -95,7 +100,6 @@ def make_empty_state(num_docs: int, capacity: int) -> DocState:
         id_clock=jnp.zeros(shape, jnp.int32),
         rank=jnp.full(shape, _INF, jnp.int32),
         origin_rank=jnp.full(shape, -1, jnp.int32),
-        chars=jnp.zeros(shape, jnp.int32),
         deleted=jnp.zeros(shape, bool),
         length=jnp.zeros((num_docs,), jnp.int32),
         overflow=jnp.zeros((num_docs,), bool),
@@ -113,7 +117,6 @@ def make_noop_batch(num_docs: int) -> OpBatch:
         left_clock=zeros,
         right_client=jnp.full((num_docs,), NONE_CLIENT, jnp.uint32),
         right_clock=zeros,
-        chars=jnp.zeros((num_docs, MAX_RUN), jnp.int32),
     )
 
 
@@ -161,14 +164,6 @@ def _integrate_one(state: DocState, op: OpBatch) -> DocState:
     )
     slot_off = idx - state.length  # 0..run-1 for the new slots
     in_new = do_insert & (slot_off >= 0) & (slot_off < run)
-    off = jnp.clip(slot_off, 0, MAX_RUN - 1)
-    # chars lookup as a broadcast compare+sum: dynamic gathers (even from
-    # a 16-entry table) lower to serialized code on TPU; this stays on
-    # the VPU as selects/reductions
-    run_lane = jnp.arange(MAX_RUN, dtype=jnp.int32)
-    new_chars = jnp.sum(
-        jnp.where(off[:, None] == run_lane[None, :], op.chars[None, :], 0), axis=1
-    )
     is_first = slot_off == 0
 
     id_client = jnp.where(in_new, op.client, state.id_client)
@@ -177,7 +172,6 @@ def _integrate_one(state: DocState, op: OpBatch) -> DocState:
     origin_rank = jnp.where(
         in_new, jnp.where(is_first, left_rank, ins_rank + slot_off - 1), origin_rank_bumped
     )
-    chars = jnp.where(in_new, new_chars, state.chars)
     deleted_after_insert = jnp.where(in_new, False, state.deleted)
 
     # -- delete: id-range tombstones ---------------------------------------
@@ -195,7 +189,6 @@ def _integrate_one(state: DocState, op: OpBatch) -> DocState:
         id_clock=id_clock,
         rank=rank,
         origin_rank=origin_rank,
-        chars=chars,
         deleted=deleted_after_insert | in_del_range,
         length=jnp.where(do_insert, state.length + run, state.length),
         overflow=state.overflow | ((op.kind == KIND_INSERT) & ~fits),

@@ -20,7 +20,7 @@ from ..crdt.encoding import Decoder
 from ..crdt.structs import GC, Item, Skip
 from ..crdt.update import _read_client_struct_refs
 from ..native import get_codec
-from .kernels import KIND_DELETE, KIND_INSERT, MAX_RUN, NONE_CLIENT
+from .kernels import KIND_DELETE, KIND_INSERT, NONE_CLIENT
 
 # struct kinds produced by decoding (matching the native codec)
 STRUCT_STRING = 0
@@ -155,23 +155,22 @@ class DocLowerer:
         right_client, right_clock = (
             struct.right_origin if struct.right_origin is not None else (NONE_CLIENT, 0)
         )
-        offset = 0
-        while offset < len(units):
-            piece = units[offset : offset + MAX_RUN]
-            out.append(
-                DenseOp(
-                    kind=KIND_INSERT,
-                    client=client,
-                    clock=clock + offset,
-                    run_len=len(piece),
-                    left_client=left_client if offset == 0 else client,
-                    left_clock=left_clock if offset == 0 else clock + offset - 1,
-                    right_client=right_client,
-                    right_clock=right_clock,
-                    chars=tuple(piece),
-                )
+        # one op per struct regardless of run length: char payloads are
+        # host-side (MergePlane.char_logs), so the kernel's run width is
+        # unbounded — a rank bump + elementwise slot fill
+        out.append(
+            DenseOp(
+                kind=KIND_INSERT,
+                client=client,
+                clock=clock,
+                run_len=len(units),
+                left_client=left_client,
+                left_clock=left_clock,
+                right_client=right_client,
+                right_clock=right_clock,
+                chars=tuple(units),
             )
-            offset += len(piece)
+        )
         if struct.kind == STRUCT_DELETED:
             out.append(
                 DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=len(units))

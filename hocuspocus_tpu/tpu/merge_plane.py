@@ -18,7 +18,7 @@ import numpy as np
 from ..server.types import Extension, Payload
 from .kernels import (
     DocState,
-    MAX_RUN,
+    KIND_INSERT,
     NONE_CLIENT,
     OpBatch,
     extract_live_mask,
@@ -40,6 +40,11 @@ class MergePlane:
         self.free: list[int] = list(range(num_docs - 1, -1, -1))
         self.lowerers: dict[int, DocLowerer] = {}
         self.queues: dict[int, list[DenseOp]] = {}
+        # char payloads never touch the device: slot assignment in the
+        # append-only arena is deterministic (arena slot = arrival
+        # index), so shipped insert payloads land here, indexed by slot
+        self.char_logs: dict[int, list[int]] = {}
+        self.projected_len: dict[int, int] = {}
         self.total_integrated = 0
 
     # -- registry ----------------------------------------------------------
@@ -53,6 +58,8 @@ class MergePlane:
         self.slots[name] = slot
         self.lowerers[slot] = DocLowerer()
         self.queues[slot] = []
+        self.char_logs[slot] = []
+        self.projected_len[slot] = 0
         return slot
 
     def release(self, name: str) -> None:
@@ -61,6 +68,8 @@ class MergePlane:
             return
         self.lowerers.pop(slot, None)
         self.queues.pop(slot, None)
+        self.char_logs.pop(slot, None)
+        self.projected_len.pop(slot, None)
         self._clear_slot(slot)
         self.free.append(slot)
 
@@ -90,7 +99,20 @@ class MergePlane:
         lowerer = self.lowerers[slot]
         if lowerer.unsupported:
             return
-        self.queues[slot].extend(lowerer.lower_update(update))
+        ops = lowerer.lower_update(update)
+        # host-side mirror of the device capacity check: the lowerer
+        # guarantees causal readiness, so inserts succeed until the
+        # arena overflows — at which point the doc is CPU-only forever;
+        # stop queueing (and logging payloads) instead of leaking
+        projected = self.projected_len[slot] + sum(
+            op.run_len for op in ops if op.kind == KIND_INSERT
+        )
+        if projected > self.capacity:
+            lowerer.unsupported = True
+            self.queues[slot].clear()
+            return
+        self.projected_len[slot] = projected
+        self.queues[slot].extend(ops)
 
     def pending_ops(self) -> int:
         return sum(len(q) for q in self.queues.values())
@@ -135,10 +157,10 @@ class MergePlane:
         left_clock = np.zeros((k, d), np.int32)
         right_client = np.full((k, d), NONE_CLIENT, np.uint32)
         right_clock = np.zeros((k, d), np.int32)
-        chars = np.zeros((k, d, MAX_RUN), np.int32)
         for slot, queue in self.queues.items():
             take = queue[:k]
             del queue[:k]
+            log = self.char_logs[slot]
             for i, op in enumerate(take):
                 kind[i, slot] = op.kind
                 client[i, slot] = op.client
@@ -148,8 +170,8 @@ class MergePlane:
                 left_clock[i, slot] = op.left_clock
                 right_client[i, slot] = op.right_client
                 right_clock[i, slot] = op.right_clock
-                for j, ch in enumerate(op.chars[:MAX_RUN]):
-                    chars[i, slot, j] = ch
+                if op.kind == KIND_INSERT:  # payload goes to the host log
+                    log.extend(op.chars)
         import jax.numpy as jnp
 
         return OpBatch(
@@ -161,7 +183,6 @@ class MergePlane:
             left_clock=jnp.asarray(left_clock),
             right_client=jnp.asarray(right_client),
             right_clock=jnp.asarray(right_clock),
-            chars=jnp.asarray(chars),
         )
 
     # -- extraction --------------------------------------------------------
@@ -180,8 +201,15 @@ class MergePlane:
         slot = self.slots.get(name)
         if slot is None:
             return None
+        if self.lowerers[slot].unsupported:
+            return None  # doc fell back to the CPU path (content/overflow)
         overflow = bool(np.asarray(self.state.overflow)[slot])
         if overflow:
+            return None
+        log = np.asarray(self.char_logs[slot], dtype=np.int64)
+        if len(log) != int(np.asarray(self.state.length)[slot]):
+            # host log and arena desynced (op rejected on device) —
+            # the CPU document stays authoritative
             return None
         live = np.asarray(extract_live_mask(self.state))[slot]
         occupied = np.nonzero(live)[0]
@@ -189,7 +217,7 @@ class MergePlane:
         order = np.argsort(ranks_all)
         sel = occupied[order]
         ranks = ranks_all[order]
-        chars = np.asarray(self.state.chars)[slot][sel]
+        chars = log[sel]
         clients = np.asarray(self.state.id_client)[slot][sel]
         clocks = np.asarray(self.state.id_clock)[slot][sel]
         out: list[int] = []

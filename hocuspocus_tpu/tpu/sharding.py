@@ -39,7 +39,6 @@ def state_sharding(mesh: Mesh) -> DocState:
         id_clock=arena,
         rank=arena,
         origin_rank=arena,
-        chars=arena,
         deleted=arena,
         length=per_doc,
         overflow=per_doc,
@@ -57,7 +56,6 @@ def ops_sharding(mesh: Mesh) -> OpBatch:
         left_clock=slot_doc,
         right_client=slot_doc,
         right_clock=slot_doc,
-        chars=NamedSharding(mesh, P(None, "doc", None)),
     )
 
 

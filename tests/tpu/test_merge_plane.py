@@ -168,7 +168,7 @@ def test_sharded_step_multichip():
         make_sharded_step,
         ops_sharding,
     )
-    from hocuspocus_tpu.tpu.kernels import OpBatch, MAX_RUN
+    from hocuspocus_tpu.tpu.kernels import OpBatch
 
     n = len(jax.devices())
     assert n == 8, f"expected 8 virtual devices, got {n}"
@@ -190,12 +190,10 @@ def test_sharded_step_multichip():
     left_clock = np.zeros((k, d), np.int32)
     right_client = np.full((k, d), NONE_CLIENT, np.uint32)
     right_clock = np.zeros((k, d), np.int32)
-    chars = np.zeros((k, d, MAX_RUN), np.int32)
     for doc_i in range(d):
         kind[0, doc_i] = 1  # insert
         client[0, doc_i] = 42
         run_len[0, doc_i] = 3
-        chars[0, doc_i, :3] = [104 + doc_i, 105, 106]
         kind[1, doc_i] = 2  # delete one unit
         client[1, doc_i] = 42
         clock[1, doc_i] = 1
@@ -209,7 +207,6 @@ def test_sharded_step_multichip():
         left_clock=jnp.asarray(left_clock),
         right_client=jnp.asarray(right_client),
         right_clock=jnp.asarray(right_clock),
-        chars=jnp.asarray(chars),
     )
     op_shards = ops_sharding(mesh)
     ops = OpBatch(*(jax.device_put(f, s) for f, s in zip(ops, op_shards)))
@@ -219,3 +216,24 @@ def test_sharded_step_multichip():
     assert (lengths == 3).all()
     deleted = np.asarray(new_state.deleted)
     assert deleted[:, 1].all() and not deleted[:, 0].any()
+
+
+def test_overflow_stops_queueing_and_logging():
+    """Once a doc can't fit the arena, the plane stops retaining payloads."""
+    plane = MergePlane(num_docs=2, capacity=32)
+    doc = Doc()
+    mirror_doc_updates(plane, "d", doc)
+    text = doc.get_text("t")
+    text.insert(0, "x" * 16)
+    plane.flush()
+    assert plane.text("d") == text.to_string()
+    slot = plane.slots["d"]
+    text.insert(0, "y" * 64)  # exceeds capacity
+    assert not plane.is_supported("d")
+    assert plane.queues[slot] == []
+    log_len = len(plane.char_logs[slot])
+    text.insert(0, "z" * 100)  # further edits must not grow host state
+    assert len(plane.char_logs[slot]) == log_len
+    assert plane.queues[slot] == []
+    plane.flush()
+    assert plane.text("d") is None
