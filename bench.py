@@ -33,9 +33,9 @@ def main() -> None:
     from hocuspocus_tpu.tpu.kernels import (
         NONE_CLIENT,
         OpBatch,
-        integrate_op_slots,
         make_empty_state,
     )
+    from hocuspocus_tpu.tpu.pallas_kernels import integrate_op_slots_fast
 
     MAX_RUN = 16  # UTF-16 units per synthetic insert op (typing-burst sized)
 
@@ -88,6 +88,18 @@ def main() -> None:
         next_clock, ops = jax.lax.scan(one_slot, next_clock, keys)
         return next_clock, ops
 
+    def sync(st):
+        """Content readback of the per-doc lengths (32KB).
+
+        The ONLY reliable completion barrier: block_until_ready on the
+        aliased Pallas outputs can report ready before the kernel runs
+        (observed on the remote-attached runtime), silently turning a
+        throughput loop into a no-op measurement. Reading real content
+        cannot lie — and mirrors the serving flow, where the host reads
+        lengths/overflow back after every flush anyway.
+        """
+        return int(np.asarray(st.length).sum())
+
     key = jax.random.PRNGKey(0)
     state = make_empty_state(num_docs, capacity)
     next_clock = jnp.zeros((num_docs,), jnp.int32)
@@ -97,16 +109,15 @@ def main() -> None:
     seed_slots = max(capacity // 4 // MAX_RUN, 1)
     key, sub = jax.random.split(key)
     next_clock, seed_ops = build_ops(sub, next_clock, seed_slots)
-    state, seed_count = integrate_op_slots(state, seed_ops)
-    int(seed_count)  # block
+    state, seed_count = integrate_op_slots_fast(state, seed_ops)
+    sync(state)
 
     # warmup/compile at the timed shape
     key, sub = jax.random.split(key)
     next_clock, ops = build_ops(sub, next_clock, k)
-    state, count = integrate_op_slots(state, ops)
-    int(count)
+    state, count = integrate_op_slots_fast(state, ops)
+    sync(state)
 
-    # throughput: timed loop with one final blocking readback
     total_ops = 0
     op_batches = []
     for _ in range(steps):
@@ -118,25 +129,26 @@ def main() -> None:
     start = time.perf_counter()
     counts = []
     for ops in op_batches:
-        state, count = integrate_op_slots(state, ops)
+        state, count = integrate_op_slots_fast(state, ops)
         counts.append(count)
-    total_ops = int(sum(int(c) for c in counts))
+    sync(state)
     elapsed = time.perf_counter() - start
+    total_ops = int(sum(int(c) for c in counts))
 
-    # latency: individually timed steps (includes one device round trip,
-    # i.e. merge-to-broadcast-readiness for a micro-batch)
+    # latency: individually timed 8-slot micro-batches, each synced to
+    # host-visible results (= merge-to-broadcast readiness)
     key, sub = jax.random.split(key)
     next_clock, ops = build_ops(sub, next_clock, 8)
-    state, count = integrate_op_slots(state, ops)
-    int(count)  # warm the 8-slot compile out of the latency timings
+    state, count = integrate_op_slots_fast(state, ops)
+    sync(state)  # warm the 8-slot compile
     latencies = []
-    for _ in range(5):
+    for _ in range(20):
         key, sub = jax.random.split(key)
         next_clock, ops = build_ops(sub, next_clock, 8)
         jax.block_until_ready(ops)
         t0 = time.perf_counter()
-        state, count = integrate_op_slots(state, ops)
-        int(count)
+        state, count = integrate_op_slots_fast(state, ops)
+        sync(state)
         latencies.append(time.perf_counter() - t0)
 
     merges_per_sec = total_ops / elapsed

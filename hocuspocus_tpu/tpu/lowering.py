@@ -142,8 +142,6 @@ class DocLowerer:
 
     def _emit_struct(self, struct: LoweredStruct, out: list[DenseOp]) -> None:
         client, clock = struct.client, struct.clock
-        if clock < self.known.get(client, 0):
-            return  # duplicate
         if struct.kind == STRUCT_STRING:
             units = _utf16_units(struct.text or "")
         elif struct.kind == STRUCT_DELETED:
@@ -151,7 +149,17 @@ class DocLowerer:
         else:
             self.unsupported = True
             return
+        known = self.known.get(client, 0)
+        if clock + len(units) <= known:
+            return  # full duplicate
+        # Yjs routinely re-encodes merged items, so a struct may overlap
+        # what we already integrated (clock < known < clock+len): emit
+        # only the unseen tail, whose left origin is the last known unit
+        # (mirrors yjs Item splice-on-offset during readSyncStep2)
+        offset = max(known - clock, 0)
         left_client, left_clock = struct.origin if struct.origin is not None else (NONE_CLIENT, 0)
+        if offset > 0:
+            left_client, left_clock = client, clock + offset - 1
         right_client, right_clock = (
             struct.right_origin if struct.right_origin is not None else (NONE_CLIENT, 0)
         )
@@ -162,16 +170,17 @@ class DocLowerer:
             DenseOp(
                 kind=KIND_INSERT,
                 client=client,
-                clock=clock,
-                run_len=len(units),
+                clock=clock + offset,
+                run_len=len(units) - offset,
                 left_client=left_client,
                 left_clock=left_clock,
                 right_client=right_client,
                 right_clock=right_clock,
-                chars=tuple(units),
+                chars=tuple(units[offset:]),
             )
         )
         if struct.kind == STRUCT_DELETED:
+            # idempotent id-range tombstone over the full struct range
             out.append(
                 DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=len(units))
             )

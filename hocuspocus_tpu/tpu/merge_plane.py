@@ -22,10 +22,10 @@ from .kernels import (
     NONE_CLIENT,
     OpBatch,
     extract_live_mask,
-    integrate_op_slots,
     make_empty_state,
 )
 from .lowering import DenseOp, DocLowerer, units_to_text
+from .pallas_kernels import integrate_op_slots_fast
 
 
 class MergePlane:
@@ -135,13 +135,18 @@ class MergePlane:
             while k < needed:
                 k *= 2
             ops = self._build_batch(k)
+            # int(count) is a sound completion barrier: count is an
+            # output of the SAME executable as the integrate kernel, and
+            # content readback waits for the program (buffer *readiness*
+            # of aliased Pallas outputs is not trustworthy — see
+            # bench.py sync())
             if tracer.enabled:
                 with tracer.device_span("merge_plane.integrate", slots=k) as span:
-                    self.state, count = integrate_op_slots(self.state, ops)
+                    self.state, count = integrate_op_slots_fast(self.state, ops)
                     count = int(count)
                     span.set("integrated", count)
             else:
-                self.state, count = integrate_op_slots(self.state, ops)
+                self.state, count = integrate_op_slots_fast(self.state, ops)
                 count = int(count)
             total += count
         self.total_integrated += total
@@ -208,8 +213,12 @@ class MergePlane:
             return None
         log = np.asarray(self.char_logs[slot], dtype=np.int64)
         if len(log) != int(np.asarray(self.state.length)[slot]):
-            # host log and arena desynced (op rejected on device) —
-            # the CPU document stays authoritative
+            # host log and arena desynced (op rejected on device) — the
+            # CPU document stays authoritative; retire the doc from the
+            # plane so it stops consuming queue/log/kernel resources
+            self.lowerers[slot].unsupported = True
+            self.queues[slot].clear()
+            self.char_logs[slot] = []
             return None
         live = np.asarray(extract_live_mask(self.state))[slot]
         occupied = np.nonzero(live)[0]

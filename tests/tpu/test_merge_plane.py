@@ -237,3 +237,26 @@ def test_overflow_stops_queueing_and_logging():
     assert plane.queues[slot] == []
     plane.flush()
     assert plane.text("d") is None
+
+
+def test_overlapping_snapshot_emits_tail():
+    """A re-enqueued snapshot whose merged items span the known boundary
+    must contribute exactly the unseen tail units (yjs offset splice)."""
+    d = Doc()
+    t = d.get_text("t")
+    t.insert(0, "abc")
+    u1 = encode_state_as_update(d)
+    t.insert(3, "def")
+    full = encode_state_as_update(d)  # items may merge into one struct
+    plane = MergePlane(num_docs=2, capacity=64)
+    plane.register("d")
+    plane.enqueue_update("d", u1)
+    plane.flush()
+    assert plane.text("d") == "abc"
+    plane.enqueue_update("d", full)
+    plane.flush()
+    assert plane.text("d") == "abcdef"
+    # and a pure duplicate is a no-op
+    plane.enqueue_update("d", full)
+    plane.flush()
+    assert plane.text("d") == "abcdef"
