@@ -118,7 +118,6 @@ def main() -> None:
     state, count = integrate_op_slots_fast(state, ops)
     sync(state)
 
-    total_ops = 0
     op_batches = []
     for _ in range(steps):
         key, sub = jax.random.split(key)

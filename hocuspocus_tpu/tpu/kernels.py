@@ -216,7 +216,11 @@ def integrate_op_slots(state: DocState, ops: OpBatch) -> tuple[DocState, jax.Arr
         return _integrate_batch(carry, op_slice), jnp.sum(op_slice.kind != KIND_NOOP)
 
     state, counts = jax.lax.scan(step, state, ops)
-    return state, jnp.sum(counts)
+    # data-depend the count on the final state so fetching it is a
+    # completion barrier for the whole integrate step (callers use
+    # int(count) as their sync point)
+    count, _ = jax.lax.optimization_barrier((jnp.sum(counts), state.length))
+    return state, count
 
 
 @jax.jit

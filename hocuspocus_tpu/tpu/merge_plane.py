@@ -135,11 +135,10 @@ class MergePlane:
             while k < needed:
                 k *= 2
             ops = self._build_batch(k)
-            # int(count) is a sound completion barrier: count is an
-            # output of the SAME executable as the integrate kernel, and
-            # content readback waits for the program (buffer *readiness*
-            # of aliased Pallas outputs is not trustworthy — see
-            # bench.py sync())
+            # int(count) is a sound completion barrier: both integrate
+            # paths data-depend the count on the output state via
+            # lax.optimization_barrier (buffer *readiness* of aliased
+            # Pallas outputs is not trustworthy — see bench.py sync())
             if tracer.enabled:
                 with tracer.device_span("merge_plane.integrate", slots=k) as span:
                     self.state, count = integrate_op_slots_fast(self.state, ops)

@@ -253,7 +253,13 @@ def _integrate_pallas(state: DocState, ops: OpBatch, interpret: bool):
         length=length[:, 0],
         overflow=ovf[:, 0].astype(bool),
     )
-    return new_state, jnp.sum(ops.kind != KIND_NOOP)
+    count = jnp.sum(ops.kind != KIND_NOOP)
+    # tie the count to a kernel output so fetching it is a completion
+    # barrier for the integrate step by DATA DEPENDENCE, not by runtime
+    # program-atomicity assumptions (see bench.py sync() on why buffer
+    # readiness cannot be trusted here)
+    count, _ = jax.lax.optimization_barrier((count, new_state.length))
+    return new_state, count
 
 
 def integrate_op_slots_pallas(

@@ -59,20 +59,60 @@ def ops_sharding(mesh: Mesh) -> OpBatch:
     )
 
 
-def make_sharded_step(mesh: Mesh):
+def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None, interpret: bool = False):
     """Jitted multi-chip integrate step with explicit in/out shardings.
 
     The returned callable takes (DocState, OpBatch with (K, D, ...) op
     slots) and returns (DocState, integrated-op count). The op count is
     a global reduction — XLA lowers it to an all-reduce over the mesh.
+
+    Two lowering strategies:
+    - XLA scan (default off-TPU, and whenever the arena axis is itself
+      sharded): plain jit with shardings; XLA inserts the collectives
+      that the arena-axis reductions need.
+    - Pallas per shard (default on TPU with a doc-only mesh): shard_map
+      over the 'doc' axis runs the VMEM-resident kernel independently
+      on each device's doc shard — zero cross-device traffic in the hot
+      loop, one psum for the global count. Documents never interact, so
+      doc-parallelism is embarrassingly parallel by construction.
     """
-    st_shard = state_sharding(mesh)
-    op_shard = ops_sharding(mesh)
-    count_sharding = NamedSharding(mesh, P())
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and mesh.shape["unit"] == 1
+    if use_pallas and mesh.shape["unit"] != 1:
+        raise ValueError("the Pallas sharded step requires a doc-only mesh")
+
+    if not use_pallas:
+        st_shard = state_sharding(mesh)
+        op_shard = ops_sharding(mesh)
+        count_sharding = NamedSharding(mesh, P())
+        return jax.jit(
+            integrate_op_slots.__wrapped__,  # re-jit with shardings
+            in_shardings=(st_shard, op_shard),
+            out_shardings=(st_shard, count_sharding),
+            donate_argnums=(0,),
+        )
+
+    from .pallas_kernels import integrate_op_slots_pallas
+
+    arena = P("doc", None)
+    per_doc = P("doc")
+    st_spec = DocState(arena, arena, arena, arena, arena, per_doc, per_doc)
+    op_spec_p = P(None, "doc")
+    ops_spec = OpBatch(*([op_spec_p] * 8))
+
+    def local_step(state: DocState, ops: OpBatch):
+        new_state, count = integrate_op_slots_pallas(state, ops, interpret=interpret)
+        return new_state, jax.lax.psum(count, "doc")
+
     return jax.jit(
-        integrate_op_slots.__wrapped__,  # re-jit with shardings
-        in_shardings=(st_shard, op_shard),
-        out_shardings=(st_shard, count_sharding),
+        jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(st_spec, ops_spec),
+            out_specs=(st_spec, P()),
+            # pallas_call out_shapes carry no varying-mesh-axes info
+            check_vma=False,
+        ),
         donate_argnums=(0,),
     )
 

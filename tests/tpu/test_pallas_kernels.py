@@ -15,12 +15,21 @@ from hocuspocus_tpu.tpu.kernels import (
 from hocuspocus_tpu.tpu.pallas_kernels import _pick_block, integrate_op_slots_pallas
 
 
+# one client below 2^31 and one above: same-origin concurrent inserts
+# from these two exercise the YATA client-id tiebreak as an UNSIGNED
+# compare (a signed compare would order them the other way round)
+_CLIENTS = (7, 0x9000_0001)
+
+
 def _random_stream(rng, num_docs, num_slots, next_clock):
-    """Causally-valid single-client op stream with random origins."""
+    """Causally-valid two-client op stream with random origins.
+
+    next_clock has shape (num_clients, num_docs).
+    """
     import jax.numpy as jnp
 
     kind = rng.integers(0, 3, size=(num_slots, num_docs)).astype(np.int32)
-    client = np.full((num_slots, num_docs), 7, np.uint32)
+    client = np.full((num_slots, num_docs), _CLIENTS[0], np.uint32)
     clock = np.zeros((num_slots, num_docs), np.int32)
     run_len = rng.integers(1, 9, size=(num_slots, num_docs)).astype(np.int32)
     lc = np.full((num_slots, num_docs), NONE_CLIENT, np.uint32)
@@ -29,28 +38,36 @@ def _random_stream(rng, num_docs, num_slots, next_clock):
     rk = np.zeros((num_slots, num_docs), np.int32)
     for k in range(num_slots):
         for d in range(num_docs):
+            ci = rng.integers(0, len(_CLIENTS))
             if kind[k, d] == 1:
-                clock[k, d] = next_clock[d]
-                if next_clock[d] > 0:
-                    lc[k, d] = 7
-                    lk[k, d] = rng.integers(0, next_clock[d])
+                client[k, d] = _CLIENTS[ci]
+                clock[k, d] = next_clock[ci, d]
+                known = [(i, c) for i, c in enumerate(next_clock[:, d]) if c > 0]
+                if known:
+                    oi, oc = known[rng.integers(0, len(known))]
+                    lc[k, d] = _CLIENTS[oi]
+                    lk[k, d] = rng.integers(0, oc)
                     if rng.random() < 0.3:
-                        rc[k, d] = 7
-                        rk[k, d] = rng.integers(lk[k, d], next_clock[d])
-                next_clock[d] += run_len[k, d]
+                        ri, rcl = known[rng.integers(0, len(known))]
+                        rc[k, d] = _CLIENTS[ri]
+                        rk[k, d] = rng.integers(0, rcl)
+                next_clock[ci, d] += run_len[k, d]
             elif kind[k, d] == 2:
-                if next_clock[d] == 0:
+                if next_clock[ci, d] == 0:
                     kind[k, d] = 0
                 else:
-                    clock[k, d] = rng.integers(0, next_clock[d])
-                    run_len[k, d] = min(run_len[k, d], next_clock[d] - clock[k, d])
+                    client[k, d] = _CLIENTS[ci]
+                    clock[k, d] = rng.integers(0, next_clock[ci, d])
+                    run_len[k, d] = min(
+                        run_len[k, d], next_clock[ci, d] - clock[k, d]
+                    )
     return OpBatch(*map(jnp.asarray, (kind, client, clock, run_len, lc, lk, rc, rk)))
 
 
 def test_pallas_matches_xla_scan_fuzz():
     rng = np.random.default_rng(7)
     num_docs, capacity, num_slots = 16, 256, 6
-    next_clock = np.zeros(num_docs, np.int64)
+    next_clock = np.zeros((len(_CLIENTS), num_docs), np.int64)
     state_a = make_empty_state(num_docs, capacity)
     state_b = make_empty_state(num_docs, capacity)
     for _ in range(3):
@@ -92,3 +109,38 @@ def test_pick_block_respects_vmem():
     assert _pick_block(8192, 2048) == 64
     assert _pick_block(8192, 32768) in (0, 8)  # huge arenas fall back/shrink
     assert _pick_block(7, 2048) == 0  # indivisible doc counts fall back
+
+
+def test_sharded_pallas_step_matches_xla():
+    """shard_map(pallas) over a doc-only mesh == XLA sharded step."""
+    import jax
+    import numpy as np
+
+    from hocuspocus_tpu.tpu.sharding import (
+        make_mesh,
+        make_sharded_state,
+        make_sharded_step,
+        ops_sharding,
+    )
+
+    assert len(jax.devices()) == 8
+    mesh = make_mesh(doc_axis=8)  # doc-only: unit axis size 1
+    num_docs, capacity, num_slots = 64, 128, 4
+
+    rng = np.random.default_rng(3)
+    next_clock = np.zeros((len(_CLIENTS), num_docs), np.int64)
+    ops = _random_stream(rng, num_docs, num_slots, next_clock)
+    op_shards = ops_sharding(mesh)
+    ops = type(ops)(*(jax.device_put(f, s) for f, s in zip(ops, op_shards)))
+
+    state_x = make_sharded_state(mesh, num_docs, capacity)
+    step_x = make_sharded_step(mesh, use_pallas=False)
+    state_x, count_x = step_x(state_x, ops)
+
+    state_p = make_sharded_state(mesh, num_docs, capacity)
+    step_p = make_sharded_step(mesh, use_pallas=True, interpret=True)
+    state_p, count_p = step_p(state_p, ops)
+
+    assert int(count_x) == int(count_p)
+    for name, a, b in zip(state_x._fields, state_x, state_p):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
