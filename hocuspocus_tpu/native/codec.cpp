@@ -20,6 +20,18 @@
 //     deletes: list of (client, clock, length)
 //   utf16_len(str) -> int      (JS string .length semantics)
 //
+// Wire-frame hot path (reference IncomingMessage/OutgoingMessage,
+// `packages/server/src/OutgoingMessage.ts:24-28` frame layout
+// [varString documentName][varUint msgType][payload]):
+//   parse_frame_header(bytes) -> (document_name, msg_type, offset)
+//     one call replacing the per-message Python varint reads used for
+//     routing (ClientConnection.messageHandler) and dispatch
+//   build_update_frame(name, update, reply) -> bytes
+//     the broadcast frame [name][Sync|SyncReply][yjsUpdate][update] —
+//     built once per document update (Document.handleUpdate fan-out)
+//   build_sync_status_frame(name, ok) -> bytes
+//     the per-update durability ack [name][SyncStatus][0|1]
+//
 // Build: g++ -O2 -shared -fPIC (see build.py); no external deps.
 
 #define PY_SSIZE_T_CLEAN
@@ -56,13 +68,25 @@ struct Reader {
         }
     }
 
+    // Validate an untrusted varuint length against the remaining bytes
+    // BEFORE any signed cast: a length near 2^64 cast to Py_ssize_t
+    // goes negative and would slip past a `pos + n > len` check,
+    // turning a 10-byte pre-auth frame into an out-of-bounds read.
+    Py_ssize_t checked_len(uint64_t n) {
+        if (n > static_cast<uint64_t>(len - pos))
+            throw std::runtime_error("length prefix exceeds buffer");
+        return static_cast<Py_ssize_t>(n);
+    }
+
     void skip(Py_ssize_t n) {
-        if (pos + n > len) throw std::runtime_error("unexpected end of buffer");
+        if (n < 0 || pos + n > len)
+            throw std::runtime_error("unexpected end of buffer");
         pos += n;
     }
 
     const char* bytes(Py_ssize_t n) {
-        if (pos + n > len) throw std::runtime_error("unexpected end of buffer");
+        if (n < 0 || pos + n > len)
+            throw std::runtime_error("unexpected end of buffer");
         const char* p = reinterpret_cast<const char*>(buf + pos);
         pos += n;
         return p;
@@ -70,19 +94,13 @@ struct Reader {
 
     // lib0 readVarString: utf-8 bytes with varuint length prefix
     std::pair<const char*, Py_ssize_t> var_string() {
-        Py_ssize_t n = static_cast<Py_ssize_t>(var_uint());
+        Py_ssize_t n = checked_len(var_uint());
         return {bytes(n), n};
     }
 
-    void skip_var_string() {
-        Py_ssize_t n = static_cast<Py_ssize_t>(var_uint());
-        skip(n);
-    }
+    void skip_var_string() { skip(checked_len(var_uint())); }
 
-    void skip_var_bytes() {
-        Py_ssize_t n = static_cast<Py_ssize_t>(var_uint());
-        skip(n);
-    }
+    void skip_var_bytes() { skip(checked_len(var_uint())); }
 
     // lib0 readAny (tags 116-127) — value discarded, cursor advanced
     void skip_any() {
@@ -307,10 +325,94 @@ PyObject* utf16_len(PyObject* /*self*/, PyObject* arg) {
     return PyLong_FromSsize_t(utf8_to_utf16_len(s, n));
 }
 
+// lib0 writeVarUint: 7-bit groups, little-endian, continuation bit 0x80
+void put_var_uint(std::string& out, uint64_t num) {
+    while (num > 0x7F) {
+        out.push_back(static_cast<char>(0x80 | (num & 0x7F)));
+        num >>= 7;
+    }
+    out.push_back(static_cast<char>(num));
+}
+
+void put_var_string(std::string& out, const char* s, Py_ssize_t n) {
+    put_var_uint(out, static_cast<uint64_t>(n));
+    out.append(s, static_cast<size_t>(n));
+}
+
+constexpr uint64_t MSG_SYNC = 0;
+constexpr uint64_t MSG_SYNC_REPLY = 4;
+constexpr uint64_t MSG_SYNC_STATUS = 8;
+constexpr uint64_t MSG_YJS_UPDATE = 2;
+
+PyObject* parse_frame_header(PyObject* /*self*/, PyObject* arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return nullptr;
+    Reader r{static_cast<const uint8_t*>(view.buf), view.len};
+    PyObject* result = nullptr;
+    try {
+        auto [p, n] = r.var_string();
+        uint64_t msg_type = r.var_uint();
+        // strict decode like the Python Decoder.read_var_string: both
+        // paths must reject an invalid-UTF-8 name the same way
+        PyObject* name = PyUnicode_DecodeUTF8(p, n, nullptr);
+        if (!name) {
+            PyErr_Clear();
+            throw std::runtime_error("invalid utf-8 in document name");
+        }
+        result = Py_BuildValue("(NKn)", name, msg_type, r.pos);
+    } catch (const std::exception& e) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError, e.what());
+        return nullptr;
+    }
+    PyBuffer_Release(&view);
+    return result;
+}
+
+PyObject* build_update_frame(PyObject* /*self*/, PyObject* args) {
+    const char* name;
+    Py_ssize_t name_len;
+    Py_buffer update;
+    int reply = 0;
+    if (!PyArg_ParseTuple(args, "s#y*|p", &name, &name_len, &update, &reply))
+        return nullptr;
+    std::string out;
+    out.reserve(static_cast<size_t>(name_len + update.len) + 12);
+    put_var_string(out, name, name_len);
+    put_var_uint(out, reply ? MSG_SYNC_REPLY : MSG_SYNC);
+    put_var_uint(out, MSG_YJS_UPDATE);
+    put_var_uint(out, static_cast<uint64_t>(update.len));
+    out.append(static_cast<const char*>(update.buf),
+               static_cast<size_t>(update.len));
+    PyBuffer_Release(&update);
+    return PyBytes_FromStringAndSize(out.data(),
+                                     static_cast<Py_ssize_t>(out.size()));
+}
+
+PyObject* build_sync_status_frame(PyObject* /*self*/, PyObject* args) {
+    const char* name;
+    Py_ssize_t name_len;
+    int ok = 0;
+    if (!PyArg_ParseTuple(args, "s#p", &name, &name_len, &ok)) return nullptr;
+    std::string out;
+    out.reserve(static_cast<size_t>(name_len) + 8);
+    put_var_string(out, name, name_len);
+    put_var_uint(out, MSG_SYNC_STATUS);
+    put_var_uint(out, ok ? 1 : 0);
+    return PyBytes_FromStringAndSize(out.data(),
+                                     static_cast<Py_ssize_t>(out.size()));
+}
+
 PyMethodDef methods[] = {
     {"decode_update", decode_update, METH_O,
      "Decode a Yjs v1 update into (structs, deletes) tuples."},
     {"utf16_len", utf16_len, METH_O, "UTF-16 code unit count of a string."},
+    {"parse_frame_header", parse_frame_header, METH_O,
+     "Parse [varString name][varUint type] -> (name, type, offset)."},
+    {"build_update_frame", build_update_frame, METH_VARARGS,
+     "Build [name][Sync|SyncReply][yjsUpdate][update] broadcast frame."},
+    {"build_sync_status_frame", build_sync_status_frame, METH_VARARGS,
+     "Build [name][SyncStatus][0|1] durability ack frame."},
     {nullptr, nullptr, 0, nullptr},
 };
 

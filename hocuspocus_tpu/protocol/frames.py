@@ -1,0 +1,55 @@
+"""Hot-path wire-frame helpers: native C++ fast path, Python fallback.
+
+These cover the three per-message operations the server performs most:
+routing (header parse), the update broadcast frame, and the per-update
+durability ack (reference `packages/server/src/OutgoingMessage.ts`
+frame layout; `Document.ts:228-240` fan-out; `MessageReceiver.ts:206-212`
+ack). The pure-Python codec remains the correctness reference — the
+native functions are byte-identical (tests/protocol/test_frames.py).
+"""
+
+from __future__ import annotations
+
+from ..crdt.encoding import Decoder, Encoder
+from ..native import get_codec
+from .sync import MESSAGE_YJS_UPDATE
+
+
+def parse_frame_header(data: bytes) -> tuple[str, int, int]:
+    """[varString name][varUint type] -> (name, type, payload offset)."""
+    codec = get_codec()
+    if codec is not None:
+        return codec.parse_frame_header(data)
+    decoder = Decoder(data)
+    name = decoder.read_var_string()
+    msg_type = decoder.read_var_uint()
+    return name, msg_type, decoder.pos
+
+
+def build_update_frame(name: str, update: bytes, reply: bool = False) -> bytes:
+    """[name][Sync|SyncReply][yjsUpdate][update] — the broadcast frame."""
+    codec = get_codec()
+    if codec is not None:
+        return codec.build_update_frame(name, update, reply)
+    from .message import MessageType
+
+    encoder = Encoder()
+    encoder.write_var_string(name)
+    encoder.write_var_uint(MessageType.SyncReply if reply else MessageType.Sync)
+    encoder.write_var_uint(MESSAGE_YJS_UPDATE)
+    encoder.write_var_uint8_array(update)
+    return encoder.to_bytes()
+
+
+def build_sync_status_frame(name: str, ok: bool) -> bytes:
+    """[name][SyncStatus][0|1] — the per-update durability ack."""
+    codec = get_codec()
+    if codec is not None:
+        return codec.build_sync_status_frame(name, ok)
+    from .message import MessageType
+
+    encoder = Encoder()
+    encoder.write_var_string(name)
+    encoder.write_var_uint(MessageType.SyncStatus)
+    encoder.write_var_uint(1 if ok else 0)
+    return encoder.to_bytes()

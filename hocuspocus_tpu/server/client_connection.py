@@ -17,6 +17,7 @@ from ..protocol.close_events import (
     RESET_CONNECTION,
     UNAUTHORIZED,
 )
+from ..protocol.frames import parse_frame_header
 from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
 from . import logger
 from .connection import Connection
@@ -187,9 +188,7 @@ class ClientConnection:
 
     async def _handle_queueing_message(self, data: bytes) -> None:
         try:
-            tmp = IncomingMessage(data)
-            document_name = tmp.read_var_string()
-            message_type = tmp.read_var_uint()
+            document_name, message_type, offset = parse_frame_header(data)
 
             if not (
                 message_type == MessageType.Auth
@@ -200,6 +199,8 @@ class ClientConnection:
 
             # The Auth message we have been waiting for.
             self.document_connections_established.add(document_name)
+            tmp = IncomingMessage(data)
+            tmp.decoder.pos = offset
             tmp.read_var_uint()  # auth submessage type (always Token)
             token = tmp.read_var_string()
 
@@ -245,8 +246,9 @@ class ClientConnection:
 
     async def handle_message(self, data: bytes) -> None:
         try:
-            tmp = IncomingMessage(data)
-            document_name = tmp.read_var_string()
+            # native single-call header parse for routing (the per-
+            # message hot path; falls back to the Python codec)
+            document_name, _msg_type, _offset = parse_frame_header(data)
         except Exception as error:
             logger.log_error(f"invalid message payload: {error!r}")
             self.transport.close(UNAUTHORIZED.code, UNAUTHORIZED.reason)

@@ -16,6 +16,7 @@ from ..protocol.awareness import (
     apply_awareness_update,
     remove_awareness_states,
 )
+from ..protocol.frames import build_update_frame
 from ..protocol.message import OutgoingMessage
 
 
@@ -123,8 +124,9 @@ class Document(Doc):
 
     def _handle_update(self, update: bytes, origin: Any, doc, transaction) -> None:
         self.callbacks["on_update"](self, origin, update)
-        message = OutgoingMessage(self.name).create_sync_message().write_update(update)
-        data = message.to_bytes()
+        # broadcast fan-out (reference Document.ts:228-240) — frame built
+        # once by the native codec, sent to every connection
+        data = build_update_frame(self.name, update)
         for connection in self.get_connections():
             connection.send(data)
 

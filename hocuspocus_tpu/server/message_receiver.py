@@ -11,6 +11,7 @@ from typing import Callable, Optional
 
 from ..crdt import snapshot, snapshot_contains_update
 from ..protocol.awareness import apply_awareness_update
+from ..protocol.frames import build_sync_status_frame
 from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
 from ..protocol.sync import (
     MESSAGE_YJS_SYNC_STEP1,
@@ -158,7 +159,7 @@ class MessageReceiver:
                 update = message.read_var_uint8_array()
                 contains = snapshot_contains_update(snap, update)
                 connection.send(
-                    OutgoingMessage(document.name).write_sync_status(contains).to_bytes()
+                    build_sync_status_frame(document.name, contains)
                 )
                 return sync_type
             read_sync_step2(
@@ -168,12 +169,12 @@ class MessageReceiver:
             )
             if connection is not None:
                 connection.send(
-                    OutgoingMessage(document.name).write_sync_status(True).to_bytes()
+                    build_sync_status_frame(document.name, True)
                 )
         elif sync_type == MESSAGE_YJS_UPDATE:
             if connection is not None and connection.read_only:
                 connection.send(
-                    OutgoingMessage(document.name).write_sync_status(False).to_bytes()
+                    build_sync_status_frame(document.name, False)
                 )
                 return sync_type
             read_update(
@@ -183,7 +184,7 @@ class MessageReceiver:
             )
             if connection is not None:
                 connection.send(
-                    OutgoingMessage(document.name).write_sync_status(True).to_bytes()
+                    build_sync_status_frame(document.name, True)
                 )
         else:
             raise ValueError(f"received a sync message with unknown type {sync_type}")

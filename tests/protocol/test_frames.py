@@ -1,0 +1,113 @@
+"""Native wire-frame codec vs the pure-Python reference encoder."""
+
+import pytest
+
+from hocuspocus_tpu.crdt.encoding import Encoder
+from hocuspocus_tpu.native import get_codec
+from hocuspocus_tpu.protocol.frames import (
+    build_sync_status_frame,
+    build_update_frame,
+    parse_frame_header,
+)
+from hocuspocus_tpu.protocol.message import MessageType, OutgoingMessage
+
+
+def _python_update_frame(name: str, update: bytes, reply: bool) -> bytes:
+    msg = OutgoingMessage(name)
+    if reply:
+        msg.create_sync_reply_message()
+    else:
+        msg.create_sync_message()
+    return msg.write_update(update).to_bytes()
+
+
+NAMES = ["doc", "", "näme/ünïcode-😀", "x" * 300]
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_update_frame_matches_python_encoder(name):
+    for update in (b"", b"\x01\x02\x03", bytes(range(256)) * 5):
+        for reply in (False, True):
+            assert build_update_frame(name, update, reply) == _python_update_frame(
+                name, update, reply
+            )
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_sync_status_frame_matches_python_encoder(name):
+    for ok in (True, False):
+        expected = OutgoingMessage(name).write_sync_status(ok).to_bytes()
+        assert build_sync_status_frame(name, ok) == expected
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_parse_frame_header_roundtrip(name):
+    encoder = Encoder()
+    encoder.write_var_string(name)
+    encoder.write_var_uint(MessageType.Awareness)
+    encoder.write_var_uint8_array(b"payload-bytes")
+    data = encoder.to_bytes()
+    parsed_name, msg_type, offset = parse_frame_header(data)
+    assert parsed_name == name
+    assert msg_type == MessageType.Awareness
+    # offset points at the payload
+    tail = Encoder()
+    tail.write_var_uint8_array(b"payload-bytes")
+    assert data[offset:] == tail.to_bytes()
+
+
+def test_native_and_python_paths_agree():
+    codec = get_codec()
+    if codec is None:
+        pytest.skip("native codec unavailable")
+    import hocuspocus_tpu.protocol.frames as frames
+
+    name, update = "agreement-doc", b"\x05\x06\x07" * 40
+    native = (
+        frames.build_update_frame(name, update, False),
+        frames.build_sync_status_frame(name, True),
+        frames.parse_frame_header(frames.build_update_frame(name, update, True)),
+    )
+    # force the Python fallback
+    orig = frames.get_codec
+    frames.get_codec = lambda: None
+    try:
+        fallback = (
+            frames.build_update_frame(name, update, False),
+            frames.build_sync_status_frame(name, True),
+            frames.parse_frame_header(frames.build_update_frame(name, update, True)),
+        )
+    finally:
+        frames.get_codec = orig
+    assert native == fallback
+
+
+def test_parse_frame_header_rejects_garbage():
+    with pytest.raises(Exception):
+        parse_frame_header(b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+
+
+def test_huge_length_prefix_rejected_not_crash():
+    """A varuint name length near 2^64 must raise, not read out of bounds."""
+    hostile = b"\x80" * 9 + b"\x01"  # varuint 2^63 as the name length
+    with pytest.raises(Exception):
+        parse_frame_header(hostile)
+    hostile2 = b"\xff" * 9 + b"\x01"
+    with pytest.raises(Exception):
+        parse_frame_header(hostile2)
+
+
+def test_invalid_utf8_name_rejected_like_python():
+    """Native and Python paths must both reject invalid-UTF-8 names."""
+    import hocuspocus_tpu.protocol.frames as frames
+
+    bad = b"\x02\xff\xfe" + b"\x00"  # 2-byte "name" of invalid UTF-8
+    with pytest.raises(Exception):
+        frames.parse_frame_header(bad)
+    orig = frames.get_codec
+    frames.get_codec = lambda: None
+    try:
+        with pytest.raises(Exception):
+            frames.parse_frame_header(bad)
+    finally:
+        frames.get_codec = orig
