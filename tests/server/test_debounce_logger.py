@@ -1,0 +1,61 @@
+"""Keyed debouncer max-wait semantics and Logger flag filtering.
+
+Mirrors reference test intent for `util/debounce.ts` (delay collapse,
+max-wait force-run, executeNow) and `extension-logger` (per-hook
+on/off flags, injectable sink, `[name ISO-date] message` format).
+"""
+
+import asyncio
+import re
+
+import pytest
+
+from hocuspocus_tpu.extensions.logger import Logger
+from hocuspocus_tpu.server.debounce import Debouncer
+from hocuspocus_tpu.server.types import Payload
+
+
+async def test_debounce_collapses_and_fires_once():
+    debouncer = Debouncer()
+    calls = []
+    for i in range(5):
+        debouncer.debounce("k", lambda i=i: calls.append(i), 30, 10000)
+        await asyncio.sleep(0.005)
+    assert calls == []
+    assert debouncer.is_debounced("k")
+    await asyncio.sleep(0.06)
+    assert calls == [4]  # only the last scheduled fn ran
+    assert not debouncer.is_debounced("k")
+
+
+async def test_max_debounce_forces_run():
+    debouncer = Debouncer()
+    calls = []
+    # keep re-debouncing faster than the delay; max-wait must force a run
+    for _ in range(12):
+        debouncer.debounce("k", lambda: calls.append(1), 50, 100)
+        await asyncio.sleep(0.015)
+    assert calls, "max_debounce never forced the run"
+
+
+async def test_execute_now_runs_pending_and_clears():
+    debouncer = Debouncer()
+    calls = []
+    debouncer.debounce("k", lambda: calls.append(1), 10000, 60000)
+    assert debouncer.is_debounced("k")
+    debouncer.execute_now("k")
+    assert calls == [1]
+    assert not debouncer.is_debounced("k")
+    assert debouncer.execute_now("missing") is None
+
+
+async def test_logger_flags_and_format():
+    lines = []
+    logger = Logger(log=lines.append, on_change=False)
+    logger.name = "srv"
+    await logger.on_change(Payload(document_name="doc"))
+    await logger.on_load_document(Payload(document_name="doc"))
+    text = "\n".join(lines)
+    assert "doc" in text and "Loaded" in text or "load" in text.lower()
+    assert "change" not in text.lower()  # flag off
+    assert all(re.match(r"^\[srv \d{4}-\d{2}-\d{2}T", line) for line in lines)
