@@ -27,6 +27,10 @@ def main() -> None:
 
     # -- part 1: device SV diff -------------------------------------------
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # honor a CPU request even when a TPU plugin hijacks the env var
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from hocuspocus_tpu.tpu.kernels import state_vector_diff
