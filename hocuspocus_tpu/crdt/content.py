@@ -27,11 +27,9 @@ if TYPE_CHECKING:
 
 def utf16_len(s: str) -> int:
     """Length of `s` in UTF-16 code units (JS string .length semantics)."""
-    n = len(s)
-    for ch in s:
-        if ord(ch) > 0xFFFF:
-            n += 1
-    return n
+    if s.isascii():  # C-speed fast path; virtually all real text
+        return len(s)
+    return len(s) + sum(1 for ch in s if ch > "￿")
 
 
 def utf16_index(s: str, offset: int) -> tuple[int, bool]:
@@ -187,13 +185,19 @@ class ContentString(Content):
     ref = 4
     countable = True
 
-    __slots__ = ("s",)
+    __slots__ = ("s", "_len16")
 
     def __init__(self, s: str) -> None:
         self.s = s
+        self._len16 = -1  # lazy UTF-16 length cache; -1 = unknown
 
     def get_length(self) -> int:
-        return utf16_len(self.s)
+        # Item.length hits this on every integrate/position walk — the
+        # UTF-16 unit count is cached until the string mutates (splice
+        # and merge_with below are the only mutation sites)
+        if self._len16 < 0:
+            self._len16 = utf16_len(self.s)
+        return self._len16
 
     def get_content(self) -> list[Any]:
         # one entry per UTF-16 code unit position is what yjs returns; we
@@ -218,9 +222,14 @@ class ContentString(Content):
             left = self.s[:idx]
             right_s = self.s[idx:]
         self.s = left
+        self._len16 = -1
         return ContentString(right_s)
 
     def merge_with(self, right: Content) -> bool:
+        if self._len16 >= 0 and getattr(right, "_len16", -1) >= 0:
+            self._len16 += right._len16  # type: ignore[attr-defined]
+        else:
+            self._len16 = -1
         self.s = self.s + right.s  # type: ignore[attr-defined]
         return True
 
