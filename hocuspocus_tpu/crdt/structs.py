@@ -89,6 +89,7 @@ class Item:
         "parent",
         "parent_sub",
         "content",
+        "length",
         "deleted",
         "keep",
         "redone",
@@ -113,13 +114,14 @@ class Item:
         self.parent = parent
         self.parent_sub = parent_sub
         self.content = content
+        # maintained, not derived: content.get_length() on every access
+        # dominated integrate/position profiles. Updated at the four
+        # content-mutation sites (integrate-offset, split, merge_with;
+        # gc preserves length).
+        self.length = content.get_length()
         self.deleted = False
         self.keep = False
         self.redone: Optional[ID] = None
-
-    @property
-    def length(self) -> int:
-        return self.content.get_length()
 
     @property
     def countable(self) -> bool:
@@ -195,6 +197,7 @@ class Item:
             self.left = store.get_item_clean_end(transaction, ID(self.id.client, self.id.clock - 1))
             self.origin = self.left.last_id
             self.content = self.content.splice(offset)
+            self.length -= offset
 
         parent = self.parent
         if parent is not None:
@@ -304,6 +307,7 @@ class Item:
             self.parent_sub,
             self.content.splice(diff),
         )
+        self.length = diff
         if self.deleted:
             right.deleted = True
         if self.keep:
@@ -334,6 +338,7 @@ class Item:
         ):
             if right.keep:
                 self.keep = True
+            self.length += right.length
             self.right = right.right
             if self.right is not None:
                 self.right.left = self
