@@ -28,6 +28,11 @@ import numpy as np
 
 def main() -> None:
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # honor a CPU request even when a TPU plugin hijacks the env
+        # var (lets the full bench flow smoke-test off-TPU)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from hocuspocus_tpu.tpu.kernels import (
