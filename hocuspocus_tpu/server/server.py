@@ -12,9 +12,11 @@ import asyncio
 import logging
 from typing import Any, Optional
 
+import aiohttp
 from aiohttp import WSMsgType, web
 
 from . import logger
+from ..protocol.close_events import MESSAGE_TOO_BIG
 from .hocuspocus import Hocuspocus, RequestInfo
 from .types import Configuration, Payload
 
@@ -197,7 +199,13 @@ class Server:
             return web.Response(status=403, text="Forbidden")
 
         heartbeat = max(self.configuration.timeout / 1000, 1)
-        ws = web.WebSocketResponse(heartbeat=heartbeat, autoping=True, max_msg_size=0)
+        # inbound frame cap: oversized frames close with MessageTooBig
+        # (1009) instead of buffering unboundedly
+        ws = web.WebSocketResponse(
+            heartbeat=heartbeat,
+            autoping=True,
+            max_msg_size=self.configuration.stateless_payload_limit,
+        )
         await ws.prepare(request)
         transport = AiohttpWebSocketTransport(ws)
         self._transports.add(transport)
@@ -209,6 +217,10 @@ class Server:
                 if msg.type == WSMsgType.BINARY:
                     await client_connection.handle_message(msg.data)
                 elif msg.type == WSMsgType.ERROR:
+                    if isinstance(ws.exception(), aiohttp.WebSocketError):
+                        await ws.close(
+                            code=MESSAGE_TOO_BIG.code, message=MESSAGE_TOO_BIG.reason.encode()
+                        )
                     break
         except Exception as error:
             logger.log_error(f"websocket error: {error!r}")

@@ -181,3 +181,31 @@ async def test_connection_timeout_closes_dead_socket():
 
 def _assert(cond):
     assert cond
+
+
+async def test_oversized_frame_closes_with_message_too_big():
+    """Frames over stateless_payload_limit close that socket (1009);
+    the server and other clients keep working."""
+    import aiohttp
+
+    from hocuspocus_tpu.server import Configuration, Server
+    from tests.utils import new_provider, wait_for
+
+    server = Server(Configuration(quiet=True, stateless_payload_limit=4096))
+    await server.listen(port=0)
+    try:
+        provider = new_provider(server, name="survivor")
+        await wait_for(lambda: provider.synced)
+
+        session = aiohttp.ClientSession()
+        ws = await session.ws_connect(server.web_socket_url)
+        await ws.send_bytes(b"\x03big\x00" + b"x" * 20000)
+        msg = await ws.receive(timeout=5)
+        assert msg.type in (aiohttp.WSMsgType.CLOSE, aiohttp.WSMsgType.CLOSED)
+        await session.close()
+
+        provider.document.get_text("t").insert(0, "still alive")
+        await wait_for(lambda: not provider.has_unsynced_changes)
+        provider.destroy()
+    finally:
+        await server.destroy()
