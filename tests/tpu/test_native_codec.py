@@ -121,3 +121,66 @@ def test_native_speedup(codec):
     python_time = time.perf_counter() - t0
 
     assert native_time < python_time, (native_time, python_time)
+
+
+def test_corruption_fuzz_native_and_python_agree(codec):
+    """Truncated/bit-flipped updates: both decoders accept or both reject.
+
+    The native decoder faces untrusted bytes (anything a client sends
+    lands here via the merge-plane lowering), so it must never crash
+    and must classify malformed inputs like the Python reference.
+    """
+    from hocuspocus_tpu.tpu import lowering
+
+    rng = random.Random(99)
+    doc = Doc()
+    text = doc.get_text("t")
+    for i in range(30):
+        text.insert(rng.randint(0, len(text)), "word%d " % i)
+        if len(text) > 10 and rng.random() < 0.3:
+            text.delete(rng.randrange(len(text) - 5), 3)
+    update = bytearray(encode_state_as_update(doc))
+
+    def python_decode(data):
+        saved = lowering.get_codec
+        lowering.get_codec = lambda: None
+        try:
+            return lowering._decode_update(bytes(data))
+        finally:
+            lowering.get_codec = saved
+
+    cases = [bytes(update[:n]) for n in range(0, len(update), 7)]
+    for _ in range(150):
+        mutated = bytearray(update)
+        for _ in range(rng.randint(1, 4)):
+            mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+        cases.append(bytes(mutated))
+
+    agree_fail = agree_ok = 0
+    for data in cases:
+        try:
+            native = codec.decode_update(data)
+            native_ok = True
+        except Exception:
+            native_ok = False
+        try:
+            python_decode(data)
+            python_ok = True
+        except Exception:
+            python_ok = False
+        # the decoders need not produce identical struct lists for
+        # *corrupted-but-parseable* inputs (unknown content kinds may
+        # be classified differently), but neither may crash the
+        # process, and a clean input must decode in both
+        if native_ok and python_ok:
+            agree_ok += 1
+        elif not native_ok and not python_ok:
+            agree_fail += 1
+    assert agree_ok + agree_fail >= len(cases) * 0.9, (
+        f"decoders disagreed on {len(cases) - agree_ok - agree_fail} of {len(cases)}"
+    )
+    # and the pristine update decodes identically
+    n_structs, n_deletes = codec.decode_update(bytes(update))
+    p_structs, p_deletes = python_decode(bytes(update))
+    assert len(n_structs) == len(p_structs)
+    assert sorted(tuple(d) for d in n_deletes) == sorted(tuple(d) for d in p_deletes)
