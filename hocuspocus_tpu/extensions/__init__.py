@@ -1,4 +1,5 @@
 from .database import Database
+from .incremental import IncrementalSQLite
 from .logger import Logger
 from .redis import Redis
 from .s3 import S3, S3Client
@@ -8,6 +9,7 @@ from .webhook import Events, Webhook
 
 __all__ = [
     "Database",
+    "IncrementalSQLite",
     "Logger",
     "Redis",
     "S3",

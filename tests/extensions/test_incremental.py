@@ -1,0 +1,83 @@
+"""Incremental append-log persistence: deltas, reload, compaction."""
+
+import os
+import tempfile
+
+import pytest
+
+from hocuspocus_tpu.extensions import IncrementalSQLite
+from tests.utils import new_hocuspocus, new_provider, wait_for
+
+
+@pytest.fixture
+def db_path():
+    fd, path = tempfile.mkstemp(suffix=".sqlite")
+    os.close(fd)
+    yield path
+    os.unlink(path)
+
+
+async def _edit_and_flush(provider, text_value):
+    provider.document.get_text("t").insert(0, text_value)
+    await wait_for(lambda: not provider.has_unsynced_changes)
+
+
+async def test_incremental_store_appends_deltas_and_reloads(db_path):
+    ext = IncrementalSQLite(database=db_path, compact_after=64)
+    server = await new_hocuspocus(extensions=[ext], debounce=0)
+    provider = new_provider(server, name="inc-doc")
+    await wait_for(lambda: provider.synced)
+    for word in ("alpha ", "beta ", "gamma "):
+        await _edit_and_flush(provider, word)
+    await wait_for(lambda: ext.log_length("inc-doc") >= 2)
+    rows_before = ext.log_length("inc-doc")
+    assert rows_before >= 2, "stores did not append deltas"
+    content = provider.document.get_text("t").to_string()
+    provider.destroy()
+    # simulate a restart: fresh server sharing the same database handle
+    server2 = await new_hocuspocus(extensions=[ext], debounce=0)
+    p2 = new_provider(server2, name="inc-doc")
+    await wait_for(lambda: p2.synced)
+    assert p2.document.get_text("t").to_string() == content
+    p2.destroy()
+    await server.destroy()
+    await server2.destroy()
+
+
+async def test_compaction_bounds_log_length(db_path):
+    ext = IncrementalSQLite(database=db_path, compact_after=5)
+    server = await new_hocuspocus(extensions=[ext], debounce=0)
+    provider = new_provider(server, name="doc")
+    await wait_for(lambda: provider.synced)
+    for i in range(12):
+        await _edit_and_flush(provider, f"w{i} ")
+        if i == 6:
+            provider.document.get_text("t").delete(0, 3)
+    await wait_for(lambda: ext.log_length("doc") > 0)
+    assert ext.log_length("doc") <= 6, "log never compacted"
+    content = provider.document.get_text("t").to_string()
+    provider.destroy()
+    server2 = await new_hocuspocus(extensions=[ext], debounce=0)
+    p2 = new_provider(server2, name="doc")
+    await wait_for(lambda: p2.synced)
+    assert p2.document.get_text("t").to_string() == content
+    p2.destroy()
+    await server.destroy()
+    await server2.destroy()
+
+
+async def test_empty_delta_not_stored():
+    ext = IncrementalSQLite(database=":memory:")
+    server = await new_hocuspocus(extensions=[ext], debounce=0)
+    provider = new_provider(server, name="doc")
+    await wait_for(lambda: provider.synced)
+    await _edit_and_flush(provider, "only edit")
+    await wait_for(lambda: ext.log_length("doc") == 1)
+    # a store with no changes must not append
+    from hocuspocus_tpu.server.types import Payload
+
+    doc = server.documents["doc"]
+    await ext.on_store_document(Payload(document=doc, document_name="doc"))
+    assert ext.log_length("doc") == 1
+    provider.destroy()
+    await server.destroy()
