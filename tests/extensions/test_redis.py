@@ -177,3 +177,49 @@ async def test_awareness_across_instances():
         await server_a.destroy()
         await server_b.destroy()
         await redis.stop()
+
+
+async def test_tpu_merge_plane_mirrors_across_instances():
+    """Both instances shadow the same doc on their own merge plane; an
+    edit at A must converge on B's DEVICE mirror via the Redis fan-out
+    (provider A -> server A -> redis -> server B -> B's plane)."""
+    from hocuspocus_tpu.tpu.merge_plane import TpuMergeExtension
+
+    redis = await MiniRedis().start()
+    ext_a = TpuMergeExtension(num_docs=4, capacity=512)
+    ext_b = TpuMergeExtension(num_docs=4, capacity=512)
+    server_a = await new_hocuspocus(
+        extensions=[
+            Redis(port=redis.port, identifier="tpu-a", disconnect_delay=100),
+            ext_a,
+        ]
+    )
+    server_b = await new_hocuspocus(
+        extensions=[
+            Redis(port=redis.port, identifier="tpu-b", disconnect_delay=100),
+            ext_b,
+        ]
+    )
+    try:
+        provider_a = new_provider(server_a, name="shared-doc")
+        provider_b = new_provider(server_b, name="shared-doc")
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_text("t").insert(0, "from instance A: hello!")
+        provider_b.document.get_text("t").insert(0, "B says: ")
+
+        def converged():
+            ext_a.plane.flush()
+            ext_b.plane.flush()
+            text_a = ext_a.plane.text("shared-doc")
+            text_b = ext_b.plane.text("shared-doc")
+            cpu = provider_a.document.get_text("t").to_string()
+            _assert(text_a is not None and text_a == text_b == cpu)
+            _assert("hello" in text_a and "B says" in text_a)
+
+        await retryable_assertion(converged)
+        provider_a.destroy()
+        provider_b.destroy()
+    finally:
+        await server_a.destroy()
+        await server_b.destroy()
+        await redis.stop()
