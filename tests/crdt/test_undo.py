@@ -1,0 +1,188 @@
+"""UndoManager: selective undo/redo cooperating with remote edits."""
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.crdt.undo import UndoManager
+
+
+def _sync(a: Doc, b: Doc) -> None:
+    apply_update(b, encode_state_as_update(a))
+    apply_update(a, encode_state_as_update(b))
+
+
+def test_undo_insert_and_redo():
+    doc = Doc()
+    text = doc.get_text("t")
+    um = UndoManager(text, capture_timeout=0)
+    text.insert(0, "hello")
+    text.insert(5, " world")
+    assert um.can_undo()
+    um.undo()
+    assert text.to_string() == "hello"
+    um.undo()
+    assert text.to_string() == ""
+    assert not um.can_undo()
+    assert um.can_redo()
+    um.redo()
+    assert text.to_string() == "hello"
+    um.redo()
+    assert text.to_string() == "hello world"
+    assert not um.can_redo()
+
+
+def test_undo_delete_recreates_content():
+    doc = Doc()
+    text = doc.get_text("t")
+    text.insert(0, "keep this text")
+    um = UndoManager(text, capture_timeout=0)
+    text.delete(5, 5)
+    assert text.to_string() == "keep text"
+    um.undo()
+    assert text.to_string() == "keep this text"
+    um.redo()
+    assert text.to_string() == "keep text"
+
+
+def test_capture_timeout_merges_changes():
+    doc = Doc()
+    text = doc.get_text("t")
+    um = UndoManager(text, capture_timeout=10_000)
+    text.insert(0, "a")
+    text.insert(1, "b")
+    text.insert(2, "c")
+    um.undo()  # merged into one stack item
+    assert text.to_string() == ""
+
+
+def test_stop_capturing_splits_stack_items():
+    doc = Doc()
+    text = doc.get_text("t")
+    um = UndoManager(text, capture_timeout=10_000)
+    text.insert(0, "a")
+    um.stop_capturing()
+    text.insert(1, "b")
+    um.undo()
+    assert text.to_string() == "a"
+
+
+def test_untracked_origin_not_captured():
+    doc = Doc()
+    text = doc.get_text("t")
+    um = UndoManager(text, capture_timeout=0)
+
+    def remote_edit(transaction):
+        from hocuspocus_tpu.crdt.types.ytext import YText  # noqa: F401
+
+        text._insert(transaction, 0, "remote ")
+
+    doc.transact(remote_edit, origin="remote-peer")
+    assert not um.can_undo(), "remote origin must not be captured"
+    text.insert(0, "local ")
+    um.undo()
+    assert text.to_string() == "remote "
+
+
+def test_undo_preserves_concurrent_remote_edits():
+    a, b = Doc(), Doc()
+    ta, tb = a.get_text("t"), b.get_text("t")
+    um = UndoManager(ta, capture_timeout=0)
+    ta.insert(0, "local")
+    _sync(a, b)
+    tb.insert(5, " remote")  # concurrent remote addition
+    _sync(a, b)
+    um.undo()  # undo only the local "local"
+    _sync(a, b)
+    assert ta.to_string() == " remote"
+    assert tb.to_string() == " remote"
+    um.redo()
+    _sync(a, b)
+    assert "local" in ta.to_string() and " remote" in ta.to_string()
+
+
+def test_undo_delete_after_remote_edit():
+    a, b = Doc(), Doc()
+    ta, tb = a.get_text("t"), b.get_text("t")
+    ta.insert(0, "shared base")
+    _sync(a, b)
+    um = UndoManager(ta, capture_timeout=0)
+    ta.delete(0, 6)  # delete "shared"
+    _sync(a, b)
+    tb.insert(len(tb), "!!!")
+    _sync(a, b)
+    assert ta.to_string() == " base!!!"
+    um.undo()
+    _sync(a, b)
+    assert ta.to_string() == tb.to_string() == "shared base!!!"
+
+
+def test_scope_filtering():
+    doc = Doc()
+    tracked = doc.get_text("tracked")
+    other = doc.get_text("other")
+    um = UndoManager(tracked, capture_timeout=0)
+    other.insert(0, "untracked content")
+    assert not um.can_undo()
+    tracked.insert(0, "tracked content")
+    assert um.can_undo()
+    um.undo()
+    assert tracked.to_string() == ""
+    assert other.to_string() == "untracked content"
+
+
+def test_map_undo():
+    doc = Doc()
+    m = doc.get_map("m")
+    um = UndoManager(m, capture_timeout=0)
+    m.set("k", "v1")
+    m.set("k", "v2")
+    um.undo()
+    assert m.get("k") == "v1"
+    um.undo()
+    assert m.get("k") is None
+    um.redo()
+    assert m.get("k") == "v1"
+    um.redo()
+    assert m.get("k") == "v2"
+
+
+def test_map_concurrent_set_wins_over_redo():
+    a, b = Doc(), Doc()
+    ma, mb = a.get_map("m"), b.get_map("m")
+    um = UndoManager(ma, capture_timeout=0)
+    ma.set("k", "mine")
+    _sync(a, b)
+    um.undo()
+    mb.set("k", "theirs")  # concurrent remote set while undone
+    _sync(a, b)
+    um.redo()  # must NOT clobber the concurrent remote set
+    _sync(a, b)
+    assert ma.get("k") == "theirs"
+    assert mb.get("k") == "theirs"
+
+
+def test_array_undo():
+    doc = Doc()
+    arr = doc.get_array("a")
+    um = UndoManager(arr, capture_timeout=0)
+    arr.insert(0, [1, 2, 3])
+    arr.insert(3, [4])
+    um.undo()
+    assert arr.to_list() == [1, 2, 3]
+    um.undo()
+    assert arr.to_list() == []
+    um.redo()
+    um.redo()
+    assert arr.to_list() == [1, 2, 3, 4]
+
+
+def test_undo_events():
+    doc = Doc()
+    text = doc.get_text("t")
+    um = UndoManager(text, capture_timeout=0)
+    added, popped = [], []
+    um.on("stack-item-added", lambda event, manager: added.append(event["type"]))
+    um.on("stack-item-popped", lambda event, manager: popped.append(event["type"]))
+    text.insert(0, "x")
+    um.undo()
+    um.redo()
+    assert "undo" in added and "redo" in added
+    assert popped == ["undo", "redo"]
