@@ -119,9 +119,18 @@ class UndoManager(Observable):
         changed = transaction.changed_parent_types
         return any(t in changed or t in transaction.changed for t in self.scope)
 
+    def _tracks(self, transaction: Transaction) -> bool:
+        # origin None is tracked only for LOCAL transactions: remote updates
+        # applied via apply_update run with origin=None/local=False and must
+        # never land on the undo stack (yjs providers pass themselves as
+        # origin; our apply path signals remoteness via transaction.local)
+        if transaction.origin not in self.tracked_origins:
+            return False
+        return transaction.origin is not None or transaction.local
+
     def _after_transaction(self, transaction: Transaction, doc: Any) -> None:
         if not self._in_scope(transaction) or (
-            transaction.origin not in self.tracked_origins
+            not self._tracks(transaction)
             and not (self.undoing or self.redoing)
         ):
             return

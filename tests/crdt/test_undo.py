@@ -69,12 +69,9 @@ def test_untracked_origin_not_captured():
     text = doc.get_text("t")
     um = UndoManager(text, capture_timeout=0)
 
-    def remote_edit(transaction):
-        from hocuspocus_tpu.crdt.types.ytext import YText  # noqa: F401
-
-        text._insert(transaction, 0, "remote ")
-
-    doc.transact(remote_edit, origin="remote-peer")
+    # nested transact reuses the active transaction, so the insert runs
+    # with origin="remote-peer"
+    doc.transact(lambda txn: text.insert(0, "remote "), origin="remote-peer")
     assert not um.can_undo(), "remote origin must not be captured"
     text.insert(0, "local ")
     um.undo()
@@ -166,12 +163,12 @@ def test_array_undo():
     arr.insert(0, [1, 2, 3])
     arr.insert(3, [4])
     um.undo()
-    assert arr.to_list() == [1, 2, 3]
+    assert arr.to_array() == [1, 2, 3]
     um.undo()
-    assert arr.to_list() == []
+    assert arr.to_array() == []
     um.redo()
     um.redo()
-    assert arr.to_list() == [1, 2, 3, 4]
+    assert arr.to_array() == [1, 2, 3, 4]
 
 
 def test_undo_events():
