@@ -20,13 +20,146 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 from functools import partial
 
 import numpy as np
 
+# The remote-attached TPU plugin (axon) is flaky: backend init sometimes
+# raises "Unable to initialize backend", sometimes HANGS in jax.devices().
+# So: (1) every jax-touching step runs in a killable subprocess, (2) a
+# cheap PROBE (import jax + jax.devices()) gates the expensive bench run,
+# so a hang costs PROBE_TIMEOUT, not the whole round.
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 900))
+
+_PROBE_SNIPPET = (
+    "import jax; d = jax.devices(); "
+    "print('PROBE', jax.default_backend(), len(d), flush=True)"
+)
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _env_for(platforms: "str | None") -> dict:
+    env = os.environ.copy()
+    if platforms is not None:
+        env["JAX_PLATFORMS"] = platforms
+    return env
+
+
+def _label(platforms: "str | None") -> str:
+    return "inherit" if platforms is None else (platforms or "<unset>")
+
+
+def _probe(platforms: "str | None") -> "str | None":
+    """Return the backend name jax lands on under this env, or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            env=_env_for(platforms),
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"probe JAX_PLATFORMS={_label(platforms)}: hung > {PROBE_TIMEOUT_S}s")
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROBE "):
+            backend = line.split()[1]
+            _log(f"probe JAX_PLATFORMS={_label(platforms)}: backend={backend}")
+            return backend
+    _log(
+        f"probe JAX_PLATFORMS={_label(platforms)}: rc={proc.returncode} "
+        f"{proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else ''}"
+    )
+    return None
+
+
+def _run_inner(platforms: "str | None") -> "dict | None":
+    """Run the measurement in a subprocess; return its parsed JSON line."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            env=_env_for(platforms),
+            capture_output=True,
+            text=True,
+            timeout=ATTEMPT_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"attempt JAX_PLATFORMS={_label(platforms)}: timed out after {ATTEMPT_TIMEOUT_S}s")
+        return None
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        _log(f"attempt JAX_PLATFORMS={_label(platforms)}: rc={proc.returncode}")
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    _log(f"attempt JAX_PLATFORMS={_label(platforms)}: no JSON line on stdout")
+    return None
+
 
 def main() -> None:
+    """Orchestrator. Probe for a live TPU backend (two rounds, short
+    timeouts), bench on the first config that probes OK, degrade to CPU
+    rather than emitting a traceback. Exactly ONE JSON line on stdout."""
+    errors: list[str] = []
+    candidates: list = []
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        # inherit first (normal plugin path), then JAX_PLATFORMS='' (the
+        # retry the JAX init error itself suggests); two probe rounds to
+        # ride out transient tunnel flakes
+        candidates = [None, "", None, ""]
+    for platforms in candidates:
+        backend = _probe(platforms)
+        if backend is None or backend == "cpu":
+            errors.append(f"probe-{_label(platforms)}:{backend or 'dead'}")
+            continue
+        result = _run_inner(platforms)
+        if result is None:
+            errors.append(f"bench-{_label(platforms)}:failed")
+            continue
+        if result.get("extra", {}).get("backend") == "cpu":
+            errors.append(f"bench-{_label(platforms)}:landed-on-cpu")
+            continue
+        if errors:
+            result.setdefault("extra", {})["failed_attempts"] = errors
+        print(json.dumps(result))
+        return
+    # graceful degradation: a CPU number beats rc=1 with a traceback
+    for _ in range(2):
+        result = _run_inner("cpu")
+        if result is not None:
+            if errors:
+                result.setdefault("extra", {})["failed_attempts"] = errors
+            print(json.dumps(result))
+            return
+    print(
+        json.dumps(
+            {
+                "metric": "crdt_update_merges_per_sec",
+                "value": 0.0,
+                "unit": "merges/s",
+                "vs_baseline": 0.0,
+                "extra": {"error": "all backend attempts failed", "failed_attempts": errors},
+            }
+        )
+    )
+    sys.exit(1)
+
+
+def run_bench() -> None:
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -155,6 +288,17 @@ def main() -> None:
         sync(state)
         latencies.append(time.perf_counter() - t0)
 
+    # end-to-end merge-to-broadcast p99 THROUGH THE SERVER: real ws
+    # providers, plane serving path (device flush + merged broadcast) —
+    # the BASELINE metric is end-to-end, not kernel-microbatch
+    server_p99_ms = None
+    server_p99_err = None
+    if os.environ.get("BENCH_SERVER_P99", "1") != "0":
+        try:
+            server_p99_ms = _measure_server_p99()
+        except Exception as error:  # never lose the headline number to this
+            server_p99_err = repr(error)[:300]
+
     merges_per_sec = total_ops / elapsed
     p99_ms = float(np.percentile(np.array(latencies) * 1000, 99))
     result = {
@@ -173,8 +317,82 @@ def main() -> None:
             "device": str(jax.devices()[0]),
         },
     }
+    if server_p99_ms is not None:
+        result["extra"]["server_merge_to_broadcast_p99_ms"] = round(server_p99_ms, 2)
+    if server_p99_err is not None:
+        result["extra"]["server_p99_error"] = server_p99_err
     print(json.dumps(result))
 
 
+def _measure_server_p99() -> float:
+    """Merge-to-broadcast p99 through the live server on the plane path.
+
+    Boots the real aiohttp server with TpuMergeExtension(serve=True),
+    connects 2 real ws providers per doc, and times client-A-insert →
+    client-B-observes for a round-robin edit stream. This is the
+    end-to-end metric from BASELINE.json (<50 ms p99 target): queue wait
+    + lowering + device flush + merged broadcast + fan-out.
+    """
+    import asyncio
+    import time as _time
+
+    from hocuspocus_tpu.provider import HocuspocusProvider
+    from hocuspocus_tpu.server import Configuration, Server
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+
+    num_docs = int(os.environ.get("BENCH_SERVER_DOCS", 8))
+    edits = int(os.environ.get("BENCH_SERVER_EDITS", 200))
+
+    async def run() -> float:
+        ext = TpuMergeExtension(
+            num_docs=num_docs * 2, capacity=8192, flush_interval_ms=2.0, serve=True
+        )
+        server = Server(Configuration(quiet=True, extensions=[ext]))
+        await server.listen(port=0)
+        writers, readers = [], []
+        try:
+            for d in range(num_docs):
+                writers.append(
+                    HocuspocusProvider(name=f"bench-{d}", url=server.web_socket_url)
+                )
+                readers.append(
+                    HocuspocusProvider(name=f"bench-{d}", url=server.web_socket_url)
+                )
+            deadline = _time.monotonic() + 30
+            for p in writers + readers:
+                while not p.synced:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError("bench providers never synced")
+                    await asyncio.sleep(0.01)
+
+            async def one_edit(i: int) -> float:
+                d = i % num_docs
+                wtext = writers[d].document.get_text("body")
+                rtext = readers[d].document.get_text("body")
+                expected = len(rtext.to_string()) + 16
+                t0 = _time.perf_counter()
+                wtext.insert(len(wtext.to_string()), "x" * 16)
+                while len(rtext.to_string()) < expected:
+                    await asyncio.sleep(0.0005)
+                return _time.perf_counter() - t0
+
+            for i in range(10):  # warmup: compiles the flush shapes
+                await one_edit(i)
+            lat = []
+            for i in range(edits):
+                lat.append(await one_edit(i))
+            assert ext.plane.counters["plane_broadcasts"] > 0, "plane never served"
+            return float(np.percentile(np.array(lat) * 1000, 99))
+        finally:
+            for p in writers + readers:
+                p.destroy()
+            await server.destroy()
+
+    return asyncio.run(run())
+
+
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        run_bench()
+    else:
+        main()

@@ -37,6 +37,11 @@ class Document(Doc):
             "on_update": lambda document, connection, update: None,
             "before_broadcast_stateless": lambda document, stateless: None,
         }
+        # TPU merge-plane serving seams (tpu/merge_plane.TpuMergeExtension):
+        # sync_source serves SyncStep2 payloads from device state;
+        # broadcast_source claims updates for batched device broadcast
+        self.sync_source = None
+        self.broadcast_source = None
         self.awareness.on("update", self._handle_awareness_update)
         self.on("update", self._handle_update)
 
@@ -124,8 +129,24 @@ class Document(Doc):
 
     def _handle_update(self, update: bytes, origin: Any, doc, transaction) -> None:
         self.callbacks["on_update"](self, origin, update)
+        source = self.broadcast_source
+        if source is not None:
+            try:
+                if source.try_capture(self, update, origin):
+                    # plane-served doc: one merged broadcast per device
+                    # flush replaces the per-update fan-out below
+                    return
+            except Exception:
+                from . import logger as _logger_mod
+
+                _logger_mod.log_error(
+                    f"plane capture failed for {self.name!r}; broadcasting via CPU"
+                )
         # broadcast fan-out (reference Document.ts:228-240) — frame built
         # once by the native codec, sent to every connection
+        self.broadcast_update_frame(update)
+
+    def broadcast_update_frame(self, update: bytes) -> None:
         data = build_update_frame(self.name, update)
         for connection in self.get_connections():
             connection.send(data)

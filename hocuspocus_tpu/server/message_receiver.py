@@ -20,6 +20,7 @@ from ..protocol.sync import (
     read_sync_step1,
     read_sync_step2,
     read_update,
+    write_sync_step2,
 )
 from ..observability.tracing import get_tracer
 from .document import Document
@@ -134,7 +135,19 @@ class MessageReceiver:
             )
 
         if sync_type == MESSAGE_YJS_SYNC_STEP1:
-            read_sync_step1(message.decoder, message.encoder, document)
+            source = getattr(document, "sync_source", None)
+            if source is not None:
+                # TPU-plane serving path: the SyncStep2 payload is built
+                # from device state; None degrades to the CPU document
+                sv = message.decoder.read_var_uint8_array()
+                update = source.encode_state_as_update(sv)
+                if update is not None:
+                    message.encoder.write_var_uint(MESSAGE_YJS_SYNC_STEP2)
+                    message.encoder.write_var_uint8_array(update)
+                else:
+                    write_sync_step2(message.encoder, document, sv)
+            else:
+                read_sync_step1(message.decoder, message.encoder, document)
             # The server replies SyncStep2 (already in message.encoder)
             # immediately followed by its own SyncStep1.
             if reply is not None and request_first_sync:

@@ -217,9 +217,20 @@ class Server:
                 if msg.type == WSMsgType.BINARY:
                     await client_connection.handle_message(msg.data)
                 elif msg.type == WSMsgType.ERROR:
-                    if isinstance(ws.exception(), aiohttp.WebSocketError):
+                    exc = ws.exception()
+                    if (
+                        isinstance(exc, aiohttp.WebSocketError)
+                        and exc.code == aiohttp.WSCloseCode.MESSAGE_TOO_BIG
+                    ):
                         await ws.close(
                             code=MESSAGE_TOO_BIG.code, message=MESSAGE_TOO_BIG.reason.encode()
+                        )
+                    elif isinstance(exc, aiohttp.WebSocketError):
+                        # invalid opcode / bad frame / protocol violation:
+                        # don't mislabel as 1009
+                        await ws.close(
+                            code=aiohttp.WSCloseCode.PROTOCOL_ERROR,
+                            message=b"protocol error",
                         )
                     break
         except Exception as error:

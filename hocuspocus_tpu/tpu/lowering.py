@@ -41,6 +41,9 @@ class DenseOp:
     right_client: int = NONE_CLIENT
     right_clock: int = 0
     chars: tuple = ()
+    # insert lowered from a ContentDeleted struct: the arena stores the
+    # units (as zeros) but serving re-encodes the struct as ContentDeleted
+    deleted_content: bool = False
 
 
 @dataclass
@@ -177,6 +180,7 @@ class DocLowerer:
                 right_client=right_client,
                 right_clock=right_clock,
                 chars=tuple(units[offset:]),
+                deleted_content=struct.kind == STRUCT_DELETED,
             )
         )
         if struct.kind == STRUCT_DELETED:

@@ -44,8 +44,26 @@ class MergePlane:
         # append-only arena is deterministic (arena slot = arrival
         # index), so shipped insert payloads land here, indexed by slot
         self.char_logs: dict[int, list[int]] = {}
+        # every op the device consumed, in arena order, with the char-log
+        # offset of its payload — the host half of the serving path
+        self.op_logs: dict[int, list[tuple[DenseOp, int]]] = {}
+        # root type name per slot (needed to encode origin-less items)
+        self.root_names: dict[int, str] = {}
         self.projected_len: dict[int, int] = {}
+        self._retired: set[int] = set()
         self.total_integrated = 0
+        # degradation accounting: at 100k docs nobody notices 3% of docs
+        # silently falling off the plane unless it is counted
+        self.counters: dict[str, int] = {
+            "docs_retired_overflow": 0,
+            "docs_retired_desync": 0,
+            "docs_retired_unsupported": 0,
+            "docs_retired_capacity": 0,
+            "docs_retired_fallback": 0,
+            "sync_serves": 0,
+            "plane_broadcasts": 0,
+            "cpu_fallbacks": 0,
+        }
 
     # -- registry ----------------------------------------------------------
 
@@ -59,6 +77,7 @@ class MergePlane:
         self.lowerers[slot] = DocLowerer()
         self.queues[slot] = []
         self.char_logs[slot] = []
+        self.op_logs[slot] = []
         self.projected_len[slot] = 0
         return slot
 
@@ -69,9 +88,30 @@ class MergePlane:
         self.lowerers.pop(slot, None)
         self.queues.pop(slot, None)
         self.char_logs.pop(slot, None)
+        self.op_logs.pop(slot, None)
+        self.root_names.pop(slot, None)
         self.projected_len.pop(slot, None)
+        self._retired.discard(slot)
         self._clear_slot(slot)
         self.free.append(slot)
+
+    def retire_slot(self, slot: int, reason: str) -> None:
+        """Permanently degrade a doc to the CPU path (slot stays allocated
+        until unload so the name keeps resolving to 'unsupported')."""
+        lowerer = self.lowerers.get(slot)
+        if lowerer is None:
+            return
+        if slot not in self._retired:
+            # counted via _retired, not the unsupported flag: the lowerer
+            # flips unsupported itself on unrepresentable content
+            self._retired.add(slot)
+            self.counters[f"docs_retired_{reason}"] = (
+                self.counters.get(f"docs_retired_{reason}", 0) + 1
+            )
+        lowerer.unsupported = True
+        self.queues[slot].clear()
+        self.char_logs[slot] = []
+        self.op_logs[slot] = []
 
     def _clear_slot(self, slot: int) -> None:
         empty = make_empty_state(1, self.capacity)
@@ -90,16 +130,20 @@ class MergePlane:
 
     # -- queueing ----------------------------------------------------------
 
-    def enqueue_update(self, name: str, update: bytes) -> None:
+    def enqueue_update(self, name: str, update: bytes) -> int:
+        """Lower + queue one update; returns the number of ops queued."""
         slot = self.slots.get(name)
         if slot is None:
             slot = self.register(name)
             if slot is None:
-                return
+                return 0
         lowerer = self.lowerers[slot]
         if lowerer.unsupported:
-            return
+            return 0
         ops = lowerer.lower_update(update)
+        if lowerer.unsupported:
+            self.retire_slot(slot, "unsupported")
+            return 0
         # host-side mirror of the device capacity check: the lowerer
         # guarantees causal readiness, so inserts succeed until the
         # arena overflows — at which point the doc is CPU-only forever;
@@ -108,11 +152,11 @@ class MergePlane:
             op.run_len for op in ops if op.kind == KIND_INSERT
         )
         if projected > self.capacity:
-            lowerer.unsupported = True
-            self.queues[slot].clear()
-            return
+            self.retire_slot(slot, "capacity")
+            return 0
         self.projected_len[slot] = projected
         self.queues[slot].extend(ops)
+        return len(ops)
 
     def pending_ops(self) -> int:
         return sum(len(q) for q in self.queues.values())
@@ -165,6 +209,7 @@ class MergePlane:
             take = queue[:k]
             del queue[:k]
             log = self.char_logs[slot]
+            op_log = self.op_logs[slot]
             for i, op in enumerate(take):
                 kind[i, slot] = op.kind
                 client[i, slot] = op.client
@@ -174,6 +219,7 @@ class MergePlane:
                 left_clock[i, slot] = op.left_clock
                 right_client[i, slot] = op.right_client
                 right_clock[i, slot] = op.right_clock
+                op_log.append((op, len(log)))
                 if op.kind == KIND_INSERT:  # payload goes to the host log
                     log.extend(op.chars)
         import jax.numpy as jnp
@@ -209,15 +255,14 @@ class MergePlane:
             return None  # doc fell back to the CPU path (content/overflow)
         overflow = bool(np.asarray(self.state.overflow)[slot])
         if overflow:
+            self.retire_slot(slot, "overflow")
             return None
         log = np.asarray(self.char_logs[slot], dtype=np.int64)
         if len(log) != int(np.asarray(self.state.length)[slot]):
             # host log and arena desynced (op rejected on device) — the
             # CPU document stays authoritative; retire the doc from the
             # plane so it stops consuming queue/log/kernel resources
-            self.lowerers[slot].unsupported = True
-            self.queues[slot].clear()
-            self.char_logs[slot] = []
+            self.retire_slot(slot, "desync")
             return None
         live = np.asarray(extract_live_mask(self.state))[slot]
         occupied = np.nonzero(live)[0]
@@ -254,12 +299,27 @@ class MergePlane:
         return units_to_text(out)
 
 
-class TpuMergeExtension(Extension):
-    """Mirrors live documents onto the TPU merge plane via onChange.
+class _MultipleRoots(Exception):
+    pass
 
-    The CPU document stays authoritative for serving in this round; the
-    plane shadows every supported text document and is the substrate for
-    batched merge serving (bench.py drives it directly).
+
+class TpuMergeExtension(Extension):
+    """Puts live documents on the TPU merge plane via onChange.
+
+    Two modes:
+    - shadow (serve=False): the plane mirrors every supported text
+      document; the CPU document serves (round-1 behavior).
+    - serve (serve=True): for supported docs the plane IS the serving
+      path — SyncStep2 replies come from device state
+      (`Document.sync_source`), per-update CPU fan-out is suppressed
+      (`Document.broadcast_source`) and replaced by one merged broadcast
+      per device flush. Any degradation (unsupported content, overflow,
+      desync) falls the doc back to the CPU path, shipping the full CPU
+      state once so receivers that only saw plane broadcasts are whole.
+
+    Replaces the reference's per-connection apply+broadcast loop
+    (`packages/server/src/MessageReceiver.ts:195-213`,
+    `packages/server/src/Document.ts:228-240`).
     """
 
     priority = 900
@@ -270,30 +330,159 @@ class TpuMergeExtension(Extension):
         capacity: int = 4096,
         flush_interval_ms: float = 5.0,
         plane: Optional[MergePlane] = None,
+        serve: bool = False,
     ) -> None:
         self.plane = plane or MergePlane(num_docs=num_docs, capacity=capacity)
         self.flush_interval_ms = flush_interval_ms
         self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self.serve = serve
+        self.serving = None
+        self._docs: dict[str, object] = {}  # name -> server Document being served
+        if serve:
+            from .serving import PlaneServing
+
+            self.serving = PlaneServing(self.plane)
+
+    # -- hooks ---------------------------------------------------------------
 
     async def after_load_document(self, data: Payload) -> None:
         from ..crdt import encode_state_as_update
 
-        self.plane.register(data.document_name)
+        name = data.document_name
+        slot = self.plane.register(name)
         snapshot = encode_state_as_update(data.document)
-        self.plane.enqueue_update(data.document_name, snapshot)
+        queued = self.plane.enqueue_update(name, snapshot)
+        if self.serve and slot is not None and self.plane.is_supported(name):
+            document = data.document
+            try:
+                root = self._resolve_root(document)
+            except _MultipleRoots:
+                self.plane.retire_slot(slot, "unsupported")
+                self._schedule_flush()
+                return
+            if root is not None:
+                self.plane.root_names[slot] = root
+            from .serving import TpuSyncSource
+
+            # receivers get pre-load state via sync, not broadcast
+            self.serving.broadcast_cursor[slot] = queued
+            document.sync_source = TpuSyncSource(self.serving, name, document)
+            document.broadcast_source = self
+            self._docs[name] = document
         self._schedule_flush()
 
     async def on_change(self, data: Payload) -> None:
+        if self.serve and data.document_name in self._docs:
+            return  # already captured synchronously in try_capture
         self.plane.enqueue_update(data.document_name, data.update)
         self._schedule_flush()
 
     async def after_unload_document(self, data: Payload) -> None:
-        self.plane.release(data.document_name)
+        name = data.document_name
+        document = self._docs.pop(name, None)
+        if document is not None:
+            document.sync_source = None
+            document.broadcast_source = None
+        slot = self.plane.slots.get(name)
+        if slot is not None:
+            self.serving and self.serving.broadcast_cursor.pop(slot, None)
+        self.plane.release(name)
 
     async def on_destroy(self, data: Payload) -> None:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
+        self._flush()
+
+    # -- serving: update capture (called by Document._handle_update) ---------
+
+    def try_capture(self, document, update: bytes, origin) -> bool:
+        """Claim an update for plane-batched broadcast. False = CPU fan-out."""
+        name = document.name
+        if not self.serve or name not in self._docs:
+            return False
+        plane = self.plane
+        slot = plane.slots.get(name)
+        if slot is None or not plane.is_supported(name):
+            self._fallback_to_cpu(document)
+            return False
+        plane.enqueue_update(name, update)
+        if not plane.is_supported(name):
+            # this very update degraded the doc; it broadcasts via CPU
+            self._fallback_to_cpu(document)
+            return False
+        if plane.root_names.get(slot) is None:
+            try:
+                root = self._resolve_root(document)
+            except _MultipleRoots:
+                plane.retire_slot(slot, "unsupported")
+                self._fallback_to_cpu(document)
+                return False
+            if root is not None:
+                plane.root_names[slot] = root
+        self._schedule_flush()
+        return True
+
+    def _resolve_root(self, document) -> Optional[str]:
+        """The single content-bearing root type name, None if empty.
+
+        The dense arena models ONE text sequence per doc; a second
+        content-bearing root would interleave, so it degrades the doc.
+        """
+        roots = [
+            key
+            for key, ytype in document.share.items()
+            if ytype._start is not None or getattr(ytype, "_map", None)
+        ]
+        if len(roots) > 1:
+            raise _MultipleRoots()
+        return roots[0] if roots else None
+
+    def _fallback_to_cpu(self, document) -> None:
+        name = document.name
+        if self._docs.pop(name, None) is None:
+            return  # already degraded
+        document.sync_source = None
+        document.broadcast_source = None
+        slot = self.plane.slots.get(name)
+        if slot is not None:
+            self.plane.retire_slot(slot, "fallback")
+        self.plane.counters["cpu_fallbacks"] += 1
+        # receivers may hold plane broadcasts only up to the last flush;
+        # ship the full CPU state once (dedup makes it a cheap no-op for
+        # anyone already current)
+        from ..crdt import encode_state_as_update
+
+        document.broadcast_update_frame(encode_state_as_update(document))
+
+    # -- flush ---------------------------------------------------------------
+
+    def _flush(self) -> None:
         self.plane.flush()
+        if not self.serve:
+            return
+        self.serving.refresh()
+        for name, document in list(self._docs.items()):
+            # per-doc guard: the stated safety model is "any serving
+            # error degrades that doc to the CPU path" — an exception
+            # here must neither strand this doc's ops nor skip the
+            # remaining docs' broadcasts
+            try:
+                if self.serving.slot_healthy(name) is None:
+                    self._fallback_to_cpu(document)
+                    continue
+                update = self.serving.build_broadcast(name)
+                if update is not None:
+                    document.broadcast_update_frame(update)
+            except Exception:
+                from ..server import logger as _logger_mod
+
+                _logger_mod.log_error(
+                    f"plane broadcast failed for {name!r}; degrading to CPU path"
+                )
+                try:
+                    self._fallback_to_cpu(document)
+                except Exception:
+                    _logger_mod.log_error(f"CPU fallback failed for {name!r}")
 
     def _schedule_flush(self) -> None:
         if self._flush_handle is not None:
@@ -301,7 +490,7 @@ class TpuMergeExtension(Extension):
 
         def run() -> None:
             self._flush_handle = None
-            self.plane.flush()
+            self._flush()
 
         self._flush_handle = asyncio.get_event_loop().call_later(
             self.flush_interval_ms / 1000, run

@@ -1,0 +1,262 @@
+"""Serve sync replies and broadcasts from TPU merge-plane state.
+
+This is the piece that promotes the merge plane from a shadow mirror to
+the serving path: for supported text documents, SyncStep2 payloads and
+steady-state update broadcasts are PRODUCED from device state — arena
+ids / rank / tombstones read back from the TPU, combined with the
+host-side op/char logs — instead of from the CPU document
+(reference hot path: `packages/server/src/MessageReceiver.ts:137-213`
+building SyncStep2 via `Y.encodeStateAsUpdate`, and
+`packages/server/src/Document.ts:228-240` re-broadcasting every
+incoming update per-connection).
+
+Safety model:
+- The CPU document stays the fallback: every serve checks the plane is
+  healthy (supported, no overflow, host/device logs in sync) AND covers
+  the CPU document's state vector; otherwise the caller falls back.
+- Delete sets in served payloads are always read from the DEVICE
+  tombstone mask — a deletion the kernel did not apply can never be
+  served, and redundant ds ranges are no-ops on receivers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..crdt.content import ContentDeleted, ContentString
+from ..crdt.delete_set import DeleteSet
+from ..crdt.encoding import Encoder
+from ..crdt.ids import ID
+from ..crdt.structs import Item
+from ..crdt.update import _write_structs, decode_state_vector
+from .kernels import KIND_DELETE, KIND_INSERT, NONE_CLIENT
+from .lowering import DenseOp, units_to_text
+from .merge_plane import MergePlane
+
+
+def _make_item(op: DenseOp, char_off: int, char_log: list, root: Optional[str]) -> Item:
+    origin = ID(op.left_client, op.left_clock) if op.left_client != NONE_CLIENT else None
+    right_origin = (
+        ID(op.right_client, op.right_clock) if op.right_client != NONE_CLIENT else None
+    )
+    if op.deleted_content:
+        content = ContentDeleted(op.run_len)
+    else:
+        content = ContentString(units_to_text(char_log[char_off : char_off + op.run_len]))
+    return Item(
+        ID(op.client, op.clock),
+        None,
+        origin,
+        None,
+        right_origin,
+        root,  # only consulted by Item.write when both origins are None
+        None,
+        content,
+    )
+
+
+class PlaneServing:
+    """Builds yjs update bytes from plane state for sync + broadcast."""
+
+    def __init__(self, plane: MergePlane) -> None:
+        self.plane = plane
+        # slot -> op_log index whose ops receivers already have
+        self.broadcast_cursor: dict[int, int] = {}
+        self._length_cache: Optional[np.ndarray] = None
+        self._overflow_cache: Optional[np.ndarray] = None
+
+    # -- device readback cache ---------------------------------------------
+
+    def refresh(self) -> None:
+        """Pull the (D,) health rows once; per-slot checks then stay host-side."""
+        self._length_cache = np.asarray(self.plane.state.length)
+        self._overflow_cache = np.asarray(self.plane.state.overflow)
+
+    def _lengths(self) -> np.ndarray:
+        if self._length_cache is None:
+            self.refresh()
+        return self._length_cache
+
+    def _overflows(self) -> np.ndarray:
+        if self._overflow_cache is None:
+            self.refresh()
+        return self._overflow_cache
+
+    # -- health -------------------------------------------------------------
+
+    def slot_healthy(self, name: str) -> Optional[int]:
+        plane = self.plane
+        slot = plane.slots.get(name)
+        if slot is None:
+            return None
+        if plane.lowerers[slot].unsupported:
+            return None
+        if bool(self._overflows()[slot]):
+            plane.retire_slot(slot, "overflow")
+            return None
+        if len(plane.char_logs[slot]) != int(self._lengths()[slot]):
+            # host log and device arena desynced (op rejected on device)
+            plane.retire_slot(slot, "desync")
+            return None
+        return slot
+
+    def covers(self, name: str, document) -> bool:
+        """Plane has integrated everything the CPU document has seen."""
+        slot = self.plane.slots.get(name)
+        if slot is None:
+            return False
+        known = self.plane.lowerers[slot].known
+        for client, clock in document.store.get_state_vector().items():
+            if clock > known.get(client, 0):
+                return False
+        return True
+
+    # -- encoding -----------------------------------------------------------
+
+    def _items_by_client(self, slot: int, root: Optional[str]) -> dict[int, list[Item]]:
+        by: dict[int, list[Item]] = {}
+        log = self.plane.char_logs[slot]
+        for op, off in self.plane.op_logs[slot]:
+            if op.kind != KIND_INSERT:
+                continue
+            by.setdefault(op.client, []).append(_make_item(op, off, log, root))
+        for items in by.values():
+            items.sort(key=lambda item: item.id.clock)
+        return by
+
+    def _device_delete_set(self, slot: int) -> DeleteSet:
+        """Tombstone ranges as the DEVICE sees them (the provable part)."""
+        state = self.plane.state
+        length = int(self._lengths()[slot])
+        ds = DeleteSet()
+        if length == 0:
+            return ds
+        deleted = np.asarray(state.deleted[slot])[:length]
+        if not deleted.any():
+            return ds
+        sel = np.nonzero(deleted)[0]
+        clients = np.asarray(state.id_client[slot])[sel]
+        clocks = np.asarray(state.id_clock[slot])[sel]
+        pairs = sorted(zip(clients.tolist(), clocks.tolist()))
+        run_client, run_start, run_len = pairs[0][0], pairs[0][1], 1
+        for client, clock in pairs[1:]:
+            if client == run_client and clock == run_start + run_len:
+                run_len += 1
+            else:
+                ds.add(run_client, run_start, run_len)
+                run_client, run_start, run_len = client, clock, 1
+        ds.add(run_client, run_start, run_len)
+        ds.sort_and_merge()
+        return ds
+
+    def encode_state_as_update(
+        self, name: str, document, sv_bytes: Optional[bytes] = None
+    ) -> Optional[bytes]:
+        """SyncStep2 payload from device state; None = CPU fallback."""
+        plane = self.plane
+        if plane.pending_ops() > 0:
+            plane.flush()
+            self.refresh()
+        slot = self.slot_healthy(name)
+        if slot is None or not self.covers(name, document):
+            return None
+        root = plane.root_names.get(slot)
+        items_by_client = self._items_by_client(slot, root)
+        if items_by_client and root is None:
+            return None  # content exists but the root type is unresolved
+        target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
+        local_sv = {
+            client: items[-1].id.clock + items[-1].length
+            for client, items in items_by_client.items()
+        }
+        sm: dict[int, int] = {}
+        for client, clock in target_sv.items():
+            if local_sv.get(client, 0) > clock:
+                sm[client] = clock
+        for client in local_sv:
+            if client not in target_sv:
+                sm[client] = 0
+        encoder = Encoder()
+        encoder.write_var_uint(len(sm))
+        for client in sorted(sm, reverse=True):
+            _write_structs(encoder, items_by_client[client], client, sm[client])
+        self._device_delete_set(slot).write(encoder)
+        plane.counters["sync_serves"] += 1
+        return encoder.to_bytes()
+
+    def build_broadcast(self, name: str) -> Optional[bytes]:
+        """Merged update for ops integrated since the last broadcast.
+
+        Items come from the host op log (everything consumed by the
+        device since the cursor); when the window contained delete ops,
+        the delete set is the full device tombstone state — receivers
+        treat already-known ranges as no-ops, so device-applied deletions
+        are never lost without per-slot delta bookkeeping. The cursor
+        only advances on a successfully encoded payload (or a genuinely
+        empty window), so a bail-out never strands ops.
+        """
+        plane = self.plane
+        slot = plane.slots.get(name)
+        if slot is None:
+            return None
+        log = plane.op_logs.get(slot)
+        if log is None:
+            return None
+        cursor = min(self.broadcast_cursor.get(slot, 0), len(log))
+        new = log[cursor:]
+        if not new:
+            return None
+        root = plane.root_names.get(slot)
+        by: dict[int, list[Item]] = {}
+        has_delete = False
+        char_log = plane.char_logs[slot]
+        for op, off in new:
+            if op.kind == KIND_INSERT:
+                by.setdefault(op.client, []).append(_make_item(op, off, char_log, root))
+            elif op.kind == KIND_DELETE:
+                has_delete = True
+        if by and root is None:
+            return None  # cursor unmoved: ops broadcast once root resolves
+        if not by and not has_delete:
+            self.broadcast_cursor[slot] = len(log)
+            return None
+        for items in by.values():
+            items.sort(key=lambda item: item.id.clock)
+        encoder = Encoder()
+        encoder.write_var_uint(len(by))
+        for client in sorted(by, reverse=True):
+            items = by[client]
+            _write_structs(encoder, items, client, items[0].id.clock)
+        if has_delete:
+            self._device_delete_set(slot).write(encoder)
+        else:
+            DeleteSet().write(encoder)
+        self.broadcast_cursor[slot] = len(log)
+        plane.counters["plane_broadcasts"] += 1
+        return encoder.to_bytes()
+
+
+class TpuSyncSource:
+    """`document.sync_source` adapter: SyncStep2 bytes from the plane.
+
+    Any serving error degrades to the CPU path (return None) rather
+    than failing the client's sync.
+    """
+
+    def __init__(self, serving: PlaneServing, name: str, document) -> None:
+        self.serving = serving
+        self.name = name
+        self.document = document
+
+    def encode_state_as_update(self, sv_bytes: Optional[bytes]) -> Optional[bytes]:
+        try:
+            return self.serving.encode_state_as_update(self.name, self.document, sv_bytes)
+        except Exception:
+            from ..server import logger as _logger_mod
+
+            _logger_mod.log_error(
+                f"plane sync serve failed for {self.name!r}; using CPU path"
+            )
+            return None

@@ -202,10 +202,59 @@ async def test_oversized_frame_closes_with_message_too_big():
         await ws.send_bytes(b"\x03big\x00" + b"x" * 20000)
         msg = await ws.receive(timeout=5)
         assert msg.type in (aiohttp.WSMsgType.CLOSE, aiohttp.WSMsgType.CLOSED)
+        if msg.type == aiohttp.WSMsgType.CLOSE:
+            assert msg.data == 1009
         await session.close()
 
         provider.document.get_text("t").insert(0, "still alive")
         await wait_for(lambda: not provider.has_unsynced_changes)
+        provider.destroy()
+    finally:
+        await server.destroy()
+
+
+async def test_invalid_opcode_closes_with_protocol_error():
+    """A malformed ws frame (reserved opcode) must NOT be mislabeled
+    1009 MessageTooBig; the server replies 1002 and stays up."""
+    import base64
+    import os as _os
+    from urllib.parse import urlparse
+
+    from hocuspocus_tpu.server import Configuration, Server
+    from tests.utils import new_provider, wait_for
+
+    server = Server(Configuration(quiet=True))
+    await server.listen(port=0)
+    try:
+        parsed = urlparse(server.web_socket_url)
+        reader, writer = await asyncio.open_connection(parsed.hostname, parsed.port)
+        key = base64.b64encode(_os.urandom(16)).decode()
+        writer.write(
+            (
+                f"GET / HTTP/1.1\r\nHost: {parsed.hostname}:{parsed.port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        await writer.drain()
+        handshake = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=5)
+        assert b"101" in handshake.split(b"\r\n", 1)[0]
+
+        # FIN + reserved opcode 0x3, masked, zero-length payload
+        writer.write(bytes([0x83, 0x80, 0, 0, 0, 0]))
+        await writer.drain()
+
+        frame = await asyncio.wait_for(reader.readexactly(2), timeout=5)
+        assert frame[0] & 0x0F == 0x08, "expected a close frame"
+        length = frame[1] & 0x7F
+        payload = await asyncio.wait_for(reader.readexactly(length), timeout=5)
+        close_code = int.from_bytes(payload[:2], "big")
+        assert close_code == 1002, f"expected 1002 protocol error, got {close_code}"
+        writer.close()
+
+        # server survives: a healthy provider still syncs
+        provider = new_provider(server, name="pe-survivor")
+        await wait_for(lambda: provider.synced)
         provider.destroy()
     finally:
         await server.destroy()

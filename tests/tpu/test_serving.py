@@ -1,0 +1,181 @@
+"""TPU merge plane as the SERVING path (serve=True).
+
+Proves the promotion from shadow mirror to serving substrate:
+- a fresh client's SyncStep2 reply is produced from device state (the
+  CPU encode path is poisoned for the test, so success is proof);
+- steady-state broadcasts are batched per device flush, not per update;
+- degradation (unsupported content, forced desync) falls back to the
+  CPU path without losing data, and is counted.
+
+Reference behavior being replaced: readSyncStep1 reply + per-update
+broadcast in `packages/server/src/MessageReceiver.ts:137-213` and
+`packages/server/src/Document.ts:228-240`.
+"""
+
+import asyncio
+
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_sync_reply_served_from_device_state(monkeypatch):
+    """A late joiner syncs entirely from plane state: the CPU SyncStep2
+    encoder is poisoned, so a successful sync proves device serving."""
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    provider_a = new_provider(server, name="served")
+    try:
+        await wait_synced(provider_a)
+        provider_a.document.get_text("body").insert(0, "from the device")
+        await retryable_assertion(
+            lambda: _assert(ext.plane.text("served") == "from the device")
+        )
+
+        # poison the CPU fallback: if the server builds SyncStep2 from the
+        # CPU document, the late joiner can never sync
+        import hocuspocus_tpu.server.message_receiver as mr
+
+        def poisoned(encoder, doc, sv=None):
+            raise AssertionError("CPU write_sync_step2 used for a plane-served doc")
+
+        monkeypatch.setattr(mr, "write_sync_step2", poisoned)
+
+        provider_b = new_provider(server, name="served")
+        await wait_synced(provider_b)
+        assert provider_b.document.get_text("body").to_string() == "from the device"
+        assert ext.plane.counters["sync_serves"] >= 1
+        provider_b.destroy()
+    finally:
+        provider_a.destroy()
+        await server.destroy()
+
+
+async def test_broadcast_is_batched_through_device_flush():
+    """With a long flush interval, edits reach peers only after the device
+    flush — proof the per-update CPU fan-out was suppressed and replaced
+    by the plane's merged broadcast."""
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=300, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    provider_a = new_provider(server, name="batched")
+    provider_b = new_provider(server, name="batched")
+    try:
+        await wait_synced(provider_a, provider_b)
+        text_b = provider_b.document.get_text("body")
+        provider_a.document.get_text("body").insert(0, "deferred")
+        # the update reaches the server well before the 300 ms flush, and
+        # must NOT have been fan-out broadcast immediately
+        await asyncio.sleep(0.1)
+        assert text_b.to_string() == ""
+        await retryable_assertion(lambda: _assert(text_b.to_string() == "deferred"))
+        assert ext.plane.counters["plane_broadcasts"] >= 1
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_concurrent_edits_converge_through_plane():
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    provider_a = new_provider(server, name="conv")
+    provider_b = new_provider(server, name="conv")
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_text("body").insert(0, "alpha ")
+        provider_b.document.get_text("body").insert(0, "beta ")
+
+        def converged():
+            a = provider_a.document.get_text("body").to_string()
+            b = provider_b.document.get_text("body").to_string()
+            cpu = server.documents["conv"].get_text("body").to_string()
+            assert a == b == cpu and len(cpu) == 11
+
+        await retryable_assertion(converged)
+        # deletes flow through the plane's device tombstones
+        provider_a.document.get_text("body").delete(0, 5)
+
+        def deleted():
+            a = provider_a.document.get_text("body").to_string()
+            b = provider_b.document.get_text("body").to_string()
+            assert a == b and len(a) == 6
+
+        await retryable_assertion(deleted)
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_unsupported_content_falls_back_to_cpu_path():
+    """Map edits cannot live on the dense text arena: the doc degrades to
+    the CPU path, nothing is lost, and the degradation is counted."""
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    provider_a = new_provider(server, name="mapdoc")
+    provider_b = new_provider(server, name="mapdoc")
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_map("m").set("k", "v")
+        await retryable_assertion(
+            lambda: _assert(provider_b.document.get_map("m").get("k") == "v")
+        )
+        assert ext.plane.counters["docs_retired_unsupported"] >= 1
+        assert "mapdoc" not in ext._docs  # serving detached
+        # doc continues to work on the CPU path
+        provider_b.document.get_map("m").set("k2", "v2")
+        await retryable_assertion(
+            lambda: _assert(provider_a.document.get_map("m").get("k2") == "v2")
+        )
+        # late joiner syncs via CPU
+        provider_c = new_provider(server, name="mapdoc")
+        await wait_synced(provider_c)
+        assert provider_c.document.get_map("m").get("k") == "v"
+        provider_c.destroy()
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
+
+
+async def test_forced_desync_detected_and_recovered():
+    """Forcibly desync the host char log from the device arena: the next
+    flush detects it, retires the doc (counted), ships the full CPU
+    state so receivers stay whole, and serving detaches."""
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    provider_a = new_provider(server, name="desynced")
+    provider_b = new_provider(server, name="desynced")
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_text("body").insert(0, "healthy")
+        await retryable_assertion(
+            lambda: _assert(provider_b.document.get_text("body").to_string() == "healthy")
+        )
+        # corrupt: host log claims a unit the device never integrated
+        slot = ext.plane.slots["desynced"]
+        ext.plane.char_logs[slot].append(ord("x"))
+
+        provider_a.document.get_text("body").insert(7, " again")
+
+        def recovered():
+            assert ext.plane.counters["docs_retired_desync"] == 1
+            assert ext.plane.counters["cpu_fallbacks"] == 1
+            assert "desynced" not in ext._docs
+            assert provider_b.document.get_text("body").to_string() == "healthy again"
+
+        await retryable_assertion(recovered)
+        # steady state continues via CPU
+        provider_b.document.get_text("body").insert(0, ">> ")
+        await retryable_assertion(
+            lambda: _assert(
+                provider_a.document.get_text("body").to_string() == ">> healthy again"
+            )
+        )
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
