@@ -373,6 +373,8 @@ def _measure_server_p99() -> float:
                 t0 = _time.perf_counter()
                 wtext.insert(len(wtext.to_string()), "x" * 16)
                 while len(rtext.to_string()) < expected:
+                    if _time.perf_counter() - t0 > 10:
+                        raise TimeoutError(f"edit {i} never observed by reader")
                     await asyncio.sleep(0.0005)
                 return _time.perf_counter() - t0
 

@@ -166,7 +166,11 @@ class Item:
         # All dependencies present — resolve them.
         if origin is not None:
             self.left = store.get_item_clean_end(transaction, origin)
-            self.origin = self.left.last_id
+            # the origin may resolve into a GC struct (deleted + collected
+            # range from a real yjs peer): no last_id to take, and the
+            # GC-left check below nulls the parent so this item itself
+            # integrates as a GC struct (yjs Item.getMissing semantics)
+            self.origin = self.left.last_id if isinstance(self.left, Item) else None
         if right_origin is not None:
             self.right = store.get_item_clean_start(transaction, right_origin)
             self.right_origin = self.right.id

@@ -224,13 +224,21 @@ class DocLowerer:
             self.pending = remaining
             if self.unsupported:
                 return []
-        # deletes apply once their target range is known
+        # deletes apply to whatever prefix of the range is known NOW —
+        # mirroring the CPU path (_read_and_apply_delete_set), which
+        # tombstones the known sub-range immediately and keeps only the
+        # rest pending. Deferring the whole range would let a sync serve
+        # in the gap omit deletions the CPU document already applied.
         remaining_deletes = []
         for client, clock, length in self.pending_deletes:
-            if clock + length <= self.known.get(client, 0):
-                out.append(DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=length))
-            else:
-                remaining_deletes.append((client, clock, length))
+            known = self.known.get(client, 0)
+            upto = min(known, clock + length)
+            if upto > clock:
+                out.append(
+                    DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=upto - clock)
+                )
+            if upto < clock + length:
+                remaining_deletes.append((client, max(clock, upto), clock + length - max(clock, upto)))
         self.pending_deletes = remaining_deletes
         return out
 

@@ -457,10 +457,25 @@ class TpuMergeExtension(Extension):
     # -- flush ---------------------------------------------------------------
 
     def _flush(self) -> None:
-        self.plane.flush()
+        try:
+            self.plane.flush()
+            if self.serve:
+                self.serving.refresh()
+        except Exception:
+            # a plane-level device error must not strand captured docs:
+            # degrade every served doc to the CPU path (full-state
+            # broadcast) rather than silently dropping their updates
+            from ..server import logger as _logger_mod
+
+            _logger_mod.log_error("plane flush failed; degrading served docs to CPU")
+            for _, document in list(self._docs.items()):
+                try:
+                    self._fallback_to_cpu(document)
+                except Exception:
+                    _logger_mod.log_error(f"CPU fallback failed for {document.name!r}")
+            return
         if not self.serve:
             return
-        self.serving.refresh()
         for name, document in list(self._docs.items()):
             # per-doc guard: the stated safety model is "any serving
             # error degrades that doc to the CPU path" — an exception

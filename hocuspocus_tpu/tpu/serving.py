@@ -115,12 +115,29 @@ class PlaneServing:
 
     # -- encoding -----------------------------------------------------------
 
-    def _items_by_client(self, slot: int, root: Optional[str]) -> dict[int, list[Item]]:
+    def _group_items(
+        self,
+        slot: int,
+        root: Optional[str],
+        ops: list,
+        min_clock: Optional[dict[int, int]] = None,
+    ) -> dict[int, list[Item]]:
+        """Group an op-log slice into per-client clock-sorted Items.
+
+        min_clock trims fully-known items per client: an op is included
+        when any part of it is at/above the client's cutoff (the first
+        included item may overlap the cutoff — _write_structs emits it
+        with an offset), and clients absent from min_clock are skipped.
+        """
         by: dict[int, list[Item]] = {}
         log = self.plane.char_logs[slot]
-        for op, off in self.plane.op_logs[slot]:
+        for op, off in ops:
             if op.kind != KIND_INSERT:
                 continue
+            if min_clock is not None:
+                cutoff = min_clock.get(op.client)
+                if cutoff is None or op.clock + op.run_len <= cutoff:
+                    continue
             by.setdefault(op.client, []).append(_make_item(op, off, log, root))
         for items in by.values():
             items.sort(key=lambda item: item.id.clock)
@@ -163,14 +180,11 @@ class PlaneServing:
         if slot is None or not self.covers(name, document):
             return None
         root = plane.root_names.get(slot)
-        items_by_client = self._items_by_client(slot, root)
-        if items_by_client and root is None:
-            return None  # content exists but the root type is unresolved
+        # plane-integrated clocks ARE the local state vector (queue was
+        # just flushed), so the diff is computed before building Items —
+        # a nearly-current reconnect pays for its tail, not the full doc
+        local_sv = dict(plane.lowerers[slot].known)
         target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
-        local_sv = {
-            client: items[-1].id.clock + items[-1].length
-            for client, items in items_by_client.items()
-        }
         sm: dict[int, int] = {}
         for client, clock in target_sv.items():
             if local_sv.get(client, 0) > clock:
@@ -178,9 +192,12 @@ class PlaneServing:
         for client in local_sv:
             if client not in target_sv:
                 sm[client] = 0
+        items_by_client = self._group_items(slot, root, plane.op_logs[slot], sm)
+        if items_by_client and root is None:
+            return None  # content exists but the root type is unresolved
         encoder = Encoder()
-        encoder.write_var_uint(len(sm))
-        for client in sorted(sm, reverse=True):
+        encoder.write_var_uint(len(items_by_client))
+        for client in sorted(items_by_client, reverse=True):
             _write_structs(encoder, items_by_client[client], client, sm[client])
         self._device_delete_set(slot).write(encoder)
         plane.counters["sync_serves"] += 1
@@ -209,21 +226,13 @@ class PlaneServing:
         if not new:
             return None
         root = plane.root_names.get(slot)
-        by: dict[int, list[Item]] = {}
-        has_delete = False
-        char_log = plane.char_logs[slot]
-        for op, off in new:
-            if op.kind == KIND_INSERT:
-                by.setdefault(op.client, []).append(_make_item(op, off, char_log, root))
-            elif op.kind == KIND_DELETE:
-                has_delete = True
+        by = self._group_items(slot, root, new)
+        has_delete = any(op.kind == KIND_DELETE for op, _ in new)
         if by and root is None:
             return None  # cursor unmoved: ops broadcast once root resolves
         if not by and not has_delete:
             self.broadcast_cursor[slot] = len(log)
             return None
-        for items in by.values():
-            items.sort(key=lambda item: item.id.clock)
         encoder = Encoder()
         encoder.write_var_uint(len(by))
         for client in sorted(by, reverse=True):

@@ -1,0 +1,213 @@
+"""Golden-vector interop tests: complete yjs-v1 wire blobs as hex literals.
+
+These byte blobs are HAND-AUTHORED from the published Yjs v1 update
+format (UpdateEncoderV1 / lib0: varuint sections, struct info bits
+0x80 origin / 0x40 right-origin / 0x20 parent-sub, content refs
+GC=0 Deleted=1 JSON=2 Binary=3 String=4 Embed=5 Format=6 Type=7 Any=8,
+parent-info isYKey flag, trailing delete set) — NOT produced by this
+repo's encoder, and derived independently of its decoder. No yjs
+implementation exists in this image (no node, no y-py; zero egress), so
+these literals are the strongest available stand-in for captured
+editor traffic: if our decoder or encoder drifts from the spec in a
+way a real yjs peer would notice, these fail.
+
+Reference consumption point for such bytes:
+`/root/reference/packages/server/src/MessageReceiver.ts:195-213`
+(readUpdate of exactly this format from real editors).
+"""
+
+from hocuspocus_tpu.crdt import (
+    Doc,
+    apply_update,
+    encode_state_as_update,
+    encode_state_vector,
+)
+from hocuspocus_tpu.crdt.encoding import Decoder
+from hocuspocus_tpu.crdt.update import decode_state_vector
+from hocuspocus_tpu.protocol.sync import read_sync_message
+
+
+def _h(s: str) -> bytes:
+    return bytes.fromhex(s.replace(" ", ""))
+
+
+# client 42 inserts "hi" into root text "t":
+# [1 section][1 struct][client 42][clock 0]
+# [info 0x04 ContentString, no origins][parent: isYKey=1, "t"]["hi"][empty ds]
+BLOB_SIMPLE_INSERT = _h("01 01 2A 00 04 01 01 74 02 68 69 00")
+
+# two clients, YATA origins: 42 typed "ab"; 7 inserted "X" with
+# left origin (42,0) and right origin (42,1) -> "aXb"
+BLOB_CONCURRENT = _h(
+    "02"
+    " 01 2A 00 04 01 01 74 02 61 62"      # section client 42: Item "ab"
+    " 01 07 00 C4 2A 00 2A 01 01 58"      # section client 7: Item "X" w/ origins
+    " 00"
+)
+# the same two sections as separate updates, applied out of causal order
+BLOB_CONCURRENT_B = _h("01 01 07 00 C4 2A 00 2A 01 01 58 00")
+BLOB_CONCURRENT_A = _h("01 01 2A 00 04 01 01 74 02 61 62 00")
+
+# client 42 typed "abcd", then deleted "bc". yjs gc replaces deleted
+# TEXT content with ContentDeleted (GC structs only appear for gc'd
+# subtrees), so the re-encoded doc is: Item "a" (clock 0),
+# Item ContentDeleted len 2 (clock 1, origin (42,0)), Item "d"
+# (clock 3, origin (42,2)); delete set {42: [(1, 2)]}
+BLOB_CONTENT_DELETED = _h(
+    "01 03 2A 00"
+    " 04 01 01 74 01 61"                  # Item "a"
+    " 81 2A 00 02"                        # Item ContentDeleted(2), origin (42,0)
+    " 84 2A 02 01 64"                     # Item "d", origin (42,2)
+    " 01 2A 01 01 02"                     # ds: client 42, range (1, 2)
+)
+
+# degenerate-but-legal input a yjs peer can emit when a SUBTREE was
+# gc'd: a GC struct in the middle of a client's range, with a later
+# item anchored into the collected range. yjs Item.getMissing nulls the
+# parent when an origin resolves into GC, integrating the item itself
+# as GC — "d" is collected, not resurrected.
+BLOB_GC_ANCHORED = _h(
+    "01 03 2A 00"
+    " 04 01 01 74 01 61"                  # Item "a"
+    " 00 02"                              # GC struct, length 2
+    " 84 2A 02 01 64"                     # Item "d", origin (42,2) -> into GC
+    " 01 2A 01 01 02"                     # ds: client 42, range (1, 2)
+)
+
+# rich text: client 99 wrote bold "x" into "t":
+# ContentFormat(bold=true), ContentString "x", ContentFormat(bold=null)
+BLOB_FORMAT = _h(
+    "01 03 63 00"
+    " 06 01 01 74 04 62 6F 6C 64 04 74 72 75 65"  # <bold true>
+    " 84 63 00 01 78"                              # "x"
+    " 86 63 01 04 62 6F 6C 64 04 6E 75 6C 6C"     # <bold null>
+    " 00"
+)
+
+# state vector {42: 4, 7: 1}
+BLOB_STATE_VECTOR = _h("02 2A 04 07 01")
+
+# y-protocols sync frames
+BLOB_SYNC_STEP1 = _h("00 05") + BLOB_STATE_VECTOR
+BLOB_SYNC_STEP2 = _h("01") + bytes([len(BLOB_SIMPLE_INSERT)]) + BLOB_SIMPLE_INSERT
+BLOB_SYNC_UPDATE = _h("02") + bytes([len(BLOB_CONCURRENT)]) + BLOB_CONCURRENT
+
+
+def _reencode_roundtrip(doc: Doc) -> Doc:
+    """Re-encode a doc and apply to a fresh doc (encoder must emit bytes
+    a spec-conforming peer can consume)."""
+    fresh = Doc()
+    apply_update(fresh, encode_state_as_update(doc))
+    return fresh
+
+
+def test_simple_insert_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_SIMPLE_INSERT)
+    assert doc.get_text("t").to_string() == "hi"
+    assert doc.store.get_state_vector() == {42: 2}
+    assert _reencode_roundtrip(doc).get_text("t").to_string() == "hi"
+    # strict: our encoder must reproduce the hand-authored bytes exactly
+    assert encode_state_as_update(doc) == BLOB_SIMPLE_INSERT
+
+
+def test_concurrent_origins_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_CONCURRENT)
+    assert doc.get_text("t").to_string() == "aXb"
+    assert doc.store.get_state_vector() == {42: 2, 7: 1}
+    assert _reencode_roundtrip(doc).get_text("t").to_string() == "aXb"
+
+
+def test_out_of_causal_order_application():
+    """Client 7's item arrives before its origins exist: it must buffer
+    as pending and integrate once client 42's update lands."""
+    doc = Doc()
+    apply_update(doc, BLOB_CONCURRENT_B)
+    assert doc.get_text("t").to_string() == ""
+    apply_update(doc, BLOB_CONCURRENT_A)
+    assert doc.get_text("t").to_string() == "aXb"
+
+
+def test_content_deleted_and_delete_set_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_CONTENT_DELETED)
+    assert doc.get_text("t").to_string() == "ad"
+    assert doc.store.get_state_vector() == {42: 4}
+    assert _reencode_roundtrip(doc).get_text("t").to_string() == "ad"
+
+
+def test_gc_anchored_item_is_collected():
+    doc = Doc()
+    apply_update(doc, BLOB_GC_ANCHORED)
+    assert doc.get_text("t").to_string() == "a"
+    # the collected range still counts in the state vector
+    assert doc.store.get_state_vector() == {42: 4}
+    assert _reencode_roundtrip(doc).get_text("t").to_string() == "a"
+
+
+def test_format_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_FORMAT)
+    text = doc.get_text("t")
+    assert text.to_string() == "x"
+    delta = text.to_delta()
+    assert delta == [{"insert": "x", "attributes": {"bold": True}}]
+    fresh = _reencode_roundtrip(doc)
+    assert fresh.get_text("t").to_delta() == delta
+
+
+def test_state_vector_blob():
+    assert decode_state_vector(BLOB_STATE_VECTOR) == {42: 4, 7: 1}
+    # our encoder writes clients descending; yjs accepts any order —
+    # round-trip through decode must be value-equal
+    assert decode_state_vector(encode_state_vector({42: 4, 7: 1})) == {42: 4, 7: 1}
+
+
+def test_sync_frames():
+    from hocuspocus_tpu.crdt.encoding import Encoder
+
+    # step1: a peer asks with sv {42:4, 7:1}; we must answer step2 with
+    # exactly the missing structs
+    doc = Doc()
+    apply_update(doc, BLOB_CONCURRENT)  # sv {42:2, 7:1}
+    out = Encoder()
+    read_sync_message(Decoder(BLOB_SYNC_STEP1), out, doc)
+    reply = out.to_bytes()
+    assert reply[0] == 1  # step2
+    # peer already has everything we do -> empty diff applies cleanly
+    peer = Doc()
+    apply_update(peer, BLOB_CONCURRENT)
+    read_sync_message(Decoder(reply), Encoder(), peer)
+    assert peer.get_text("t").to_string() == "aXb"
+
+    # step2 frame carries the simple-insert update
+    doc2 = Doc()
+    read_sync_message(Decoder(BLOB_SYNC_STEP2), Encoder(), doc2)
+    assert doc2.get_text("t").to_string() == "hi"
+
+    # update frame carries the concurrent update
+    doc3 = Doc()
+    read_sync_message(Decoder(BLOB_SYNC_UPDATE), Encoder(), doc3)
+    assert doc3.get_text("t").to_string() == "aXb"
+
+
+def test_native_codec_decodes_golden_blobs():
+    """The C++ codec (TPU lowering hot path) must read the same wire."""
+    from hocuspocus_tpu.native import get_codec
+
+    codec = get_codec()
+    if codec is None:
+        import pytest
+
+        pytest.skip("native codec unavailable")
+    structs, deletes = codec.decode_update(BLOB_CONTENT_DELETED)
+    kinds = [s[2] for s in structs]
+    assert 1 in kinds  # ContentDeleted struct seen
+    texts = [s[7] for s in structs if s[2] == 0]
+    assert texts == ["a", "d"]
+    assert [tuple(d) for d in deletes] == [(42, 1, 2)]
+
+    structs, deletes = codec.decode_update(BLOB_GC_ANCHORED)
+    assert 2 in [s[2] for s in structs]  # GC struct seen
+    assert [tuple(d) for d in deletes] == [(42, 1, 2)]

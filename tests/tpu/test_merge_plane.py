@@ -260,3 +260,51 @@ def test_overlapping_snapshot_emits_tail():
     plane.enqueue_update("d", full)
     plane.flush()
     assert plane.text("d") == "abcdef"
+
+
+def test_partial_delete_range_applies_known_prefix():
+    """A delete set covering a partially-known range must tombstone the
+    known prefix immediately (CPU _read_and_apply_delete_set parity) —
+    deferring the whole range would let a sync serve omit deletions the
+    CPU document already applied."""
+    from hocuspocus_tpu.crdt.encoding import Encoder
+    from hocuspocus_tpu.tpu.kernels import KIND_DELETE
+    from hocuspocus_tpu.tpu.lowering import DocLowerer
+
+    # hand-built update: client 9 structs "abc" (clocks 0-2), plus a
+    # delete set claiming (client 9, clock 0, len 5) — clocks 3-4 unknown
+    enc = Encoder()
+    enc.write_var_uint(1)  # sections
+    enc.write_var_uint(1)  # structs
+    enc.write_var_uint(9)  # client
+    enc.write_var_uint(0)  # clock
+    enc.write_uint8(0x04)  # ContentString, no origins
+    enc.write_var_uint(1)  # parent isYKey
+    enc.write_var_string("t")
+    enc.write_var_string("abc")
+    enc.write_var_uint(1)  # ds clients
+    enc.write_var_uint(9)
+    enc.write_var_uint(1)  # ranges
+    enc.write_var_uint(0)  # clock
+    enc.write_var_uint(5)  # len
+    lowerer = DocLowerer()
+    ops = lowerer.lower_update(enc.to_bytes())
+    deletes = [op for op in ops if op.kind == KIND_DELETE]
+    assert [(d.clock, d.run_len) for d in deletes] == [(0, 3)]
+    assert lowerer.pending_deletes == [(9, 3, 2)]
+
+    # once clocks 3-4 arrive, the remainder of the range applies
+    enc2 = Encoder()
+    enc2.write_var_uint(1)
+    enc2.write_var_uint(1)
+    enc2.write_var_uint(9)
+    enc2.write_var_uint(3)
+    enc2.write_uint8(0x84)  # origin present
+    enc2.write_var_uint(9)
+    enc2.write_var_uint(2)
+    enc2.write_var_string("de")
+    enc2.write_var_uint(0)  # empty ds
+    ops2 = lowerer.lower_update(enc2.to_bytes())
+    deletes2 = [op for op in ops2 if op.kind == KIND_DELETE]
+    assert [(d.clock, d.run_len) for d in deletes2] == [(3, 2)]
+    assert lowerer.pending_deletes == []
