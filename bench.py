@@ -133,11 +133,16 @@ def main() -> None:
         if result.get("extra", {}).get("backend") == "cpu":
             errors.append(f"bench-{_label(platforms)}:landed-on-cpu")
             continue
+        _attach_baseline_scale_pass(result, platforms)
         if errors:
             result.setdefault("extra", {})["failed_attempts"] = errors
         print(json.dumps(result))
         return
-    # graceful degradation: a CPU number beats rc=1 with a traceback
+    # graceful degradation: a CPU number beats rc=1 with a traceback.
+    # The CPU fallback runs a reduced doc count (unless the caller pinned
+    # one) so it always fits the attempt timeout.
+    if "BENCH_DOCS" not in os.environ:
+        os.environ["BENCH_DOCS"] = "2048"
     for _ in range(2):
         result = _run_inner("cpu")
         if result is not None:
@@ -159,6 +164,58 @@ def main() -> None:
     sys.exit(1)
 
 
+def _attach_baseline_scale_pass(result: dict, platforms: "str | None") -> None:
+    """On a live TPU, also run the BASELINE-regime scale point (100k docs
+    x 10KB capacity ~ 9.6 GB HBM) and attach it under extra.baseline_scale.
+    Never jeopardizes the headline result."""
+    if os.environ.get("BENCH_BASELINE_SCALE", "1") == "0" or "BENCH_DOCS" in os.environ:
+        return
+    env = _env_for(platforms)
+    env.update(
+        {
+            "BENCH_DOCS": "100000",
+            "BENCH_CAPACITY": "5632",
+            "BENCH_STEPS": "8",
+            "BENCH_SERVER_P99": "0",
+            "BENCH_BASELINE_SCALE": "0",
+        }
+    )
+    # a short independent budget: losing the scale point must never cost
+    # the already-computed headline number under an outer deadline
+    scale_timeout = int(os.environ.get("BENCH_SCALE_TIMEOUT", 300))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=scale_timeout,
+        )
+    except subprocess.TimeoutExpired:
+        result.setdefault("extra", {})["baseline_scale"] = {"error": "timeout"}
+        return
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                scale = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            result.setdefault("extra", {})["baseline_scale"] = {
+                "merges_per_sec": scale.get("value"),
+                **{
+                    k: v
+                    for k, v in scale.get("extra", {}).items()
+                    if k in ("docs", "capacity", "total_merges", "p99_microbatch_ms", "backend")
+                },
+            }
+            return
+    result.setdefault("extra", {})["baseline_scale"] = {
+        "error": f"rc={proc.returncode}",
+        "stderr_tail": proc.stderr[-300:],
+    }
+
+
 def run_bench() -> None:
     import jax
 
@@ -177,8 +234,12 @@ def run_bench() -> None:
 
     MAX_RUN = 16  # UTF-16 units per synthetic insert op (typing-burst sized)
 
+    # defaults size the BASELINE 10KB-doc regime: capacity 5632 holds a
+    # 5,120-unit (10,240-byte UTF-16) document with headroom. HBM model:
+    # ~17 B/unit (4+4+4+4+1) -> 8192 docs x 5632 x 17 B = 0.78 GB;
+    # the 100k-doc pass (below) = 9.6 GB, inside a v5e chip's 16 GB.
     num_docs = int(os.environ.get("BENCH_DOCS", 8192))
-    capacity = int(os.environ.get("BENCH_CAPACITY", 2048))
+    capacity = int(os.environ.get("BENCH_CAPACITY", 5632))
     k = int(os.environ.get("BENCH_SLOTS", 64))
     steps = int(os.environ.get("BENCH_STEPS", 20))
 

@@ -11,10 +11,11 @@ on document load, and delayed unsubscribe on disconnect.
 from __future__ import annotations
 
 import asyncio
+import random
 import uuid
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
-from ..net.resp import RedisClient, RedisSubscriber
+from ..net.resp import ClusterSubscriber, RedisClient, RedisClusterClient, RedisSubscriber
 from ..protocol.message import IncomingMessage, OutgoingMessage
 from ..server import REDIS_ORIGIN, logger
 from ..server.message_receiver import MessageReceiver
@@ -27,6 +28,16 @@ class LockContention(Exception):
 
     def __init__(self) -> None:
         super().__init__("")
+
+
+class _HeldLock:
+    __slots__ = ("token", "count", "extend_handle", "extends")
+
+    def __init__(self, token: str) -> None:
+        self.token = token
+        self.count = 1
+        self.extend_handle: Optional[asyncio.TimerHandle] = None
+        self.extends = 0
 
 
 class Redis(Extension):
@@ -42,19 +53,47 @@ class Redis(Extension):
         identifier: Optional[str] = None,
         lock_timeout: int = 1000,
         disconnect_delay: int = 1000,
+        nodes: Optional[list] = None,
+        create_client: Optional[Callable[[], Any]] = None,
+        create_subscriber: Optional[Callable[[Callable[[bytes, bytes], None]], Any]] = None,
+        lock_retry_count: int = 10,
+        lock_retry_delay: int = 100,
+        lock_auto_extend: bool = True,
+        lock_max_extends: int = 20,
     ) -> None:
+        """Production seams beyond host/port (reference
+        `extension-redis/src/Redis.ts:19-50,96-140`): `nodes` switches to
+        a slot-routed cluster client; `create_client`/`create_subscriber`
+        inject arbitrary client objects (any `RedisCommands`-shaped /
+        subscriber-shaped implementation); the store lock retries with
+        jittered delay and auto-extends at ttl/2 while a slow store runs.
+        """
         self.host = host
         self.port = port
         self.prefix = prefix
         self.identifier = identifier or f"host-{uuid.uuid4()}"
         self.lock_timeout = lock_timeout
         self.disconnect_delay = disconnect_delay
+        self.lock_retry_count = lock_retry_count
+        self.lock_retry_delay = lock_retry_delay
+        self.lock_auto_extend = lock_auto_extend
+        self.lock_max_extends = lock_max_extends
 
         self.redis_transaction_origin = REDIS_ORIGIN
-        self.pub = RedisClient(host, port)
-        self.sub = RedisSubscriber(host, port, on_message=self._handle_incoming_message)
+        if create_client is not None:
+            self.pub = create_client()
+        elif nodes:
+            self.pub = RedisClusterClient(nodes)
+        else:
+            self.pub = RedisClient(host, port)
+        if create_subscriber is not None:
+            self.sub = create_subscriber(self._handle_incoming_message)
+        elif nodes:
+            self.sub = ClusterSubscriber(nodes, on_message=self._handle_incoming_message)
+        else:
+            self.sub = RedisSubscriber(host, port, on_message=self._handle_incoming_message)
         self.instance = None
-        self.locks: dict[str, str] = {}  # lock key -> token
+        self.locks: dict[str, _HeldLock] = {}  # lock key -> held state
         self._pending_disconnects: dict[str, asyncio.TimerHandle] = {}
         self._pending_after_store: dict[str, asyncio.TimerHandle] = {}
         identifier_bytes = self.identifier.encode()
@@ -103,23 +142,82 @@ class Redis(Extension):
         )
 
     async def on_store_document(self, data: Payload) -> None:
-        """Acquire the distributed store lock; losing means another
-        instance stores — halt the chain silently."""
+        """Acquire the distributed store lock; losing after all retries
+        means another instance stores — halt the chain silently."""
         resource = self.lock_key(data.document_name)
+        held = self.locks.get(resource)
+        if held is not None:
+            # concurrent store of the same doc on this instance (the
+            # saveMutex makes this rare): reenter instead of clobbering
+            # the token and orphaning the first holder's release
+            held.count += 1
+            return
         token = str(uuid.uuid4())
-        acquired = await self.pub.acquire_lock(resource, token, self.lock_timeout)
-        if not acquired:
-            raise LockContention()
-        self.locks[resource] = token
+        for attempt in range(self.lock_retry_count + 1):
+            if await self.pub.acquire_lock(resource, token, self.lock_timeout):
+                held = _HeldLock(token)
+                self.locks[resource] = held
+                if self.lock_auto_extend:
+                    self._schedule_lock_extend(resource, held)
+                return
+            if attempt < self.lock_retry_count:
+                delay = self.lock_retry_delay * (0.5 + random.random())
+                await asyncio.sleep(delay / 1000)
+        raise LockContention()
+
+    def _schedule_lock_extend(self, resource: str, held: _HeldLock) -> None:
+        """Keep a held lock alive while a slow store runs (ttl/2 cadence;
+        the reference's redlock extends the same way)."""
+
+        def extend() -> None:
+            if self.locks.get(resource) is not held:
+                return
+            # bounded: a leaked lock (process wedged mid-store) must
+            # eventually expire so other instances can store again
+            held.extends += 1
+            if held.extends > self.lock_max_extends:
+                return
+
+            async def run() -> None:
+                try:
+                    still_held = await self.pub.extend_lock(
+                        resource, held.token, self.lock_timeout
+                    )
+                except Exception:
+                    return  # redis gone: the lock will expire on its own
+                if still_held and self.locks.get(resource) is held:
+                    self._schedule_lock_extend(resource, held)
+
+            asyncio.ensure_future(run())
+
+        held.extend_handle = asyncio.get_event_loop().call_later(
+            self.lock_timeout / 2000, extend
+        )
+
+    async def _release_store_lock(self, document_name: str) -> None:
+        resource = self.lock_key(document_name)
+        held = self.locks.get(resource)
+        if held is not None:
+            held.count -= 1
+            if held.count <= 0:
+                self.locks.pop(resource, None)
+                if held.extend_handle is not None:
+                    held.extend_handle.cancel()
+                try:
+                    await self.pub.release_lock(resource, held.token)
+                except Exception:
+                    pass  # lock expires on its own
+
+    async def on_store_document_failed(self, data: Payload) -> None:
+        """A later store hook failed: release our lock so other instances
+        can store (after_store_document is skipped on chain failure)."""
+        await self._release_store_lock(data.document_name)
 
     async def after_store_document(self, data: Payload) -> None:
-        resource = self.lock_key(data.document_name)
-        token = self.locks.pop(resource, None)
-        if token is not None:
-            try:
-                await self.pub.release_lock(resource, token)
-            except Exception:
-                pass  # lock expires on its own
+        await self._release_store_lock(data.document_name)
+        await self._direct_connection_grace(data)
+
+    async def _direct_connection_grace(self, data: Payload) -> None:
         # Direct-connection stores need a grace period so sync messages
         # reach the subscription before disconnect tears it down.
         if data.socket_id == "server":
@@ -206,5 +304,8 @@ class Redis(Extension):
             handle.cancel()
         for handle in list(self._pending_after_store.values()):
             handle.cancel()
+        for held in list(self.locks.values()):
+            if held.extend_handle is not None:
+                held.extend_handle.cancel()
         self.pub.close()
         self.sub.close()

@@ -13,7 +13,7 @@ import asyncio
 import time
 from typing import Optional
 
-from .resp import CRLF, RELEASE_LOCK_SCRIPT, read_reply
+from .resp import CRLF, EXTEND_LOCK_SCRIPT, RELEASE_LOCK_SCRIPT, key_hash_slot, read_reply
 
 
 def _bulk(data: Optional[bytes]) -> bytes:
@@ -26,6 +26,10 @@ def _array(items: list[bytes]) -> bytes:
     return b"*%d\r\n%s" % (len(items), b"".join(items))
 
 
+def _int(value: int) -> bytes:
+    return b":%d\r\n" % value
+
+
 class MiniRedis:
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self.host = host
@@ -34,6 +38,35 @@ class MiniRedis:
         # channel -> set of writer streams
         self.subscribers: dict[bytes, set[asyncio.StreamWriter]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        # cluster emulation: list of (start, end, MiniRedis) covering the
+        # slot space; keyed commands off this node's ranges answer MOVED,
+        # publishes fan out to every node's subscribers (the cluster bus)
+        self.cluster_ranges: Optional[list[tuple[int, int, "MiniRedis"]]] = None
+
+    def configure_cluster(self, ranges: list[tuple[int, int, "MiniRedis"]]) -> None:
+        self.cluster_ranges = ranges
+
+    def _owns(self, key: bytes) -> Optional["MiniRedis"]:
+        """None if this node owns the key's slot, else the owning node."""
+        if self.cluster_ranges is None:
+            return None
+        slot = key_hash_slot(key)
+        for start, end, node in self.cluster_ranges:
+            if start <= slot <= end:
+                return None if node is self else node
+        return None
+
+    def _deliver(self, channel: bytes, payload: bytes) -> int:
+        receivers = self.subscribers.get(channel, set())
+        message = _array([_bulk(b"message"), _bulk(channel), _bulk(payload)])
+        delivered = 0
+        for sub_writer in list(receivers):
+            try:
+                sub_writer.write(message)
+                delivered += 1
+            except Exception:
+                receivers.discard(sub_writer)
+        return delivered
 
     async def start(self) -> "MiniRedis":
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -69,8 +102,39 @@ class MiniRedis:
                     continue
                 command = request[0].upper()
                 args = request[1:]
+                # cluster slot check for keyed commands
+                routed_key: Optional[bytes] = None
+                if command in (b"SET", b"GET", b"DEL") and args:
+                    routed_key = args[0]
+                elif command == b"EVAL" and len(args) > 2 and int(args[1]) > 0:
+                    routed_key = args[2]
+                if routed_key is not None:
+                    owner = self._owns(routed_key)
+                    if owner is not None:
+                        writer.write(
+                            b"-MOVED %d %s:%d\r\n"
+                            % (key_hash_slot(routed_key), owner.host.encode(), owner.port)
+                        )
+                        await writer.drain()
+                        continue
                 if command == b"PING":
                     writer.write(b"+PONG\r\n")
+                elif command == b"CLUSTER" and args and args[0].upper() == b"SLOTS":
+                    if self.cluster_ranges is None:
+                        writer.write(b"-ERR This instance has cluster support disabled\r\n")
+                    else:
+                        entries = []
+                        for start, end, node in self.cluster_ranges:
+                            entries.append(
+                                _array(
+                                    [
+                                        _int(start),
+                                        _int(end),
+                                        _array([_bulk(node.host.encode()), _int(node.port)]),
+                                    ]
+                                )
+                            )
+                        writer.write(_array(entries))
                 elif command == b"SET":
                     key, value = args[0], args[1]
                     nx = False
@@ -115,19 +179,27 @@ class MiniRedis:
                             writer.write(b":1\r\n")
                         else:
                             writer.write(b":0\r\n")
+                    elif script == EXTEND_LOCK_SCRIPT:
+                        if keys and self._get(keys[0]) == (script_args[0] if script_args else None):
+                            value, _ = self.data[keys[0]]
+                            ttl_ms = int(script_args[1])
+                            self.data[keys[0]] = (value, time.monotonic() + ttl_ms / 1000)
+                            writer.write(b":1\r\n")
+                        else:
+                            writer.write(b":0\r\n")
                     else:
                         writer.write(b"-ERR unsupported script\r\n")
                 elif command == b"PUBLISH":
                     channel, payload = args[0], args[1]
-                    receivers = self.subscribers.get(channel, set())
-                    message = _array([_bulk(b"message"), _bulk(channel), _bulk(payload)])
-                    delivered = 0
-                    for sub_writer in list(receivers):
-                        try:
-                            sub_writer.write(message)
-                            delivered += 1
-                        except Exception:
-                            receivers.discard(sub_writer)
+                    delivered = self._deliver(channel, payload)
+                    if self.cluster_ranges is not None:
+                        # cluster bus: published messages reach every
+                        # node's subscribers (each node once)
+                        seen: set[int] = set()
+                        for _, _, node in self.cluster_ranges:
+                            if node is not self and id(node) not in seen:
+                                seen.add(id(node))
+                                delivered += node._deliver(channel, payload)
                     writer.write(b":%d\r\n" % delivered)
                 elif command == b"SUBSCRIBE":
                     for channel in args:

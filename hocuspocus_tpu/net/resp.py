@@ -18,6 +18,40 @@ RELEASE_LOCK_SCRIPT = (
     "else return 0 end"
 )
 
+# compare-and-pexpire: extend a held lock without a release/re-acquire
+# window (the reference's redlock extends the same way)
+EXTEND_LOCK_SCRIPT = (
+    'if redis.call("get",KEYS[1]) == ARGV[1] then return redis.call("pexpire",KEYS[1],ARGV[2]) '
+    "else return 0 end"
+)
+
+# CRC16-CCITT (XModem) — redis cluster's key->slot hash
+_CRC16_TABLE = []
+for _byte in range(256):
+    _crc = _byte << 8
+    for _ in range(8):
+        _crc = ((_crc << 1) ^ 0x1021) if (_crc & 0x8000) else (_crc << 1)
+    _CRC16_TABLE.append(_crc & 0xFFFF)
+
+
+def crc16(data: bytes) -> int:
+    crc = 0
+    for b in data:
+        crc = ((crc << 8) & 0xFFFF) ^ _CRC16_TABLE[((crc >> 8) & 0xFF) ^ b]
+    return crc
+
+
+def key_hash_slot(key: Union[str, bytes]) -> int:
+    """Redis cluster slot for a key, honoring {hash tags}."""
+    if isinstance(key, str):
+        key = key.encode()
+    start = key.find(b"{")
+    if start != -1:
+        end = key.find(b"}", start + 1)
+        if end != -1 and end != start + 1:
+            key = key[start + 1 : end]
+    return crc16(key) % 16384
+
 
 def encode_command(*args: Union[bytes, str, int, float]) -> bytes:
     out = bytearray(b"*%d\r\n" % len(args))
@@ -61,7 +95,58 @@ async def read_reply(reader: asyncio.StreamReader) -> Any:
     raise RespError(f"unexpected RESP reply type {kind!r}")
 
 
-class RedisClient:
+class RedisCommands:
+    """Convenience commands shared by the single-node and cluster
+    clients. `execute(*args, key=...)` routes by key on the cluster."""
+
+    async def execute(self, *args, key: Optional[Union[str, bytes]] = None) -> Any:
+        raise NotImplementedError
+
+    async def ping(self) -> bool:
+        return await self.execute("PING") == "PONG"
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return await self.execute("GET", key, key=key)
+
+    async def set(
+        self,
+        key: str,
+        value: Union[bytes, str],
+        nx: bool = False,
+        px: Optional[int] = None,
+    ) -> Optional[str]:
+        args: list = ["SET", key, value]
+        if px is not None:
+            args += ["PX", px]
+        if nx:
+            args.append("NX")
+        return await self.execute(*args, key=key)
+
+    async def delete(self, *keys: str) -> int:
+        return await self.execute("DEL", *keys, key=keys[0] if keys else None)
+
+    async def publish(self, channel: str, data: Union[bytes, str]) -> int:
+        return await self.execute("PUBLISH", channel, data)
+
+    async def eval(self, script: str, keys: list[str], args: list) -> Any:
+        return await self.execute(
+            "EVAL", script, len(keys), *keys, *args, key=keys[0] if keys else None
+        )
+
+    async def flushall(self) -> None:
+        await self.execute("FLUSHALL")
+
+    async def acquire_lock(self, key: str, token: str, ttl_ms: int) -> bool:
+        return await self.set(key, token, nx=True, px=ttl_ms) == "OK"
+
+    async def release_lock(self, key: str, token: str) -> bool:
+        return bool(await self.eval(RELEASE_LOCK_SCRIPT, [key], [token]))
+
+    async def extend_lock(self, key: str, token: str, ttl_ms: int) -> bool:
+        return bool(await self.eval(EXTEND_LOCK_SCRIPT, [key], [token, ttl_ms]))
+
+
+class RedisClient(RedisCommands):
     """Request/response command client over one connection."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379) -> None:
@@ -79,7 +164,7 @@ class RedisClient:
     def connected(self) -> bool:
         return self.writer is not None and not self.writer.is_closing()
 
-    async def execute(self, *args: Union[bytes, str, int, float]) -> Any:
+    async def execute(self, *args: Union[bytes, str, int, float], key=None) -> Any:
         if not self.connected:
             await self.connect()
         async with self._lock:
@@ -88,51 +173,145 @@ class RedisClient:
             await self.writer.drain()
             return await read_reply(self.reader)
 
-    # convenience commands -------------------------------------------------
-
-    async def ping(self) -> bool:
-        return await self.execute("PING") == "PONG"
-
-    async def get(self, key: str) -> Optional[bytes]:
-        return await self.execute("GET", key)
-
-    async def set(
-        self,
-        key: str,
-        value: Union[bytes, str],
-        nx: bool = False,
-        px: Optional[int] = None,
-    ) -> Optional[str]:
-        args: list = ["SET", key, value]
-        if px is not None:
-            args += ["PX", px]
-        if nx:
-            args.append("NX")
-        return await self.execute(*args)
-
-    async def delete(self, *keys: str) -> int:
-        return await self.execute("DEL", *keys)
-
-    async def publish(self, channel: str, data: Union[bytes, str]) -> int:
-        return await self.execute("PUBLISH", channel, data)
-
-    async def eval(self, script: str, keys: list[str], args: list) -> Any:
-        return await self.execute("EVAL", script, len(keys), *keys, *args)
-
-    async def flushall(self) -> None:
-        await self.execute("FLUSHALL")
-
-    async def acquire_lock(self, key: str, token: str, ttl_ms: int) -> bool:
-        return await self.set(key, token, nx=True, px=ttl_ms) == "OK"
-
-    async def release_lock(self, key: str, token: str) -> bool:
-        return bool(await self.eval(RELEASE_LOCK_SCRIPT, [key], [token]))
+    async def execute_many(self, commands: list[tuple]) -> list[Any]:
+        """Pipeline several commands atomically on this connection (no
+        interleaving — needed for ASKING + redirected command pairs).
+        Error replies come back as RespError values, not raises, so the
+        stream stays in sync."""
+        if not self.connected:
+            await self.connect()
+        async with self._lock:
+            assert self.writer is not None and self.reader is not None
+            for command in commands:
+                self.writer.write(encode_command(*command))
+            await self.writer.drain()
+            replies: list[Any] = []
+            for _ in commands:
+                try:
+                    replies.append(await read_reply(self.reader))
+                except RespError as error:
+                    replies.append(error)
+            return replies
 
     def close(self) -> None:
         if self.writer is not None:
             self.writer.close()
             self.writer = None
             self.reader = None
+
+
+class RedisClusterClient(RedisCommands):
+    """Slot-routed Redis Cluster client with MOVED/ASK redirects.
+
+    The capability the reference gets from ioredis Cluster
+    (`extension-redis/src/Redis.ts:119-135` `nodes` + `options`): route
+    each keyed command to the node owning its hash slot, follow MOVED by
+    refreshing the slot map, honor one-shot ASK redirects. Pub/sub and
+    un-keyed commands go to any reachable node (cluster pub/sub is
+    broadcast across the bus server-side).
+    """
+
+    def __init__(self, nodes: list) -> None:
+        self.nodes: list[tuple[str, int]] = [self._normalize(n) for n in nodes]
+        if not self.nodes:
+            raise ValueError("RedisClusterClient needs at least one node")
+        self._clients: dict[tuple[str, int], RedisClient] = {}
+        # (start, end, (host, port)) ranges from CLUSTER SLOTS
+        self._ranges: list[tuple[int, int, tuple[str, int]]] = []
+        # rotates on connection failures so non-keyed commands (PUBLISH,
+        # PING) fail over instead of pinning to a dead seed
+        self._preferred = 0
+
+    @staticmethod
+    def _normalize(node) -> tuple[str, int]:
+        if isinstance(node, dict):
+            return (node.get("host", "127.0.0.1"), int(node.get("port", 6379)))
+        host, port = node
+        return (host, int(port))
+
+    def _client(self, node: tuple[str, int]) -> RedisClient:
+        client = self._clients.get(node)
+        if client is None:
+            client = RedisClient(*node)
+            self._clients[node] = client
+        return client
+
+    async def refresh_slots(self) -> None:
+        last_error: Optional[Exception] = None
+        for node in self.nodes:
+            try:
+                slots = await self._client(node).execute("CLUSTER", "SLOTS")
+            except Exception as error:  # node down — try the next seed
+                last_error = error
+                continue
+            ranges = []
+            for entry in slots or []:
+                start, end, master = entry[0], entry[1], entry[2]
+                host = master[0].decode() if isinstance(master[0], bytes) else master[0]
+                ranges.append((int(start), int(end), (host, int(master[1]))))
+            if ranges:
+                self._ranges = ranges
+                return
+        if last_error is not None:
+            raise last_error
+
+    def _node_for(self, key) -> tuple[str, int]:
+        if key is None or not self._ranges:
+            return self.nodes[self._preferred % len(self.nodes)]
+        slot = key_hash_slot(key)
+        for start, end, node in self._ranges:
+            if start <= slot <= end:
+                return node
+        return self.nodes[self._preferred % len(self.nodes)]
+
+    async def execute(self, *args, key=None) -> Any:
+        if not self._ranges:
+            try:
+                await self.refresh_slots()
+            except Exception:
+                pass  # single-node clusters may not speak CLUSTER SLOTS
+        node = self._node_for(key)
+        last_error: Optional[Exception] = None
+        for attempt in range(max(5, len(self.nodes) + 1)):
+            try:
+                return await self._client(node).execute(*args)
+            except (OSError, ConnectionError) as error:
+                # node unreachable: drop its connection and fail over to
+                # the next seed (a healthy node answers, possibly with a
+                # MOVED that re-routes us properly)
+                last_error = error
+                self._clients.pop(node, None)
+                self._preferred += 1
+                node = self.nodes[self._preferred % len(self.nodes)]
+                continue
+            except RespError as error:
+                message = str(error)
+                if message.startswith("MOVED "):
+                    _, _, target = message.split(" ", 2)
+                    host, _, port = target.rpartition(":")
+                    node = (host, int(port))
+                    try:
+                        await self.refresh_slots()
+                    except Exception:
+                        pass
+                    continue
+                if message.startswith("ASK "):
+                    _, _, target = message.split(" ", 2)
+                    host, _, port = target.rpartition(":")
+                    ask_client = self._client((host, int(port)))
+                    # ASKING + command must not interleave with other
+                    # users of the connection
+                    replies = await ask_client.execute_many([("ASKING",), tuple(args)])
+                    if isinstance(replies[1], RespError):
+                        raise replies[1]
+                    return replies[1]
+                raise
+        raise last_error if last_error else RespError("too many MOVED redirects")
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()
+        self._clients.clear()
 
 
 class RedisSubscriber:
@@ -211,3 +390,25 @@ class RedisSubscriber:
             self.writer.close()
             self.writer = None
             self.reader = None
+
+
+class ClusterSubscriber(RedisSubscriber):
+    """Pub/sub over a cluster: subscribe on the first reachable node
+    (redis propagates published messages to every node's subscribers)."""
+
+    def __init__(self, nodes: list, on_message: Optional[Callable[[bytes, bytes], None]] = None) -> None:
+        self.nodes = [RedisClusterClient._normalize(n) for n in nodes]
+        if not self.nodes:
+            raise ValueError("ClusterSubscriber needs at least one node")
+        super().__init__(self.nodes[0][0], self.nodes[0][1], on_message=on_message)
+
+    async def connect(self) -> "ClusterSubscriber":
+        last_error: Optional[Exception] = None
+        for host, port in self.nodes:
+            self.host, self.port = host, port
+            try:
+                await super().connect()
+                return self
+            except OSError as error:
+                last_error = error
+        raise last_error if last_error else ConnectionError("no cluster nodes reachable")

@@ -227,6 +227,13 @@ class Hocuspocus:
                     await self.hooks("after_store_document", hook_payload)
             except Exception as error:
                 logger.log_error(f"caught error during store_document_hooks: {error!r}")
+                # best-effort cleanup hook so extensions holding resources
+                # across the store chain (e.g. the Redis store lock) can
+                # release them — after_store_document never runs on failure
+                try:
+                    await self.hooks("on_store_document_failed", hook_payload)
+                except Exception:
+                    pass
                 if str(error):
                     raise
             finally:

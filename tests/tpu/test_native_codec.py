@@ -94,7 +94,10 @@ def test_decode_unsupported_content_flagged(codec):
 
 
 def test_native_speedup(codec):
-    """The native decoder should beat the Python one comfortably."""
+    """The native decoder should beat the Python one comfortably.
+
+    Best-of-3 per side: wall-clock comparisons on a loaded host are
+    noisy, and a single scheduler stall must not flip the verdict."""
     import time
 
     doc = Doc()
@@ -103,23 +106,28 @@ def test_native_speedup(codec):
         text.insert(len(text), f"chunk {i} of text content ")
     update = encode_state_as_update(doc)
 
-    n = 300
-    t0 = time.perf_counter()
-    for _ in range(n):
-        codec.decode_update(update)
-    native_time = time.perf_counter() - t0
-
     from hocuspocus_tpu.crdt.delete_set import DeleteSet
     from hocuspocus_tpu.crdt.encoding import Decoder
     from hocuspocus_tpu.crdt.update import _read_client_struct_refs
 
-    t0 = time.perf_counter()
-    for _ in range(n):
-        d = Decoder(update)
-        _read_client_struct_refs(d)
-        DeleteSet.read(d)
-    python_time = time.perf_counter() - t0
+    n = 300
 
+    def time_native() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            codec.decode_update(update)
+        return time.perf_counter() - t0
+
+    def time_python() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            d = Decoder(update)
+            _read_client_struct_refs(d)
+            DeleteSet.read(d)
+        return time.perf_counter() - t0
+
+    native_time = min(time_native() for _ in range(3))
+    python_time = min(time_python() for _ in range(3))
     assert native_time < python_time, (native_time, python_time)
 
 
