@@ -176,18 +176,31 @@ def _integrate_block_kernel(
     jax.lax.fori_loop(0, num_slots, apply_op, 0)
 
 
-_VMEM_BUDGET = 14 * 1024 * 1024  # leave headroom under the 16MB/core cap
+# Mosaic's default scoped-VMEM cap is 16MB; a v5e core has 128MB of
+# physical VMEM. We raise the cap and keep our own budget under it so
+# the block choice — not the compiler's default — is the binding limit.
+_VMEM_LIMIT = 100 * 1024 * 1024
+_VMEM_BUDGET = 96 * 1024 * 1024
+
+# Measured live set of the block kernel, in (db, N) int32 buffers: the
+# 5 aliased arena outputs, their 5 re-reads inside apply_op, plus
+# Mosaic's per-iteration temporaries for the masked reductions and the
+# elementwise rewrite (~17 more). r02's OOM pinned this empirically:
+# "scoped allocation 19.68M" at db=32, N=5632 => 19.68e6/(32*5632*4)
+# ~ 27.3 buffers. 28 gives margin; tests/tpu/test_pallas_kernels.py
+# asserts the model against that shape so a regression fails in CI.
+_LIVE_BUFFERS = 28
 
 
 def _pick_block(num_docs: int, capacity: int = 2048) -> int:
     """Largest doc-block that divides D and fits VMEM.
 
-    Budget model: 5 in + 5 out aliased arena blocks plus roughly two
-    live temporaries per loop iteration — ~12 (db, N) int32 buffers.
-    Measured best on v5e at N=2048 is db=64 (HBM-pass-bound beyond).
+    Budget model: ~_LIVE_BUFFERS live (db, N) int32 buffers (see above;
+    op blocks are (db, K) with K<=64 — noise by comparison). Measured
+    best on v5e at N=2048 is db=64 (HBM-pass-bound beyond).
     """
     for db in (64, 32, 16, 8):
-        if num_docs % db == 0 and 12 * db * capacity * 4 <= _VMEM_BUDGET:
+        if num_docs % db == 0 and _LIVE_BUFFERS * db * capacity * 4 <= _VMEM_BUDGET:
             return db
     return 0
 
@@ -239,6 +252,7 @@ def _integrate_pallas(state: DocState, ops: OpBatch, interpret: bool):
         ),
         # state tensors update in place (inputs 8..14 -> outputs 0..6)
         input_output_aliases={8 + i: i for i in range(7)},
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*ops_i32, idc, idk, rank, orank, dele, length, ovf)
     idc, idk, rank, orank, dele, length, ovf = out
@@ -262,19 +276,41 @@ def _integrate_pallas(state: DocState, ops: OpBatch, interpret: bool):
     return new_state, count
 
 
+# Shapes whose Pallas compile failed on this process's backend. r02's
+# bench died because a Mosaic VMEM OOM propagated out of the flush; a
+# kernel failure must cost one fallback, not the server. Keyed by the
+# full (D, N, K) problem shape since any of them can change the
+# compiled program.
+_pallas_broken_shapes: set[tuple[int, int, int]] = set()
+
+
 def integrate_op_slots_pallas(
     state: DocState, ops: OpBatch, *, interpret: bool = False
 ) -> tuple[DocState, jax.Array]:
     """Drop-in equivalent of kernels.integrate_op_slots via Pallas.
 
     Ops fields have shape (K, D). Falls back to the XLA scan path when
-    the doc count has no valid block factor.
+    the doc count has no valid block factor, or — permanently for that
+    shape — when Mosaic rejects the kernel (e.g. a VMEM regression),
+    so a compile failure degrades throughput instead of availability.
     """
     from .kernels import integrate_op_slots
 
-    if _pick_block(state.id_client.shape[0], state.id_client.shape[1]) == 0:
+    shape = (state.id_client.shape[0], state.id_client.shape[1], ops.kind.shape[0])
+    if _pick_block(shape[0], shape[1]) == 0 or shape in _pallas_broken_shapes:
         return integrate_op_slots(state, ops)
-    return _integrate_pallas(state, ops, interpret)
+    try:
+        return _integrate_pallas(state, ops, interpret)
+    except Exception as error:  # Mosaic/XLA compile or launch failure
+        _pallas_broken_shapes.add(shape)
+        import logging
+
+        logging.getLogger("hocuspocus_tpu.tpu").warning(
+            "pallas integrate failed at shape %s; falling back to XLA scan: %s",
+            shape,
+            str(error)[:500],
+        )
+        return integrate_op_slots(state, ops)
 
 
 def integrate_op_slots_fast(state: DocState, ops: OpBatch) -> tuple[DocState, jax.Array]:
