@@ -86,8 +86,7 @@ def main() -> None:
     plane = MergePlane(num_docs=plane_docs, capacity=8192)
     for d in range(plane_docs):
         name = f"cold-{d}"
-        slot = plane.register(name)
-        plane.root_names[slot] = "t"  # the server extension resolves this
+        plane.register(name)
         plane.enqueue_update(name, snapshot_bytes)
     plane.flush()
     serving = PlaneServing(plane)

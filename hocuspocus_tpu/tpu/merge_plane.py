@@ -2,15 +2,23 @@
 
 Replaces the reference's per-connection apply loop (SURVEY.md §3.3 hot
 loop) with a micro-batched device step: updates from ALL documents are
-lowered to dense ops, padded into (K slots, D docs) tensors, and
+lowered to dense ops, padded into (K slots, S sequences) tensors, and
 integrated by one jitted kernel call. Exposed as `TpuMergeExtension`
 hooking the same onChange boundary the reference's extensions use, with
 the CPU document remaining the authoritative fallback.
+
+Arena rows are *sequences*, not documents: a plain text doc occupies
+one row; a tree doc (ProseMirror XML) occupies one row per element
+child-list, so the same YATA kernel integrates every sequence of every
+document in one batch. Map items (Y.Map entries, XML attributes) are
+host-side last-writer-wins records that never ride the device — they
+go straight to the doc's serve log.
 """
 
 from __future__ import annotations
 
 import asyncio
+from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
@@ -18,6 +26,7 @@ import numpy as np
 from ..server.types import Extension, Payload
 from .kernels import (
     DocState,
+    KIND_DELETE,
     KIND_INSERT,
     NONE_CLIENT,
     OpBatch,
@@ -25,32 +34,57 @@ from .kernels import (
     make_empty_state,
 )
 from .lowering import DenseOp, DocLowerer, units_to_text
-from .pallas_kernels import integrate_op_slots_fast
+
+
+@dataclass
+class LogRec:
+    """One serve-log record: an op the plane integrated (device or host).
+
+    slot is None for host-only map items; unit_off indexes the slot's
+    unit log where the op's payload starts (sequence inserts only).
+    """
+
+    op: DenseOp
+    slot: Optional[int] = None
+    unit_off: int = 0
+
+
+@dataclass
+class PlaneDoc:
+    """Per-document host state: sequence registry + serve log."""
+
+    name: str
+    lowerer: DocLowerer = field(default_factory=DocLowerer)
+    seqs: dict[tuple, int] = field(default_factory=dict)  # seq_key -> slot
+    serve_log: list[LogRec] = field(default_factory=list)
+    # delete ranges that target host-side map items (client, clock, len)
+    map_tombstones: list[tuple] = field(default_factory=list)
+    retired: bool = False
 
 
 class MergePlane:
-    """Device-resident arenas for up to `num_docs` documents."""
+    """Device-resident arenas for up to `num_docs` sequences.
+
+    (The parameter keeps its historical name; for plain text docs
+    sequences == documents. Tree docs consume one row per sequence.)
+    """
 
     def __init__(self, num_docs: int = 256, capacity: int = 4096, max_slots_per_flush: int = 16) -> None:
         self.num_docs = num_docs
         self.capacity = capacity
         self.max_slots_per_flush = max_slots_per_flush
         self.state: DocState = make_empty_state(num_docs, capacity)
-        self.slots: dict[str, int] = {}
+        self.docs: dict[str, PlaneDoc] = {}
         self.free: list[int] = list(range(num_docs - 1, -1, -1))
-        self.lowerers: dict[int, DocLowerer] = {}
+        self.slot_owner: dict[int, str] = {}  # slot -> doc name
         self.queues: dict[int, list[DenseOp]] = {}
-        # char payloads never touch the device: slot assignment in the
+        # unit payloads never touch the device: slot assignment in the
         # append-only arena is deterministic (arena slot = arrival
-        # index), so shipped insert payloads land here, indexed by slot
-        self.char_logs: dict[int, list[int]] = {}
-        # every op the device consumed, in arena order, with the char-log
-        # offset of its payload — the host half of the serving path
-        self.op_logs: dict[int, list[tuple[DenseOp, int]]] = {}
-        # root type name per slot (needed to encode origin-less items)
-        self.root_names: dict[int, str] = {}
+        # index), so shipped payloads land here, indexed by slot. An
+        # entry is an int UTF-16 unit for text, or the decoded Content
+        # object for rich units (formats/embeds/types/values).
+        self.unit_logs: dict[int, list] = {}
         self.projected_len: dict[int, int] = {}
-        self._retired: set[int] = set()
         self.total_integrated = 0
         # degradation accounting: at 100k docs nobody notices 3% of docs
         # silently falling off the plane unless it is counted
@@ -60,6 +94,7 @@ class MergePlane:
             "docs_retired_unsupported": 0,
             "docs_retired_capacity": 0,
             "docs_retired_fallback": 0,
+            "docs_retired_plane_full": 0,
             "sync_serves": 0,
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
@@ -67,51 +102,56 @@ class MergePlane:
 
     # -- registry ----------------------------------------------------------
 
-    def register(self, name: str) -> Optional[int]:
-        if name in self.slots:
-            return self.slots[name]
+    def register(self, name: str) -> PlaneDoc:
+        doc = self.docs.get(name)
+        if doc is None:
+            doc = PlaneDoc(name)
+            self.docs[name] = doc
+        return doc
+
+    def _alloc_seq(self, doc: PlaneDoc, seq_key: tuple) -> Optional[int]:
+        slot = doc.seqs.get(seq_key)
+        if slot is not None:
+            return slot
         if not self.free:
             return None
         slot = self.free.pop()
-        self.slots[name] = slot
-        self.lowerers[slot] = DocLowerer()
+        doc.seqs[seq_key] = slot
+        self.slot_owner[slot] = doc.name
         self.queues[slot] = []
-        self.char_logs[slot] = []
-        self.op_logs[slot] = []
+        self.unit_logs[slot] = []
         self.projected_len[slot] = 0
         return slot
 
     def release(self, name: str) -> None:
-        slot = self.slots.pop(name, None)
-        if slot is None:
+        doc = self.docs.pop(name, None)
+        if doc is None:
             return
-        self.lowerers.pop(slot, None)
-        self.queues.pop(slot, None)
-        self.char_logs.pop(slot, None)
-        self.op_logs.pop(slot, None)
-        self.root_names.pop(slot, None)
-        self.projected_len.pop(slot, None)
-        self._retired.discard(slot)
-        self._clear_slot(slot)
-        self.free.append(slot)
+        for slot in doc.seqs.values():
+            self.slot_owner.pop(slot, None)
+            self.queues.pop(slot, None)
+            self.unit_logs.pop(slot, None)
+            self.projected_len.pop(slot, None)
+            self._clear_slot(slot)
+            self.free.append(slot)
 
-    def retire_slot(self, slot: int, reason: str) -> None:
-        """Permanently degrade a doc to the CPU path (slot stays allocated
+    def retire_doc(self, name: str, reason: str) -> None:
+        """Permanently degrade a doc to the CPU path (rows stay allocated
         until unload so the name keeps resolving to 'unsupported')."""
-        lowerer = self.lowerers.get(slot)
-        if lowerer is None:
+        doc = self.docs.get(name)
+        if doc is None:
             return
-        if slot not in self._retired:
-            # counted via _retired, not the unsupported flag: the lowerer
-            # flips unsupported itself on unrepresentable content
-            self._retired.add(slot)
+        if not doc.retired:
+            doc.retired = True
             self.counters[f"docs_retired_{reason}"] = (
                 self.counters.get(f"docs_retired_{reason}", 0) + 1
             )
-        lowerer.unsupported = True
-        self.queues[slot].clear()
-        self.char_logs[slot] = []
-        self.op_logs[slot] = []
+        doc.lowerer.unsupported = True
+        doc.serve_log = []
+        doc.map_tombstones = []
+        for slot in doc.seqs.values():
+            self.queues[slot].clear()
+            self.unit_logs[slot] = []
 
     def _clear_slot(self, slot: int) -> None:
         empty = make_empty_state(1, self.capacity)
@@ -123,40 +163,61 @@ class MergePlane:
         )
 
     def is_supported(self, name: str) -> bool:
-        slot = self.slots.get(name)
-        if slot is None:
+        doc = self.docs.get(name)
+        if doc is None:
             return False
-        return not self.lowerers[slot].unsupported
+        return not doc.lowerer.unsupported
 
     # -- queueing ----------------------------------------------------------
 
-    def enqueue_update(self, name: str, update: bytes) -> int:
-        """Lower + queue one update; returns the number of ops queued."""
-        slot = self.slots.get(name)
-        if slot is None:
-            slot = self.register(name)
+    def enqueue_update(self, name: str, update: bytes, presync: bool = False) -> int:
+        """Lower + queue one update; returns the number of ops accepted."""
+        doc = self.register(name)
+        if doc.lowerer.unsupported:
+            return 0
+        seq_ops, map_ops, map_tombs = doc.lowerer.lower_update(update)
+        if doc.lowerer.unsupported:
+            self.retire_doc(name, "unsupported")
+            return 0
+        count = 0
+        for seq_key, ops in seq_ops.items():
+            slot = self._alloc_seq(doc, seq_key)
             if slot is None:
+                self.retire_doc(name, "plane_full")
                 return 0
-        lowerer = self.lowerers[slot]
-        if lowerer.unsupported:
-            return 0
-        ops = lowerer.lower_update(update)
-        if lowerer.unsupported:
-            self.retire_slot(slot, "unsupported")
-            return 0
-        # host-side mirror of the device capacity check: the lowerer
-        # guarantees causal readiness, so inserts succeed until the
-        # arena overflows — at which point the doc is CPU-only forever;
-        # stop queueing (and logging payloads) instead of leaking
-        projected = self.projected_len[slot] + sum(
-            op.run_len for op in ops if op.kind == KIND_INSERT
-        )
-        if projected > self.capacity:
-            self.retire_slot(slot, "capacity")
-            return 0
-        self.projected_len[slot] = projected
-        self.queues[slot].extend(ops)
-        return len(ops)
+            # host-side mirror of the device capacity check: the lowerer
+            # guarantees causal readiness, so inserts succeed until the
+            # arena overflows — at which point the doc is CPU-only
+            # forever; stop queueing (and logging payloads) instead of
+            # leaking
+            projected = self.projected_len[slot] + sum(
+                op.run_len for op in ops if op.kind == KIND_INSERT
+            )
+            if projected > self.capacity:
+                self.retire_doc(name, "capacity")
+                return 0
+            self.projected_len[slot] = projected
+            if presync:
+                for op in ops:
+                    op.presync = True
+            self.queues[slot].extend(ops)
+            count += len(ops)
+        for op in map_ops:
+            op.presync = presync
+            doc.serve_log.append(LogRec(op=op, slot=None))
+            count += 1
+        for client, clock, length in map_tombs:
+            doc.map_tombstones.append((client, clock, length))
+            doc.serve_log.append(
+                LogRec(
+                    op=DenseOp(
+                        kind=KIND_DELETE, client=client, clock=clock, run_len=length,
+                        presync=presync,
+                    ),
+                    slot=None,
+                )
+            )
+        return count
 
     def pending_ops(self) -> int:
         return sum(len(q) for q in self.queues.values())
@@ -166,6 +227,8 @@ class MergePlane:
     def flush(self) -> int:
         """Integrate queued ops in (K, D) batches. Returns ops integrated."""
         from ..observability.tracing import get_tracer
+
+        from .pallas_kernels import integrate_op_slots_fast
 
         tracer = get_tracer()
         total = 0
@@ -206,10 +269,12 @@ class MergePlane:
         right_client = np.full((k, d), NONE_CLIENT, np.uint32)
         right_clock = np.zeros((k, d), np.int32)
         for slot, queue in self.queues.items():
+            if not queue:
+                continue
             take = queue[:k]
             del queue[:k]
-            log = self.char_logs[slot]
-            op_log = self.op_logs[slot]
+            log = self.unit_logs[slot]
+            doc = self.docs[self.slot_owner[slot]]
             for i, op in enumerate(take):
                 kind[i, slot] = op.kind
                 client[i, slot] = op.client
@@ -219,7 +284,7 @@ class MergePlane:
                 left_clock[i, slot] = op.left_clock
                 right_client[i, slot] = op.right_client
                 right_clock[i, slot] = op.right_clock
-                op_log.append((op, len(log)))
+                doc.serve_log.append(LogRec(op=op, slot=slot, unit_off=len(log)))
                 if op.kind == KIND_INSERT:  # payload goes to the host log
                     log.extend(op.chars)
         import jax.numpy as jnp
@@ -237,57 +302,88 @@ class MergePlane:
 
     # -- extraction --------------------------------------------------------
 
-    def text(self, name: str) -> Optional[str]:
-        """Decode a document's live text from device state.
+    def check_doc_health(
+        self, name: str, doc: PlaneDoc, lengths: np.ndarray, overflows: np.ndarray
+    ) -> bool:
+        """Device/host invariants for every row of a doc; retires on fail.
 
-        Surrogate-pair handling mirrors Yjs splice semantics: Yjs
-        replaces both halves with U+FFFD whenever an item split lands
-        inside a pair. The arena never splits (deletes are id-range
-        tombstones), so a pair decodes as a real character only when its
-        two units are id-consecutive from one client AND rank-adjacent
-        (no tombstones between) — every split scenario breaks one of
-        those, yielding the same U+FFFD output as the CPU path.
+        The single health definition shared by text() and the serving
+        path (PlaneServing.doc_healthy) — callers supply the (D,)
+        length/overflow rows so serving can reuse its refresh() caches.
         """
-        slot = self.slots.get(name)
-        if slot is None:
+        for slot in doc.seqs.values():
+            if bool(overflows[slot]):
+                self.retire_doc(name, "overflow")
+                return False
+            if len(self.unit_logs[slot]) != int(lengths[slot]):
+                # host log and arena desynced (op rejected on device) —
+                # the CPU document stays authoritative; retire the doc
+                # so it stops consuming queue/log/kernel resources
+                self.retire_doc(name, "desync")
+                return False
+        return True
+
+    def text(self, name: str) -> Optional[str]:
+        """Decode a plain-text document's live text from device state.
+
+        Defined for docs whose content is a single root sequence of
+        text units (formats are zero-width, as in Yjs); tree docs and
+        value sequences return None — they are served byte-level, not
+        materialized. Surrogate-pair handling mirrors Yjs splice
+        semantics: a pair decodes as a real character only when its two
+        units are id-consecutive from one client AND rank-adjacent —
+        every split scenario breaks one of those, yielding the same
+        U+FFFD output as the CPU path.
+        """
+        from ..crdt.content import ContentFormat
+
+        doc = self.docs.get(name)
+        if doc is None:
             return None
-        if self.lowerers[slot].unsupported:
+        if doc.lowerer.unsupported:
             return None  # doc fell back to the CPU path (content/overflow)
-        overflow = bool(np.asarray(self.state.overflow)[slot])
-        if overflow:
-            self.retire_slot(slot, "overflow")
+        roots = [key for key in doc.seqs if key[0] == "root"]
+        if len(doc.seqs) != len(roots) or len(roots) > 1:
+            return None  # tree-shaped: byte-served, not materialized
+        if not roots:
+            return ""
+        if not self.check_doc_health(
+            name, doc, np.asarray(self.state.length), np.asarray(self.state.overflow)
+        ):
             return None
-        log = np.asarray(self.char_logs[slot], dtype=np.int64)
-        if len(log) != int(np.asarray(self.state.length)[slot]):
-            # host log and arena desynced (op rejected on device) — the
-            # CPU document stays authoritative; retire the doc from the
-            # plane so it stops consuming queue/log/kernel resources
-            self.retire_slot(slot, "desync")
-            return None
+        slot = doc.seqs[roots[0]]
+        log = self.unit_logs[slot]
         live = np.asarray(extract_live_mask(self.state))[slot]
         occupied = np.nonzero(live)[0]
         ranks_all = np.asarray(self.state.rank)[slot][occupied]
         order = np.argsort(ranks_all)
         sel = occupied[order]
         ranks = ranks_all[order]
-        chars = log[sel]
         clients = np.asarray(self.state.id_client)[slot][sel]
         clocks = np.asarray(self.state.id_clock)[slot][sel]
+        entries = [log[i] for i in sel]
         out: list[int] = []
         i = 0
-        count = len(chars)
+        count = len(entries)
         while i < count:
-            c = int(chars[i])
+            entry = entries[i]
+            if not isinstance(entry, int):
+                if isinstance(entry, ContentFormat):
+                    i += 1  # zero-width formatting boundary
+                    continue
+                return None  # embeds/values: not a plain text doc
+            c = entry
             if 0xD800 <= c <= 0xDBFF:
+                nxt = entries[i + 1] if i + 1 < count else None
                 if (
-                    i + 1 < count
-                    and 0xDC00 <= int(chars[i + 1]) <= 0xDFFF
+                    isinstance(nxt, int)
+                    and 0xDC00 <= nxt <= 0xDFFF
                     and clients[i + 1] == clients[i]
                     and clocks[i + 1] == clocks[i] + 1
                     and ranks[i + 1] == ranks[i] + 1
                 ):
                     out.append(c)
-                    out.append(int(chars[i + 1]))
+                    out.append(nxt)
                     i += 2
                     continue
                 out.append(0xFFFD)
@@ -299,16 +395,12 @@ class MergePlane:
         return units_to_text(out)
 
 
-class _MultipleRoots(Exception):
-    pass
-
-
 class TpuMergeExtension(Extension):
     """Puts live documents on the TPU merge plane via onChange.
 
     Two modes:
-    - shadow (serve=False): the plane mirrors every supported text
-      document; the CPU document serves (round-1 behavior).
+    - shadow (serve=False): the plane mirrors every supported document;
+      the CPU document serves (round-1 behavior).
     - serve (serve=True): for supported docs the plane IS the serving
       path — SyncStep2 replies come from device state
       (`Document.sync_source`), per-update CPU fan-out is suppressed
@@ -349,23 +441,14 @@ class TpuMergeExtension(Extension):
         from ..crdt import encode_state_as_update
 
         name = data.document_name
-        slot = self.plane.register(name)
+        self.plane.register(name)
         snapshot = encode_state_as_update(data.document)
-        queued = self.plane.enqueue_update(name, snapshot)
-        if self.serve and slot is not None and self.plane.is_supported(name):
-            document = data.document
-            try:
-                root = self._resolve_root(document)
-            except _MultipleRoots:
-                self.plane.retire_slot(slot, "unsupported")
-                self._schedule_flush()
-                return
-            if root is not None:
-                self.plane.root_names[slot] = root
+        # receivers get pre-load state via sync, not broadcast
+        self.plane.enqueue_update(name, snapshot, presync=True)
+        if self.serve and self.plane.is_supported(name):
             from .serving import TpuSyncSource
 
-            # receivers get pre-load state via sync, not broadcast
-            self.serving.broadcast_cursor[slot] = queued
+            document = data.document
             document.sync_source = TpuSyncSource(self.serving, name, document)
             document.broadcast_source = self
             self._docs[name] = document
@@ -383,9 +466,8 @@ class TpuMergeExtension(Extension):
         if document is not None:
             document.sync_source = None
             document.broadcast_source = None
-        slot = self.plane.slots.get(name)
-        if slot is not None:
-            self.serving and self.serving.broadcast_cursor.pop(slot, None)
+        if self.serving is not None:
+            self.serving.broadcast_cursor.pop(name, None)
         self.plane.release(name)
 
     async def on_destroy(self, data: Payload) -> None:
@@ -401,8 +483,7 @@ class TpuMergeExtension(Extension):
         if not self.serve or name not in self._docs:
             return False
         plane = self.plane
-        slot = plane.slots.get(name)
-        if slot is None or not plane.is_supported(name):
+        if not plane.is_supported(name):
             self._fallback_to_cpu(document)
             return False
         plane.enqueue_update(name, update)
@@ -410,32 +491,8 @@ class TpuMergeExtension(Extension):
             # this very update degraded the doc; it broadcasts via CPU
             self._fallback_to_cpu(document)
             return False
-        if plane.root_names.get(slot) is None:
-            try:
-                root = self._resolve_root(document)
-            except _MultipleRoots:
-                plane.retire_slot(slot, "unsupported")
-                self._fallback_to_cpu(document)
-                return False
-            if root is not None:
-                plane.root_names[slot] = root
         self._schedule_flush()
         return True
-
-    def _resolve_root(self, document) -> Optional[str]:
-        """The single content-bearing root type name, None if empty.
-
-        The dense arena models ONE text sequence per doc; a second
-        content-bearing root would interleave, so it degrades the doc.
-        """
-        roots = [
-            key
-            for key, ytype in document.share.items()
-            if ytype._start is not None or getattr(ytype, "_map", None)
-        ]
-        if len(roots) > 1:
-            raise _MultipleRoots()
-        return roots[0] if roots else None
 
     def _fallback_to_cpu(self, document) -> None:
         name = document.name
@@ -443,9 +500,8 @@ class TpuMergeExtension(Extension):
             return  # already degraded
         document.sync_source = None
         document.broadcast_source = None
-        slot = self.plane.slots.get(name)
-        if slot is not None:
-            self.plane.retire_slot(slot, "fallback")
+        if name in self.plane.docs:
+            self.plane.retire_doc(name, "fallback")
         self.plane.counters["cpu_fallbacks"] += 1
         # receivers may hold plane broadcasts only up to the last flush;
         # ship the full CPU state once (dedup makes it a cheap no-op for
@@ -482,7 +538,7 @@ class TpuMergeExtension(Extension):
             # here must neither strand this doc's ops nor skip the
             # remaining docs' broadcasts
             try:
-                if self.serving.slot_healthy(name) is None:
+                if self.serving.doc_healthy(name) is None:
                     self._fallback_to_cpu(document)
                     continue
                 update = self.serving.build_broadcast(name)

@@ -1,10 +1,10 @@
 """Serve sync replies and broadcasts from TPU merge-plane state.
 
 This is the piece that promotes the merge plane from a shadow mirror to
-the serving path: for supported text documents, SyncStep2 payloads and
+the serving path: for supported documents, SyncStep2 payloads and
 steady-state update broadcasts are PRODUCED from device state — arena
 ids / rank / tombstones read back from the TPU, combined with the
-host-side op/char logs — instead of from the CPU document
+host-side serve/unit logs — instead of from the CPU document
 (reference hot path: `packages/server/src/MessageReceiver.ts:137-213`
 building SyncStep2 via `Y.encodeStateAsUpdate`, and
 `packages/server/src/Document.ts:228-240` re-broadcasting every
@@ -14,9 +14,11 @@ Safety model:
 - The CPU document stays the fallback: every serve checks the plane is
   healthy (supported, no overflow, host/device logs in sync) AND covers
   the CPU document's state vector; otherwise the caller falls back.
-- Delete sets in served payloads are always read from the DEVICE
+- Delete sets for *sequence* content are always read from the DEVICE
   tombstone mask — a deletion the kernel did not apply can never be
-  served, and redundant ds ranges are no-ops on receivers.
+  served. Map-item deletions (host-only content that never rides the
+  device) are merged in from the host tombstone log, which is applied
+  synchronously at lowering time.
 """
 
 from __future__ import annotations
@@ -33,26 +35,41 @@ from ..crdt.structs import Item
 from ..crdt.update import _write_structs, decode_state_vector
 from .kernels import KIND_DELETE, KIND_INSERT, NONE_CLIENT
 from .lowering import DenseOp, units_to_text
-from .merge_plane import MergePlane
+from .merge_plane import LogRec, MergePlane, PlaneDoc
 
 
-def _make_item(op: DenseOp, char_off: int, char_log: list, root: Optional[str]) -> Item:
+def _wire_parent(parent: Optional[tuple]):
+    """DenseOp parent tuple -> the Item.write representation."""
+    if parent is None:
+        return None
+    if parent[0] == "root":
+        return parent[1]
+    return ID(parent[1], parent[2])
+
+
+def _make_item(rec: LogRec, unit_logs: dict) -> Item:
+    op = rec.op
     origin = ID(op.left_client, op.left_clock) if op.left_client != NONE_CLIENT else None
     right_origin = (
         ID(op.right_client, op.right_clock) if op.right_client != NONE_CLIENT else None
     )
-    if op.deleted_content:
+    if op.content is not None:
+        content = op.content
+    elif op.deleted_content:
         content = ContentDeleted(op.run_len)
     else:
-        content = ContentString(units_to_text(char_log[char_off : char_off + op.run_len]))
+        log = unit_logs[rec.slot]
+        content = ContentString(
+            units_to_text(log[rec.unit_off : rec.unit_off + op.run_len])
+        )
     return Item(
         ID(op.client, op.clock),
         None,
         origin,
         None,
         right_origin,
-        root,  # only consulted by Item.write when both origins are None
-        None,
+        _wire_parent(op.parent),  # consulted by Item.write only when origin-less
+        op.parent_sub,
         content,
     )
 
@@ -62,8 +79,8 @@ class PlaneServing:
 
     def __init__(self, plane: MergePlane) -> None:
         self.plane = plane
-        # slot -> op_log index whose ops receivers already have
-        self.broadcast_cursor: dict[int, int] = {}
+        # doc name -> serve_log index whose records receivers already have
+        self.broadcast_cursor: dict[str, int] = {}
         self._length_cache: Optional[np.ndarray] = None
         self._overflow_cache: Optional[np.ndarray] = None
 
@@ -86,28 +103,23 @@ class PlaneServing:
 
     # -- health -------------------------------------------------------------
 
-    def slot_healthy(self, name: str) -> Optional[int]:
+    def doc_healthy(self, name: str) -> Optional[PlaneDoc]:
         plane = self.plane
-        slot = plane.slots.get(name)
-        if slot is None:
+        doc = plane.docs.get(name)
+        if doc is None:
             return None
-        if plane.lowerers[slot].unsupported:
+        if doc.lowerer.unsupported:
             return None
-        if bool(self._overflows()[slot]):
-            plane.retire_slot(slot, "overflow")
+        if not plane.check_doc_health(name, doc, self._lengths(), self._overflows()):
             return None
-        if len(plane.char_logs[slot]) != int(self._lengths()[slot]):
-            # host log and device arena desynced (op rejected on device)
-            plane.retire_slot(slot, "desync")
-            return None
-        return slot
+        return doc
 
     def covers(self, name: str, document) -> bool:
         """Plane has integrated everything the CPU document has seen."""
-        slot = self.plane.slots.get(name)
-        if slot is None:
+        doc = self.plane.docs.get(name)
+        if doc is None:
             return False
-        known = self.plane.lowerers[slot].known
+        known = doc.lowerer.known
         for client, clock in document.store.get_state_vector().items():
             if clock > known.get(client, 0):
                 return False
@@ -117,12 +129,11 @@ class PlaneServing:
 
     def _group_items(
         self,
-        slot: int,
-        root: Optional[str],
-        ops: list,
+        doc: PlaneDoc,
+        records: list[LogRec],
         min_clock: Optional[dict[int, int]] = None,
     ) -> dict[int, list[Item]]:
-        """Group an op-log slice into per-client clock-sorted Items.
+        """Group serve-log records into per-client clock-sorted Items.
 
         min_clock trims fully-known items per client: an op is included
         when any part of it is at/above the client's cutoff (the first
@@ -130,41 +141,47 @@ class PlaneServing:
         with an offset), and clients absent from min_clock are skipped.
         """
         by: dict[int, list[Item]] = {}
-        log = self.plane.char_logs[slot]
-        for op, off in ops:
+        unit_logs = self.plane.unit_logs
+        for rec in records:
+            op = rec.op
             if op.kind != KIND_INSERT:
                 continue
             if min_clock is not None:
                 cutoff = min_clock.get(op.client)
                 if cutoff is None or op.clock + op.run_len <= cutoff:
                     continue
-            by.setdefault(op.client, []).append(_make_item(op, off, log, root))
+            by.setdefault(op.client, []).append(_make_item(rec, unit_logs))
         for items in by.values():
             items.sort(key=lambda item: item.id.clock)
         return by
 
-    def _device_delete_set(self, slot: int) -> DeleteSet:
-        """Tombstone ranges as the DEVICE sees them (the provable part)."""
+    def _device_delete_set(self, doc: PlaneDoc) -> DeleteSet:
+        """Tombstones as the DEVICE sees them, across every row of the
+        doc, plus host-applied map-item tombstones."""
         state = self.plane.state
-        length = int(self._lengths()[slot])
+        lengths = self._lengths()
         ds = DeleteSet()
-        if length == 0:
-            return ds
-        deleted = np.asarray(state.deleted[slot])[:length]
-        if not deleted.any():
-            return ds
-        sel = np.nonzero(deleted)[0]
-        clients = np.asarray(state.id_client[slot])[sel]
-        clocks = np.asarray(state.id_clock[slot])[sel]
-        pairs = sorted(zip(clients.tolist(), clocks.tolist()))
-        run_client, run_start, run_len = pairs[0][0], pairs[0][1], 1
-        for client, clock in pairs[1:]:
-            if client == run_client and clock == run_start + run_len:
-                run_len += 1
-            else:
-                ds.add(run_client, run_start, run_len)
-                run_client, run_start, run_len = client, clock, 1
-        ds.add(run_client, run_start, run_len)
+        for slot in doc.seqs.values():
+            length = int(lengths[slot])
+            if length == 0:
+                continue
+            deleted = np.asarray(state.deleted[slot])[:length]
+            if not deleted.any():
+                continue
+            sel = np.nonzero(deleted)[0]
+            clients = np.asarray(state.id_client[slot])[sel]
+            clocks = np.asarray(state.id_clock[slot])[sel]
+            pairs = sorted(zip(clients.tolist(), clocks.tolist()))
+            run_client, run_start, run_len = pairs[0][0], pairs[0][1], 1
+            for client, clock in pairs[1:]:
+                if client == run_client and clock == run_start + run_len:
+                    run_len += 1
+                else:
+                    ds.add(run_client, run_start, run_len)
+                    run_client, run_start, run_len = client, clock, 1
+            ds.add(run_client, run_start, run_len)
+        for client, clock, length in doc.map_tombstones:
+            ds.add(client, clock, length)
         ds.sort_and_merge()
         return ds
 
@@ -176,14 +193,13 @@ class PlaneServing:
         if plane.pending_ops() > 0:
             plane.flush()
             self.refresh()
-        slot = self.slot_healthy(name)
-        if slot is None or not self.covers(name, document):
+        doc = self.doc_healthy(name)
+        if doc is None or not self.covers(name, document):
             return None
-        root = plane.root_names.get(slot)
         # plane-integrated clocks ARE the local state vector (queue was
         # just flushed), so the diff is computed before building Items —
         # a nearly-current reconnect pays for its tail, not the full doc
-        local_sv = dict(plane.lowerers[slot].known)
+        local_sv = dict(doc.lowerer.known)
         target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
         sm: dict[int, int] = {}
         for client, clock in target_sv.items():
@@ -192,46 +208,42 @@ class PlaneServing:
         for client in local_sv:
             if client not in target_sv:
                 sm[client] = 0
-        items_by_client = self._group_items(slot, root, plane.op_logs[slot], sm)
-        if items_by_client and root is None:
-            return None  # content exists but the root type is unresolved
+        items_by_client = self._group_items(doc, doc.serve_log, sm)
         encoder = Encoder()
         encoder.write_var_uint(len(items_by_client))
         for client in sorted(items_by_client, reverse=True):
             _write_structs(encoder, items_by_client[client], client, sm[client])
-        self._device_delete_set(slot).write(encoder)
+        self._device_delete_set(doc).write(encoder)
         plane.counters["sync_serves"] += 1
         return encoder.to_bytes()
 
     def build_broadcast(self, name: str) -> Optional[bytes]:
         """Merged update for ops integrated since the last broadcast.
 
-        Items come from the host op log (everything consumed by the
-        device since the cursor); when the window contained delete ops,
-        the delete set is the full device tombstone state — receivers
-        treat already-known ranges as no-ops, so device-applied deletions
-        are never lost without per-slot delta bookkeeping. The cursor
-        only advances on a successfully encoded payload (or a genuinely
-        empty window), so a bail-out never strands ops.
+        Items come from the doc's serve log (everything consumed by the
+        device or host-integrated since the cursor, minus presync
+        records — receivers get pre-load state via sync); when the
+        window contained delete ops, the delete set is the full device
+        tombstone state — receivers treat already-known ranges as
+        no-ops, so device-applied deletions are never lost without
+        per-slot delta bookkeeping. The cursor only advances on a
+        successfully encoded payload (or a genuinely empty window), so
+        a bail-out never strands ops.
         """
         plane = self.plane
-        slot = plane.slots.get(name)
-        if slot is None:
+        doc = plane.docs.get(name)
+        if doc is None:
             return None
-        log = plane.op_logs.get(slot)
-        if log is None:
+        log = doc.serve_log
+        cursor = min(self.broadcast_cursor.get(name, 0), len(log))
+        window = [rec for rec in log[cursor:] if not rec.op.presync]
+        if not window:
+            self.broadcast_cursor[name] = len(log)
             return None
-        cursor = min(self.broadcast_cursor.get(slot, 0), len(log))
-        new = log[cursor:]
-        if not new:
-            return None
-        root = plane.root_names.get(slot)
-        by = self._group_items(slot, root, new)
-        has_delete = any(op.kind == KIND_DELETE for op, _ in new)
-        if by and root is None:
-            return None  # cursor unmoved: ops broadcast once root resolves
+        by = self._group_items(doc, window)
+        has_delete = any(rec.op.kind == KIND_DELETE for rec in window)
         if not by and not has_delete:
-            self.broadcast_cursor[slot] = len(log)
+            self.broadcast_cursor[name] = len(log)
             return None
         encoder = Encoder()
         encoder.write_var_uint(len(by))
@@ -239,10 +251,10 @@ class PlaneServing:
             items = by[client]
             _write_structs(encoder, items, client, items[0].id.clock)
         if has_delete:
-            self._device_delete_set(slot).write(encoder)
+            self._device_delete_set(doc).write(encoder)
         else:
             DeleteSet().write(encoder)
-        self.broadcast_cursor[slot] = len(log)
+        self.broadcast_cursor[name] = len(log)
         plane.counters["plane_broadcasts"] += 1
         return encoder.to_bytes()
 

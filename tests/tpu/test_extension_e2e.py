@@ -41,9 +41,9 @@ async def test_extension_releases_slot_on_unload():
     provider = new_provider(server, name="transient")
     try:
         await wait_synced(provider)
-        assert "transient" in ext.plane.slots
+        assert "transient" in ext.plane.docs
         provider.destroy()
-        await retryable_assertion(lambda: _assert("transient" not in ext.plane.slots))
+        await retryable_assertion(lambda: _assert("transient" not in ext.plane.docs))
     finally:
         await server.destroy()
 

@@ -133,14 +133,39 @@ def test_fuzz_concurrent_multi_client_matches_cpu():
     assert plane.text("d") == docs[0].get_text("t").to_string()
 
 
-def test_unsupported_content_falls_back():
+def test_map_content_stays_on_plane():
+    """Map entries are host-side LWW records — they no longer retire the
+    doc (round-2 verdict item: BASELINE config-4 shapes on the plane)."""
     plane = MergePlane(num_docs=4, capacity=256)
     doc = Doc()
     mirror_doc_updates(plane, "d", doc)
-    doc.get_map("m").set("k", 1)  # map content unsupported on device
+    doc.get_map("m").set("k", 1)
     plane.flush()
+    assert plane.is_supported("d")
+    assert plane.counters["docs_retired_unsupported"] == 0
+    # map items land in the serve log, not the device queue
+    rec = plane.docs["d"].serve_log[-1]
+    assert rec.slot is None and rec.op.parent_sub == "k"
+
+
+def test_gc_content_falls_back():
+    """GC structs lose origin info and cannot be re-placed: unsupported."""
+    from hocuspocus_tpu.crdt.encoding import Encoder
+
+    enc = Encoder()
+    enc.write_var_uint(1)  # sections
+    enc.write_var_uint(1)  # structs
+    enc.write_var_uint(9)  # client
+    enc.write_var_uint(0)  # clock
+    enc.write_uint8(0x00)  # GC ref
+    enc.write_var_uint(3)  # gc length
+    enc.write_var_uint(0)  # ds clients
+    plane = MergePlane(num_docs=4, capacity=256)
+    plane.register("d")
+    plane.enqueue_update("d", enc.to_bytes())
     assert not plane.is_supported("d")
-    assert plane.text("d") is None or plane.text("d") == ""
+    assert plane.counters["docs_retired_unsupported"] == 1
+    assert plane.text("d") is None
 
 
 def test_slot_release_and_reuse():
@@ -227,13 +252,13 @@ def test_overflow_stops_queueing_and_logging():
     text.insert(0, "x" * 16)
     plane.flush()
     assert plane.text("d") == text.to_string()
-    slot = plane.slots["d"]
+    (slot,) = plane.docs["d"].seqs.values()
     text.insert(0, "y" * 64)  # exceeds capacity
     assert not plane.is_supported("d")
     assert plane.queues[slot] == []
-    log_len = len(plane.char_logs[slot])
+    log_len = len(plane.unit_logs[slot])
     text.insert(0, "z" * 100)  # further edits must not grow host state
-    assert len(plane.char_logs[slot]) == log_len
+    assert len(plane.unit_logs[slot]) == log_len
     assert plane.queues[slot] == []
     plane.flush()
     assert plane.text("d") is None
@@ -288,7 +313,8 @@ def test_partial_delete_range_applies_known_prefix():
     enc.write_var_uint(0)  # clock
     enc.write_var_uint(5)  # len
     lowerer = DocLowerer()
-    ops = lowerer.lower_update(enc.to_bytes())
+    seq_ops, _, _ = lowerer.lower_update(enc.to_bytes())
+    ops = [op for ops in seq_ops.values() for op in ops]
     deletes = [op for op in ops if op.kind == KIND_DELETE]
     assert [(d.clock, d.run_len) for d in deletes] == [(0, 3)]
     assert lowerer.pending_deletes == [(9, 3, 2)]
@@ -304,7 +330,8 @@ def test_partial_delete_range_applies_known_prefix():
     enc2.write_var_uint(2)
     enc2.write_var_string("de")
     enc2.write_var_uint(0)  # empty ds
-    ops2 = lowerer.lower_update(enc2.to_bytes())
+    seq_ops2, _, _ = lowerer.lower_update(enc2.to_bytes())
+    ops2 = [op for ops in seq_ops2.values() for op in ops]
     deletes2 = [op for op in ops2 if op.kind == KIND_DELETE]
     assert [(d.clock, d.run_len) for d in deletes2] == [(3, 2)]
     assert lowerer.pending_deletes == []

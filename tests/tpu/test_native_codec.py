@@ -66,23 +66,30 @@ def test_decode_parity_with_python(codec):
             pos = random.randrange(len(text))
             text.delete(pos, min(random.randint(1, 6), len(text) - pos))
 
+    def lower_all(lowerer):
+        seq_ops, map_ops = [], []
+        for update in updates:
+            seqs, maps, tombs = lowerer.lower_update(update)
+            for key in sorted(seqs):
+                seq_ops.extend((key, op) for op in seqs[key])
+            map_ops.extend(maps)
+            assert tombs == []  # plain text: no map content to tombstone
+        return seq_ops, map_ops
+
     native_lowerer = DocLowerer()
-    native_ops = []
-    for update in updates:
-        native_ops.extend(native_lowerer.lower_update(update))
+    native_seq, native_map = lower_all(native_lowerer)
 
     os.environ["HOCUSPOCUS_TPU_NO_NATIVE"] = "1"
     try:
         py_lowerer = DocLowerer()
-        py_ops = []
-        for update in updates:
-            py_ops.extend(py_lowerer.lower_update(update))
+        py_seq, py_map = lower_all(py_lowerer)
     finally:
         del os.environ["HOCUSPOCUS_TPU_NO_NATIVE"]
 
     assert not native_lowerer.unsupported and not py_lowerer.unsupported
-    assert native_ops == py_ops
-    assert len(native_ops) > 0
+    assert native_seq == py_seq
+    assert native_map == py_map == []
+    assert len(native_seq) > 0
 
 
 def test_decode_unsupported_content_flagged(codec):

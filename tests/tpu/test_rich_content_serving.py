@@ -1,0 +1,236 @@
+"""Rich content on the TPU merge plane (serve=True).
+
+Round-2 verdict items 4/5: formats, embeds, tree documents (ProseMirror
+XML) and map/array docs must STAY on the plane — lowered as sequence
+rows + host-side map records — instead of retiring to the CPU path.
+Reference parity: the reference serves every Y type through one hot
+loop (`/root/reference/packages/server/src/MessageReceiver.ts:195-213`
+readUpdate handles maps/arrays/rich text identically).
+
+Every test here drives real ws providers against a serve-mode plane and
+asserts (a) convergence, (b) zero unsupported retires, (c) the traffic
+actually rode the plane (plane_broadcasts / sync_serves counters).
+"""
+
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+def _plane_ext():
+    return TpuMergeExtension(num_docs=64, capacity=1024, flush_interval_ms=1, serve=True)
+
+
+async def test_rich_text_formats_served_from_plane():
+    """Bold/link formats are zero-width arena units (Yjs countable=False);
+    the doc stays plane-served and deltas converge byte-faithfully."""
+    ext = _plane_ext()
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="rich")
+    b = new_provider(server, name="rich")
+    try:
+        await wait_synced(a, b)
+        text_a = a.document.get_text("t")
+        text_a.insert(0, "hello world")
+        text_a.format(0, 5, {"bold": True})
+        text_a.insert(11, "!", {"link": "https://x.test"})
+
+        def converged():
+            assert b.document.get_text("t").to_delta() == text_a.to_delta()
+            assert b.document.get_text("t").to_string() == "hello world!"
+
+        await retryable_assertion(converged)
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        assert "rich" in ext._docs
+        assert ext.plane.counters["plane_broadcasts"] >= 1
+        # formats are zero-width for text extraction, as in Yjs
+        assert ext.plane.text("rich") == "hello world!"
+
+        # late joiner gets formats through the plane sync path
+        serves = ext.plane.counters["sync_serves"]
+        c = new_provider(server, name="rich")
+        await wait_synced(c)
+        assert c.document.get_text("t").to_delta() == text_a.to_delta()
+        assert ext.plane.counters["sync_serves"] > serves
+        c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+async def test_embeds_served_from_plane():
+    ext = _plane_ext()
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="embeds")
+    b = new_provider(server, name="embeds")
+    try:
+        await wait_synced(a, b)
+        text_a = a.document.get_text("t")
+        text_a.insert(0, "image: ")
+        text_a.insert_embed(7, {"src": "pic.png"}, {"width": 100})
+
+        def converged():
+            assert b.document.get_text("t").to_delta() == text_a.to_delta()
+
+        await retryable_assertion(converged)
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+        assert "embeds" in ext._docs
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+async def test_prosemirror_tree_served_from_plane():
+    """A transformer-built ProseMirror doc (XmlElement tree + attributes
+    + marks) lives on the plane as one arena row per sequence."""
+    from hocuspocus_tpu.crdt import apply_update, encode_state_as_update
+    from hocuspocus_tpu.transformer import ProsemirrorTransformer
+
+    pm_json = {
+        "type": "doc",
+        "content": [
+            {
+                "type": "heading",
+                "attrs": {"level": 2},
+                "content": [{"type": "text", "text": "Title"}],
+            },
+            {
+                "type": "paragraph",
+                "content": [
+                    {"type": "text", "text": "plain "},
+                    {"type": "text", "text": "bold", "marks": [{"type": "bold"}]},
+                ],
+            },
+        ],
+    }
+
+    ext = _plane_ext()
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="pm")
+    b = new_provider(server, name="pm")
+    try:
+        await wait_synced(a, b)
+        seed = ProsemirrorTransformer.to_ydoc(pm_json, "prosemirror")
+        apply_update(a.document, encode_state_as_update(seed))
+
+        def converged():
+            result = ProsemirrorTransformer.from_ydoc(b.document, "prosemirror")
+            assert result == pm_json
+
+        await retryable_assertion(converged)
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        assert "pm" in ext._docs
+        # the tree consumed one arena row per sequence (fragment +
+        # heading + paragraph child lists at minimum)
+        assert len(ext.plane.docs["pm"].seqs) >= 3
+
+        # live tree edit: type into the heading text node
+        frag = a.document.get_xml_fragment("prosemirror")
+        frag.get(0).get(0).insert(0, "The ")
+
+        def edited():
+            result = ProsemirrorTransformer.from_ydoc(b.document, "prosemirror")
+            assert result["content"][0]["content"][0]["text"] == "The Title"
+
+        await retryable_assertion(edited)
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+
+        # late joiner builds the whole tree from the plane sync path
+        serves = ext.plane.counters["sync_serves"]
+        c = new_provider(server, name="pm")
+        await wait_synced(c)
+        result = ProsemirrorTransformer.from_ydoc(c.document, "prosemirror")
+        assert result["content"][0]["content"][0]["text"] == "The Title"
+        assert ext.plane.counters["sync_serves"] > serves
+        c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+async def test_array_and_mixed_doc_served_from_plane():
+    """BASELINE config-4 shape: mixed Y.Map/Y.Array docs stay on the
+    plane — array runs are value sequences, map keys host-side LWW."""
+    ext = _plane_ext()
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="mixed")
+    b = new_provider(server, name="mixed")
+    try:
+        await wait_synced(a, b)
+        arr = a.document.get_array("list")
+        arr.insert(0, [1, 2, 3])
+        arr.push(["four", {"five": 5}])
+        a.document.get_map("meta").set("rev", 7)
+        b_arr = b.document.get_array("list")
+
+        def converged():
+            assert b_arr.to_json() == [1, 2, 3, "four", {"five": 5}]
+            assert b.document.get_map("meta").get("rev") == 7
+
+        await retryable_assertion(converged)
+
+        # concurrent-ish edits from both sides keep flowing
+        arr.delete(1, 2)  # -> [1, "four", {"five": 5}]
+        b.document.get_map("meta").set("rev", 8)
+
+        def second():
+            assert b_arr.to_json() == [1, "four", {"five": 5}]
+            assert a.document.get_map("meta").get("rev") == 8
+
+        await retryable_assertion(second)
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        assert "mixed" in ext._docs
+        assert ext.plane.counters["plane_broadcasts"] >= 1
+
+        serves = ext.plane.counters["sync_serves"]
+        c = new_provider(server, name="mixed")
+        await wait_synced(c)
+        assert c.document.get_array("list").to_json() == [1, "four", {"five": 5}]
+        assert c.document.get_map("meta").get("rev") == 8
+        assert ext.plane.counters["sync_serves"] > serves
+        c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+async def test_nested_types_in_map_served_from_plane():
+    """A Y.Text living under a Y.Map key (ContentType as a map value)."""
+    ext = _plane_ext()
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="nested")
+    b = new_provider(server, name="nested")
+    try:
+        await wait_synced(a, b)
+        from hocuspocus_tpu.crdt import YText
+
+        a.document.get_map("fields").set("title", YText("draft"))
+
+        def converged():
+            field = b.document.get_map("fields").get("title")
+            assert field is not None and field.to_string() == "draft"
+
+        await retryable_assertion(converged)
+        # edit the nested text through the map
+        a.document.get_map("fields").get("title").insert(5, " v2")
+
+        def edited():
+            assert b.document.get_map("fields").get("title").to_string() == "draft v2"
+
+        await retryable_assertion(edited)
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+        assert "nested" in ext._docs
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()

@@ -111,9 +111,10 @@ async def test_concurrent_edits_converge_through_plane():
         await server.destroy()
 
 
-async def test_unsupported_content_falls_back_to_cpu_path():
-    """Map edits cannot live on the dense text arena: the doc degrades to
-    the CPU path, nothing is lost, and the degradation is counted."""
+async def test_map_content_served_from_plane():
+    """Map edits are host-side LWW records on the plane (round-2 verdict:
+    BASELINE config-4 shapes must not retire) — the doc STAYS served,
+    broadcasts ride the plane, and late joiners sync from it."""
     ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
     server = await new_hocuspocus(extensions=[ext])
     provider_a = new_provider(server, name="mapdoc")
@@ -124,17 +125,25 @@ async def test_unsupported_content_falls_back_to_cpu_path():
         await retryable_assertion(
             lambda: _assert(provider_b.document.get_map("m").get("k") == "v")
         )
-        assert ext.plane.counters["docs_retired_unsupported"] >= 1
-        assert "mapdoc" not in ext._docs  # serving detached
-        # doc continues to work on the CPU path
-        provider_b.document.get_map("m").set("k2", "v2")
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+        assert "mapdoc" in ext._docs  # serving still attached
+        assert ext.plane.counters["plane_broadcasts"] >= 1
+        # LWW overwrite + a second key keep flowing through the plane
+        provider_b.document.get_map("m").set("k", "v2")
+        provider_b.document.get_map("m").set("k2", "w")
         await retryable_assertion(
-            lambda: _assert(provider_a.document.get_map("m").get("k2") == "v2")
+            lambda: _assert(
+                provider_a.document.get_map("m").get("k") == "v2"
+                and provider_a.document.get_map("m").get("k2") == "w"
+            )
         )
-        # late joiner syncs via CPU
+        # late joiner syncs from the plane
+        serves_before = ext.plane.counters["sync_serves"]
         provider_c = new_provider(server, name="mapdoc")
         await wait_synced(provider_c)
-        assert provider_c.document.get_map("m").get("k") == "v"
+        assert provider_c.document.get_map("m").get("k") == "v2"
+        assert provider_c.document.get_map("m").get("k2") == "w"
+        assert ext.plane.counters["sync_serves"] > serves_before
         provider_c.destroy()
     finally:
         provider_a.destroy()
@@ -157,8 +166,8 @@ async def test_forced_desync_detected_and_recovered():
             lambda: _assert(provider_b.document.get_text("body").to_string() == "healthy")
         )
         # corrupt: host log claims a unit the device never integrated
-        slot = ext.plane.slots["desynced"]
-        ext.plane.char_logs[slot].append(ord("x"))
+        (slot,) = ext.plane.docs["desynced"].seqs.values()
+        ext.plane.unit_logs[slot].append(ord("x"))
 
         provider_a.document.get_text("body").insert(7, " again")
 
