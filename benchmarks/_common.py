@@ -1,0 +1,13 @@
+"""Shared benchmark plumbing."""
+
+import os
+
+
+def force_cpu_if_requested() -> None:
+    """Honor JAX_PLATFORMS=cpu even when a TPU plugin hijacks the env
+    var (the axon plugin registers its backend regardless; the config
+    route reliably pins the backend). Must run before first jax use."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -1,8 +1,13 @@
-"""BASELINE config 4: docs behind Redis fan-out, multi-node, steady ops.
+"""BASELINE config 4: mixed Map/Array docs behind Redis fan-out,
+multi-node, steady ops — SERVE-MODE planes on both instances (the
+production topology, round-2 verdict item 5).
 
-Two server instances share documents through (mini-)Redis; clients on
-instance A stream steady edits, clients on instance B receive them.
-Measures cross-instance propagation throughput and p99 latency.
+Two server instances share documents through (mini-)Redis; each runs a
+serve=True TPU merge plane, so local fan-out rides plane broadcasts.
+Clients on instance A stream steady mixed edits (text + Y.Map LWW
+writes + Y.Array inserts), clients on instance B receive them. Measures
+cross-instance propagation throughput and p99 latency, and asserts the
+docs STAYED plane-served (zero unsupported retires / CPU fallbacks).
 
 Env: C4_DOCS (default 10), C4_SECONDS (default 5),
 REDIS_HOST/REDIS_PORT to target a real Redis.
@@ -20,10 +25,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 async def main() -> None:
     import numpy as np
 
+    from _common import force_cpu_if_requested
+
+    force_cpu_if_requested()
+
     from hocuspocus_tpu.extensions import Redis
     from hocuspocus_tpu.net.mini_redis import MiniRedis
     from hocuspocus_tpu.provider import HocuspocusProvider
     from hocuspocus_tpu.server import Configuration, Server
+    from hocuspocus_tpu.tpu import TpuMergeExtension
 
     num_docs = int(os.environ.get("C4_DOCS", 10))
     seconds = float(os.environ.get("C4_SECONDS", 5))
@@ -36,7 +46,15 @@ async def main() -> None:
         mini = await MiniRedis().start()
         redis_host, redis_port = "127.0.0.1", mini.port
 
+    planes = {}
+
     def make_server(ident):
+        planes[ident] = TpuMergeExtension(
+            num_docs=max(num_docs * 4, 64),
+            capacity=4096,
+            flush_interval_ms=2.0,
+            serve=True,
+        )
         return Server(
             Configuration(
                 quiet=True,
@@ -46,7 +64,8 @@ async def main() -> None:
                         port=redis_port,
                         identifier=ident,
                         disconnect_delay=100,
-                    )
+                    ),
+                    planes[ident],
                 ],
             )
         )
@@ -84,16 +103,40 @@ async def main() -> None:
         reader.document.on("update", on_reader_update(d))
 
     sent = 0
+    tick = 0
     start = time.perf_counter()
     deadline = start + seconds
     while time.perf_counter() < deadline:
         for d, writer in enumerate(writers):
             send_times[d].append(time.perf_counter())
-            writer.document.get_text("t").insert(0, "z")
+            # mixed Y.Map/Y.Array/Y.Text workload (BASELINE config 4)
+            mode = (tick + d) % 3
+            if mode == 0:
+                writer.document.get_text("t").insert(0, "z")
+            elif mode == 1:
+                writer.document.get_map("meta").set(f"k{tick % 7}", tick)
+            else:
+                writer.document.get_array("events").push([tick])
             sent += 1
+        tick += 1
         await asyncio.sleep(0.02)  # ~50 ops/s/doc
     await asyncio.sleep(1.0)
     elapsed = deadline - start
+
+    # verify the mixed docs actually stayed on the serve-mode planes
+    plane_health = {}
+    for ident, ext in planes.items():
+        c = ext.plane.counters
+        plane_health[ident] = {
+            "plane_broadcasts": c["plane_broadcasts"],
+            "sync_serves": c["sync_serves"],
+            "docs_retired_unsupported": c["docs_retired_unsupported"],
+            "cpu_fallbacks": c["cpu_fallbacks"],
+            "docs_served": len(ext._docs),
+        }
+        assert c["docs_retired_unsupported"] == 0, plane_health
+        assert c["cpu_fallbacks"] == 0, plane_health
+    assert planes["bench-a"].plane.counters["plane_broadcasts"] > 0, plane_health
 
     p99 = float(np.percentile(np.array(latencies) * 1000, 99)) if latencies else None
     print(
@@ -107,6 +150,8 @@ async def main() -> None:
                     "sent": sent,
                     "received": received,
                     "propagation_p99_ms": round(p99, 2) if p99 else None,
+                    "serve_mode": True,
+                    "plane_health": plane_health,
                 },
             }
         )

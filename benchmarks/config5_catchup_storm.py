@@ -31,11 +31,10 @@ def main() -> None:
     host_docs = int(os.environ.get("C5_HOST_DOCS", 200))
 
     # -- part 1: device SV diff -------------------------------------------
-    import jax
+    from _common import force_cpu_if_requested
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # honor a CPU request even when a TPU plugin hijacks the env var
-        jax.config.update("jax_platforms", "cpu")
+    force_cpu_if_requested()
+    import jax
     import jax.numpy as jnp
 
     from hocuspocus_tpu.tpu.kernels import state_vector_diff

@@ -38,6 +38,7 @@ class MiniRedis:
         # channel -> set of writer streams
         self.subscribers: dict[bytes, set[asyncio.StreamWriter]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set[asyncio.StreamWriter] = set()
         # cluster emulation: list of (start, end, MiniRedis) covering the
         # slot space; keyed commands off this node's ranges answer MOVED,
         # publishes fan out to every node's subscribers (the cluster bus)
@@ -76,6 +77,11 @@ class MiniRedis:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # drop live client connections like a real redis restart
+            # would (and Python 3.12's wait_closed otherwise blocks on
+            # handlers that sit in read_reply forever)
+            for writer in list(self._conns):
+                writer.close()
             await self._server.wait_closed()
             self._server = None
 
@@ -91,6 +97,7 @@ class MiniRedis:
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         subscribed: set[bytes] = set()
+        self._conns.add(writer)
         try:
             while True:
                 try:
@@ -235,4 +242,5 @@ class MiniRedis:
         finally:
             for channel in subscribed:
                 self.subscribers.get(channel, set()).discard(writer)
+            self._conns.discard(writer)
             writer.close()

@@ -165,10 +165,12 @@ class RedisClient(RedisCommands):
         return self.writer is not None and not self.writer.is_closing()
 
     async def execute(self, *args: Union[bytes, str, int, float], key=None) -> Any:
-        if not self.connected:
-            await self.connect()
+        # connect under the same lock that serializes stream use: a
+        # concurrent execute (or a close() racing the connected check)
+        # must never see a half-replaced reader/writer pair
         async with self._lock:
-            assert self.writer is not None and self.reader is not None
+            if not self.connected:
+                await self.connect()
             self.writer.write(encode_command(*args))
             await self.writer.drain()
             return await read_reply(self.reader)
@@ -178,10 +180,9 @@ class RedisClient(RedisCommands):
         interleaving — needed for ASKING + redirected command pairs).
         Error replies come back as RespError values, not raises, so the
         stream stays in sync."""
-        if not self.connected:
-            await self.connect()
         async with self._lock:
-            assert self.writer is not None and self.reader is not None
+            if not self.connected:
+                await self.connect()
             for command in commands:
                 self.writer.write(encode_command(*command))
             await self.writer.drain()
@@ -331,21 +332,49 @@ class RedisSubscriber:
         self._reader_task: Optional[asyncio.Task] = None
         self._subscribed: dict[bytes, asyncio.Future] = {}
         self.channels: set[bytes] = set()
+        self._conn_lock = asyncio.Lock()
 
     async def connect(self) -> "RedisSubscriber":
-        self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
-        self._reader_task = asyncio.ensure_future(self._read_loop())
-        return self
+        # concurrent subscribes during startup must not each open a
+        # connection: two _read_loops on one stream raise "readuntil()
+        # called while another coroutine is already waiting"
+        async with self._conn_lock:
+            if self.connected:
+                return self
+            if self._reader_task is not None:
+                self._reader_task.cancel()
+            self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
+            self._reader_task = asyncio.ensure_future(self._read_loop())
+            # recover subscriptions that died with the previous
+            # connection — without this, a Redis restart silently stops
+            # cross-instance updates for every already-loaded doc
+            if self.channels:
+                for channel in self.channels:
+                    self.writer.write(encode_command("SUBSCRIBE", channel))
+                await self.writer.drain()
+            return self
 
     @property
     def connected(self) -> bool:
-        return self.writer is not None and not self.writer.is_closing()
+        # liveness includes the read loop: a server half-close (FIN on
+        # idle timeout / failover) kills _read_loop long before
+        # writer.is_closing() flips, and a subscriber without a reader
+        # is deaf — it must count as disconnected so connect() heals it
+        return (
+            self.writer is not None
+            and not self.writer.is_closing()
+            and self._reader_task is not None
+            and not self._reader_task.done()
+        )
 
     async def _read_loop(self) -> None:
-        assert self.reader is not None
+        # bind the stream locally: a reconnect replaces self.reader, and
+        # the outgoing loop must never start reading the new stream
+        reader = self.reader
+        assert reader is not None
         try:
             while True:
-                reply = await read_reply(self.reader)
+                reply = await read_reply(reader)
                 if not isinstance(reply, list) or not reply:
                     continue
                 kind = reply[0]

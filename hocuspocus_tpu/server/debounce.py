@@ -27,7 +27,15 @@ class Debouncer:
             self._timers.pop(id, None)
             result = fn()
             if asyncio.iscoroutine(result):
-                return asyncio.ensure_future(result)
+                task = asyncio.ensure_future(result)
+                # timer-fired tasks have no awaiter: retrieve the
+                # exception so a failing store chain (which already logs
+                # itself) doesn't also emit "Task exception was never
+                # retrieved". Callers that DO await still see the raise.
+                task.add_done_callback(
+                    lambda t: t.cancelled() or t.exception()
+                )
+                return task
             return result
 
         if delay_ms == 0 or (time.monotonic() - start) * 1000 >= max_delay_ms:

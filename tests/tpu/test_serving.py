@@ -189,3 +189,73 @@ async def test_forced_desync_detected_and_recovered():
         provider_a.destroy()
         provider_b.destroy()
         await server.destroy()
+
+
+async def test_device_fault_between_capture_and_flush_loses_nothing():
+    """Kill the device step AFTER updates were captured for plane
+    broadcast (CPU fan-out suppressed) but BEFORE the flush integrates
+    them: the extension must degrade every served doc to the CPU path
+    with a full-state broadcast so no captured update is ever lost
+    (round-2 verdict item 8 — merge_plane claims this; only the
+    desync/unsupported degradations were tested)."""
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    provider_a = new_provider(server, name="faulty")
+    provider_b = new_provider(server, name="faulty")
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_text("body").insert(0, "before fault")
+        await retryable_assertion(
+            lambda: _assert(
+                provider_b.document.get_text("body").to_string() == "before fault"
+            )
+        )
+
+        # arm the fault: the NEXT device flush dies (transient Mosaic /
+        # runtime failure), after try_capture has already claimed the
+        # in-flight update for plane broadcast
+        real_flush = ext.plane.flush
+        fired = {"n": 0}
+
+        def dying_flush():
+            fired["n"] += 1
+            raise RuntimeError("simulated device fault mid-flush")
+
+        ext.plane.flush = dying_flush
+        provider_a.document.get_text("body").insert(12, " + captured edit")
+
+        def degraded_whole():
+            assert fired["n"] >= 1
+            assert ext.plane.counters["cpu_fallbacks"] == 1
+            assert ext.plane.counters["docs_retired_fallback"] == 1
+            assert "faulty" not in ext._docs  # serving detached
+            # the captured-but-never-flushed edit reached the peer via
+            # the full-state CPU fallback broadcast
+            assert (
+                provider_b.document.get_text("body").to_string()
+                == "before fault + captured edit"
+            )
+
+        await retryable_assertion(degraded_whole)
+        ext.plane.flush = real_flush
+
+        # steady state continues on the CPU path in both directions
+        provider_b.document.get_text("body").insert(0, "b: ")
+        await retryable_assertion(
+            lambda: _assert(
+                provider_a.document.get_text("body").to_string()
+                == "b: before fault + captured edit"
+            )
+        )
+        # and a late joiner syncs the complete doc via CPU
+        provider_c = new_provider(server, name="faulty")
+        await wait_synced(provider_c)
+        assert (
+            provider_c.document.get_text("body").to_string()
+            == "b: before fault + captured edit"
+        )
+        provider_c.destroy()
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server.destroy()
