@@ -89,6 +89,8 @@ async def plane_served(num_docs: int, bursts: int) -> dict:
     from hocuspocus_tpu.tpu import TpuMergeExtension
     from hocuspocus_tpu.transformer import ProsemirrorTransformer
 
+    from _common import wait_synced, wait_until
+
     ext = TpuMergeExtension(
         num_docs=num_docs * 8, capacity=4096, flush_interval_ms=2.0, serve=True
     )
@@ -98,28 +100,14 @@ async def plane_served(num_docs: int, bursts: int) -> dict:
     writers = [HocuspocusProvider(name=f"pm-{d}", url=url) for d in range(num_docs)]
     readers = [HocuspocusProvider(name=f"pm-{d}", url=url) for d in range(num_docs)]
     try:
-        deadline = time.monotonic() + 30
-        for p in writers + readers:
-            while not p.synced:
-                if time.monotonic() > deadline:
-                    raise TimeoutError("config3 providers never synced")
-                await asyncio.sleep(0.01)
+        await wait_synced(writers + readers, "config3 providers never synced", 30)
         # seed every doc with the PM tree over the wire
         for d, w in enumerate(writers):
             seed = ProsemirrorTransformer.to_ydoc(make_pm_doc(d), "prosemirror")
             apply_update(w.document, encode_state_as_update(seed))
 
         async def converged(check, why, t=30.0):
-            dl = time.monotonic() + t
-            while True:
-                try:
-                    if all(check(r) for r in range(num_docs)):
-                        return
-                except Exception:
-                    pass
-                if time.monotonic() > dl:
-                    raise TimeoutError(why)
-                await asyncio.sleep(0.01)
+            await wait_until(lambda: all(check(r) for r in range(num_docs)), why, t)
 
         await converged(
             lambda r: ProsemirrorTransformer.from_ydoc(readers[r].document, "prosemirror")

@@ -138,9 +138,15 @@ class MessageReceiver:
             source = getattr(document, "sync_source", None)
             if source is not None:
                 # TPU-plane serving path: the SyncStep2 payload is built
-                # from device state; None degrades to the CPU document
+                # from device state; None degrades to the CPU document.
+                # The async variant batches concurrent SyncStep1s through
+                # one device state-vector-diff triage (catch-up storms).
                 sv = message.decoder.read_var_uint8_array()
-                update = source.encode_state_as_update(sv)
+                batched = getattr(source, "encode_state_as_update_async", None)
+                if batched is not None:
+                    update = await batched(sv)
+                else:
+                    update = source.encode_state_as_update(sv)
                 if update is not None:
                     message.encoder.write_var_uint(MESSAGE_YJS_SYNC_STEP2)
                     message.encoder.write_var_uint8_array(update)

@@ -434,6 +434,7 @@ class TpuMergeExtension(Extension):
             from .serving import PlaneServing
 
             self.serving = PlaneServing(self.plane)
+            self.serving.flush_failure_handler = self._degrade_all_served
 
     # -- hooks ---------------------------------------------------------------
 
@@ -512,23 +513,26 @@ class TpuMergeExtension(Extension):
 
     # -- flush ---------------------------------------------------------------
 
+    def _degrade_all_served(self) -> None:
+        """Device-flush fault: the dead flush already consumed queued ops,
+        so every served doc degrades to the CPU path via a full-state
+        broadcast rather than silently dropping captured updates."""
+        from ..server import logger as _logger_mod
+
+        _logger_mod.log_error("plane flush failed; degrading served docs to CPU")
+        for _, document in list(self._docs.items()):
+            try:
+                self._fallback_to_cpu(document)
+            except Exception:
+                _logger_mod.log_error(f"CPU fallback failed for {document.name!r}")
+
     def _flush(self) -> None:
         try:
             self.plane.flush()
             if self.serve:
                 self.serving.refresh()
         except Exception:
-            # a plane-level device error must not strand captured docs:
-            # degrade every served doc to the CPU path (full-state
-            # broadcast) rather than silently dropping their updates
-            from ..server import logger as _logger_mod
-
-            _logger_mod.log_error("plane flush failed; degrading served docs to CPU")
-            for _, document in list(self._docs.items()):
-                try:
-                    self._fallback_to_cpu(document)
-                except Exception:
-                    _logger_mod.log_error(f"CPU fallback failed for {document.name!r}")
+            self._degrade_all_served()
             return
         if not self.serve:
             return

@@ -83,6 +83,15 @@ class PlaneServing:
         self.broadcast_cursor: dict[str, int] = {}
         self._length_cache: Optional[np.ndarray] = None
         self._overflow_cache: Optional[np.ndarray] = None
+        # catch-up batching: SyncStep1s that arrive in the same storm
+        # window are triaged by ONE state_vector_diff kernel call
+        self._catchup_queue: list[tuple] = []  # (name, document, sv_bytes, future)
+        self._catchup_scheduled = False
+        # set by TpuMergeExtension: invoked when a device flush dies so
+        # served docs degrade to the CPU path (captured ops were already
+        # popped from the queues — they only survive via the full-state
+        # fallback broadcast)
+        self.flush_failure_handler = None
 
     # -- device readback cache ---------------------------------------------
 
@@ -185,6 +194,17 @@ class PlaneServing:
         ds.sort_and_merge()
         return ds
 
+    def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
+        """SyncStep2 bytes for a doc given the per-client cutoff map."""
+        items_by_client = self._group_items(doc, doc.serve_log, sm)
+        encoder = Encoder()
+        encoder.write_var_uint(len(items_by_client))
+        for client in sorted(items_by_client, reverse=True):
+            _write_structs(encoder, items_by_client[client], client, sm[client])
+        self._device_delete_set(doc).write(encoder)
+        self.plane.counters["sync_serves"] += 1
+        return encoder.to_bytes()
+
     def encode_state_as_update(
         self, name: str, document, sv_bytes: Optional[bytes] = None
     ) -> Optional[bytes]:
@@ -208,14 +228,126 @@ class PlaneServing:
         for client in local_sv:
             if client not in target_sv:
                 sm[client] = 0
-        items_by_client = self._group_items(doc, doc.serve_log, sm)
-        encoder = Encoder()
-        encoder.write_var_uint(len(items_by_client))
-        for client in sorted(items_by_client, reverse=True):
-            _write_structs(encoder, items_by_client[client], client, sm[client])
-        self._device_delete_set(doc).write(encoder)
-        plane.counters["sync_serves"] += 1
-        return encoder.to_bytes()
+        return self._encode_from_sm(doc, sm)
+
+    # -- batched catch-up (the storm path) -----------------------------------
+
+    async def batched_sync(self, name: str, document, sv_bytes: Optional[bytes]):
+        """Enqueue a SyncStep1 for device-triaged batch serving.
+
+        Every request that lands in the same event-loop window shares
+        ONE `state_vector_diff` kernel call (tpu/kernels.py) — the
+        O(docs x clients) triage of a reconnect storm runs on the
+        device, and only the per-request item encode stays host-side.
+        Resolves to SyncStep2 bytes, or None = CPU fallback.
+        """
+        import asyncio
+
+        future = asyncio.get_event_loop().create_future()
+        self._catchup_queue.append((name, document, sv_bytes, future))
+        if not self._catchup_scheduled:
+            self._catchup_scheduled = True
+            asyncio.get_event_loop().call_soon(self._drain_catchup)
+        return await future
+
+    def _drain_catchup(self) -> None:
+        import jax.numpy as jnp
+
+        from .kernels import state_vector_diff
+
+        self._catchup_scheduled = False
+        batch, self._catchup_queue = self._catchup_queue, []
+        if not batch:
+            return
+        plane = self.plane
+        try:
+            if plane.pending_ops() > 0:
+                try:
+                    plane.flush()
+                except Exception:
+                    # the dead flush already consumed queued ops — the
+                    # same fault TpuMergeExtension._flush handles by
+                    # degrading every served doc with a full-state CPU
+                    # broadcast; route through the same safety model
+                    # instead of silently dropping captured updates
+                    for *_rest, future in batch:
+                        future.done() or future.set_result(None)
+                    if self.flush_failure_handler is not None:
+                        self.flush_failure_handler()
+                    return
+                self.refresh()
+            # triage rows: healthy, covering docs only (the rest resolve
+            # to None and fall back to the CPU path)
+            rows: list[tuple] = []  # (local_sv, target_sv, columns, future)
+            width = 1
+            for name, document, sv_bytes, future in batch:
+                doc = self.doc_healthy(name)
+                if doc is None or not self.covers(name, document):
+                    future.done() or future.set_result(None)
+                    continue
+                local_sv = dict(doc.lowerer.known)
+                try:
+                    target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
+                except Exception:
+                    future.done() or future.set_result(None)
+                    continue
+                columns = sorted(set(local_sv) | set(target_sv))
+                width = max(width, len(columns))
+                rows.append((doc, local_sv, target_sv, columns, future))
+            if not rows:
+                return
+            if len(rows) == 1:
+                # lone reconnect (the steady-state case): the host dict
+                # diff costs microseconds — save the kernel dispatch and
+                # the device round-trip for actual storms
+                doc, local_sv, target_sv, _, future = rows[0]
+                sm = {}
+                for cid, clock in target_sv.items():
+                    if local_sv.get(cid, 0) > clock:
+                        sm[cid] = clock
+                for cid in local_sv:
+                    if cid not in target_sv:
+                        sm[cid] = 0
+                if not future.done():
+                    try:
+                        future.set_result(self._encode_from_sm(doc, sm))
+                    except Exception:
+                        future.set_result(None)
+                return
+            # pad to a power-of-two (B, C) so storm-size jitter doesn't
+            # recompile the kernel per request count
+            b = 1
+            while b < len(rows):
+                b *= 2
+            c = 1
+            while c < width:
+                c *= 2
+            server = np.zeros((b, c), np.int64)
+            client = np.zeros((b, c), np.int64)
+            for i, (doc, local_sv, target_sv, columns, _) in enumerate(rows):
+                for j, cid in enumerate(columns):
+                    server[i, j] = local_sv.get(cid, 0)
+                    client[i, j] = target_sv.get(cid, 0)
+            missing_from, missing_len = state_vector_diff(
+                jnp.asarray(server, jnp.int32), jnp.asarray(client, jnp.int32)
+            )
+            missing_from = np.asarray(missing_from)
+            missing_len = np.asarray(missing_len)
+            for i, (doc, local_sv, target_sv, columns, future) in enumerate(rows):
+                if future.done():
+                    continue
+                try:
+                    sm = {
+                        cid: int(missing_from[i, j])
+                        for j, cid in enumerate(columns)
+                        if missing_len[i, j] > 0
+                    }
+                    future.set_result(self._encode_from_sm(doc, sm))
+                except Exception:
+                    future.set_result(None)  # degrade this request to CPU
+        except Exception:
+            for *_rest, future in batch:
+                future.done() or future.set_result(None)
 
     def build_broadcast(self, name: str) -> Optional[bytes]:
         """Merged update for ops integrated since the last broadcast.
@@ -279,5 +411,18 @@ class TpuSyncSource:
 
             _logger_mod.log_error(
                 f"plane sync serve failed for {self.name!r}; using CPU path"
+            )
+            return None
+
+    async def encode_state_as_update_async(self, sv_bytes: Optional[bytes]) -> Optional[bytes]:
+        """Batched (storm) variant: concurrent SyncStep1s share one
+        device state-vector-diff triage — see PlaneServing.batched_sync."""
+        try:
+            return await self.serving.batched_sync(self.name, self.document, sv_bytes)
+        except Exception:
+            from ..server import logger as _logger_mod
+
+            _logger_mod.log_error(
+                f"plane batched sync failed for {self.name!r}; using CPU path"
             )
             return None

@@ -259,3 +259,60 @@ async def test_device_fault_between_capture_and_flush_loses_nothing():
         provider_a.destroy()
         provider_b.destroy()
         await server.destroy()
+
+
+async def test_catchup_storm_batches_sync_triage_on_device():
+    """Concurrent reconnect SyncStep1s must share state_vector_diff
+    kernel calls (round-2 verdict item 6: the storm triage runs on
+    device, batched across docs — not one host diff per reconnect)."""
+    import hocuspocus_tpu.tpu.kernels as kernels_mod
+
+    ext = TpuMergeExtension(num_docs=32, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    num_docs, joiners_per_doc = 4, 4
+    seeders = [new_provider(server, name=f"storm-{d}") for d in range(num_docs)]
+    try:
+        await wait_synced(*seeders)
+        for d, p in enumerate(seeders):
+            p.document.get_text("body").insert(0, f"doc {d} content before the storm")
+        await retryable_assertion(
+            lambda: _assert(ext.plane.counters["plane_broadcasts"] >= 1)
+        )
+
+        calls = {"n": 0}
+        real_diff = kernels_mod.state_vector_diff
+
+        def counted(a, b):
+            calls["n"] += 1
+            return real_diff(a, b)
+
+        kernels_mod.state_vector_diff = counted
+        try:
+            serves_before = ext.plane.counters["sync_serves"]
+            storm = [
+                new_provider(server, name=f"storm-{d}")
+                for d in range(num_docs)
+                for _ in range(joiners_per_doc)
+            ]
+            await wait_synced(*storm)
+            for d in range(num_docs):
+                for j in range(joiners_per_doc):
+                    assert (
+                        storm[d * joiners_per_doc + j]
+                        .document.get_text("body")
+                        .to_string()
+                        == f"doc {d} content before the storm"
+                    )
+            served = ext.plane.counters["sync_serves"] - serves_before
+            assert served >= num_docs * joiners_per_doc
+            assert calls["n"] >= 1  # the device triage actually ran
+            # batching: strictly fewer kernel calls than reconnects
+            assert calls["n"] < num_docs * joiners_per_doc, calls
+            for p in storm:
+                p.destroy()
+        finally:
+            kernels_mod.state_vector_diff = real_diff
+    finally:
+        for p in seeders:
+            p.destroy()
+        await server.destroy()
