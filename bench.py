@@ -353,10 +353,11 @@ def run_bench() -> None:
     # providers, plane serving path (device flush + merged broadcast) —
     # the BASELINE metric is end-to-end, not kernel-microbatch
     server_p99_ms = None
+    server_p99_extra = None
     server_p99_err = None
     if os.environ.get("BENCH_SERVER_P99", "1") != "0":
         try:
-            server_p99_ms = _measure_server_p99()
+            server_p99_ms, server_p99_extra = _measure_server_p99()
         except Exception as error:  # never lose the headline number to this
             server_p99_err = repr(error)[:300]
 
@@ -380,54 +381,99 @@ def run_bench() -> None:
     }
     if server_p99_ms is not None:
         result["extra"]["server_merge_to_broadcast_p99_ms"] = round(server_p99_ms, 2)
+    if server_p99_extra is not None:
+        result["extra"]["server_p99_detail"] = server_p99_extra
     if server_p99_err is not None:
         result["extra"]["server_p99_error"] = server_p99_err
     print(json.dumps(result))
 
 
-def _measure_server_p99() -> float:
+def _measure_server_p99() -> "tuple[float, dict]":
     """Merge-to-broadcast p99 through the live server on the plane path.
 
-    Boots the real aiohttp server with TpuMergeExtension(serve=True),
-    connects 2 real ws providers per doc, and times client-A-insert →
-    client-B-observes for a round-robin edit stream. This is the
-    end-to-end metric from BASELINE.json (<50 ms p99 target): queue wait
-    + lowering + device flush + merged broadcast + fan-out.
+    Boots the real aiohttp server with TpuMergeExtension(serve=True) and
+    measures client-A-insert → client-B-observes latency. The BASELINE
+    budget (<50 ms p99) is specified AT SCALE, so the doc population
+    defaults to 1024 on TPU (8 on CPU smoke runs): every doc gets a
+    writer providing steady background load, and a sampled subset gets
+    a second (reader) provider on which latency is timed — so the
+    device flush runs at full batch width while the p99 is measured
+    end-to-end (queue wait + lowering + device flush + merged broadcast
+    + fan-out).
     """
     import asyncio
     import time as _time
+
+    import jax as _jax
 
     from hocuspocus_tpu.provider import HocuspocusProvider
     from hocuspocus_tpu.server import Configuration, Server
     from hocuspocus_tpu.tpu import TpuMergeExtension
 
-    num_docs = int(os.environ.get("BENCH_SERVER_DOCS", 8))
+    default_docs = 1024 if _jax.default_backend() == "tpu" else 8
+    num_docs = int(os.environ.get("BENCH_SERVER_DOCS", default_docs))
     edits = int(os.environ.get("BENCH_SERVER_EDITS", 200))
+    sampled = min(int(os.environ.get("BENCH_SERVER_SAMPLED", 32)), num_docs)
+    # own wall-clock budget, well under ATTEMPT_TIMEOUT_S: blowing it
+    # must cost only the p99 detail, never the already-computed
+    # headline merges/sec (run_bench prints AFTER this returns)
+    budget_s = int(os.environ.get("BENCH_SERVER_TIMEOUT", 420))
 
-    async def run() -> float:
+    async def run() -> "tuple[float, dict]":
         ext = TpuMergeExtension(
             num_docs=num_docs * 2, capacity=8192, flush_interval_ms=2.0, serve=True
         )
         server = Server(Configuration(quiet=True, extensions=[ext]))
         await server.listen(port=0)
+        url = server.web_socket_url
         writers, readers = [], []
         try:
-            for d in range(num_docs):
-                writers.append(
-                    HocuspocusProvider(name=f"bench-{d}", url=server.web_socket_url)
-                )
-                readers.append(
-                    HocuspocusProvider(name=f"bench-{d}", url=server.web_socket_url)
-                )
-            deadline = _time.monotonic() + 30
-            for p in writers + readers:
+            # connect in chunks so the sync storm stays within the
+            # provider backoff budget at 1k+ connections
+            for base in range(0, num_docs, 256):
+                chunk = [
+                    HocuspocusProvider(name=f"bench-{d}", url=url)
+                    for d in range(base, min(base + 256, num_docs))
+                ]
+                writers.extend(chunk)
+                deadline = _time.monotonic() + 120
+                for p in chunk:
+                    while not p.synced:
+                        if _time.monotonic() > deadline:
+                            raise TimeoutError("bench writers never synced")
+                        await asyncio.sleep(0.005)
+            for d in range(sampled):
+                readers.append(HocuspocusProvider(name=f"bench-{d}", url=url))
+            deadline = _time.monotonic() + 60
+            for p in readers:
                 while not p.synced:
                     if _time.monotonic() > deadline:
-                        raise TimeoutError("bench providers never synced")
+                        raise TimeoutError("bench readers never synced")
+                    await asyncio.sleep(0.005)
+
+            # steady background load across the whole population: each
+            # tick, ~6% of non-sampled docs take an insert, so flushes
+            # run at real batch width during the latency measurement.
+            # Lengths are tracked host-side (O(1), not to_string()) and
+            # the loop yields between inserts so harness CPU stalls
+            # don't masquerade as server latency in the timed samples.
+            stop_load = False
+            bg_len = [0] * num_docs
+
+            async def background_load() -> None:
+                tick = 0
+                while not stop_load:
+                    for d in range(sampled + tick % 16, num_docs, 16):
+                        writers[d].document.get_text("body").insert(bg_len[d], "y" * 8)
+                        bg_len[d] += 8
+                        await asyncio.sleep(0)
+                        if stop_load:
+                            return
+                    tick += 1
                     await asyncio.sleep(0.01)
 
             async def one_edit(i: int) -> float:
-                d = i % num_docs
+                d = i % sampled
                 wtext = writers[d].document.get_text("body")
                 rtext = readers[d].document.get_text("body")
                 expected = len(rtext.to_string()) + 16
@@ -439,19 +485,40 @@ def _measure_server_p99() -> float:
                     await asyncio.sleep(0.0005)
                 return _time.perf_counter() - t0
 
-            for i in range(10):  # warmup: compiles the flush shapes
+            # warmup covers EVERY sampled doc (first-touch costs: doc
+            # materialization, serve-log path, flush-shape compiles)
+            for i in range(max(10, sampled)):
                 await one_edit(i)
-            lat = []
-            for i in range(edits):
-                lat.append(await one_edit(i))
+            load_task = asyncio.ensure_future(background_load())
+            try:
+                lat = []
+                deadline = _time.monotonic() + budget_s * 0.5
+                for i in range(edits):
+                    lat.append(await one_edit(i))
+                    if _time.monotonic() > deadline and len(lat) >= 50:
+                        break  # enough samples; protect the headline
+            finally:
+                stop_load = True
+                await load_task
             assert ext.plane.counters["plane_broadcasts"] > 0, "plane never served"
-            return float(np.percentile(np.array(lat) * 1000, 99))
+            extra = {
+                "server_docs": num_docs,
+                "sampled_docs": sampled,
+                "samples": len(lat),
+                "served_docs": len(ext._docs),
+                "plane_broadcasts": ext.plane.counters["plane_broadcasts"],
+                "cpu_fallbacks": ext.plane.counters["cpu_fallbacks"],
+            }
+            return float(np.percentile(np.array(lat) * 1000, 99)), extra
         finally:
             for p in writers + readers:
                 p.destroy()
             await server.destroy()
 
-    return asyncio.run(run())
+    async def bounded() -> "tuple[float, dict]":
+        return await asyncio.wait_for(run(), timeout=budget_s)
+
+    return asyncio.run(bounded())
 
 
 if __name__ == "__main__":
