@@ -211,3 +211,135 @@ def test_native_codec_decodes_golden_blobs():
     structs, deletes = codec.decode_update(BLOB_GC_ANCHORED)
     assert 2 in [s[2] for s in structs]  # GC struct seen
     assert [tuple(d) for d in deletes] == [(42, 1, 2)]
+
+
+# ---------------------------------------------------------------------------
+# Rich-content vectors (round 3): maps, arrays, XML trees, embeds — the
+# content the TPU plane now serves. Same provenance as above: authored
+# byte-by-byte from the v1 spec (ContentType refs: Array=0 Map=1 Text=2
+# XmlElement=3(name) XmlFragment=4 XmlHook=5(name) XmlText=6; lib0 Any
+# tags: 119=string 120=true 125=varint; map successor items carry the
+# 0x20 parentSub BIT with origins but no sub string — readers derive
+# the key from the left item).
+# ---------------------------------------------------------------------------
+
+# client 10, root map "m": set k=1 (ContentAny[int 1]), then k="two"
+# (successor: origin (10,0), parentSub bit, no sub string). Setting a
+# map key tombstones the previous entry -> ds {10: [(0, 1)]}.
+BLOB_MAP_LWW = _h(
+    "01 02 0A 00"
+    " 28 01 01 6D 01 6B 01 7D 01"      # Item Any[1] parent "m" sub "k"
+    " A8 0A 00 01 77 03 74 77 6F"      # Item Any["two"], origin (10,0)
+    " 01 0A 01 00 01"                     # ds: client 10, range (0, 1)
+)
+
+# client 11, root array "a": Any[1, "x"] (2 clocks), then Any[true]
+# inserted at the head (right-origin (11,0), no left)
+BLOB_ARRAY = _h(
+    "01 02 0B 00"
+    " 08 01 01 61 02 7D 01 77 01 78"      # Item Any[int 1, "x"]
+    " 48 0B 00 01 78"                  # Item Any[true], rightOrigin (11,0)
+    " 00"
+)
+
+# client 12, root xml "x": <p lang="en">hi</p> as four structs:
+# XmlElement "p" -> XmlText under the element item -> "hi" under the
+# XmlText item -> attribute lang="en" as a map item on the element
+BLOB_XML_TREE = _h(
+    "01 04 0C 00"
+    " 07 01 01 78 03 01 70"               # ContentType XmlElement("p") in root "x"
+    " 07 00 0C 00 06"                     # ContentType XmlText, parent item (12,0)
+    " 04 00 0C 01 02 68 69"               # ContentString "hi", parent item (12,1)
+    " 28 00 0C 00 04 6C 61 6E 67 01 77 02 65 6E"  # attr lang="en" on (12,0)
+    " 00"
+)
+
+# client 13, root text "t": a single ContentEmbed {"a":1}
+BLOB_EMBED = _h(
+    "01 01 0D 00"
+    " 05 01 01 74 07 7B 22 61 22 3A 31 7D"
+    " 00"
+)
+
+
+def test_map_lww_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_MAP_LWW)
+    assert doc.get_map("m").get("k") == "two"
+    assert doc.store.get_state_vector() == {10: 2}
+    fresh = _reencode_roundtrip(doc)
+    assert fresh.get_map("m").get("k") == "two"
+
+
+def test_array_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_ARRAY)
+    assert doc.get_array("a").to_json() == [True, 1, "x"]
+    assert doc.store.get_state_vector() == {11: 3}
+    assert _reencode_roundtrip(doc).get_array("a").to_json() == [True, 1, "x"]
+
+
+def test_xml_tree_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_XML_TREE)
+    frag = doc.get_xml_fragment("x")
+    element = frag.get(0)
+    assert element.node_name == "p"
+    assert element.get_attribute("lang") == "en"
+    assert element.get(0).to_string() == "hi"
+    fresh = _reencode_roundtrip(doc)
+    assert fresh.get_xml_fragment("x").get(0).get_attribute("lang") == "en"
+    assert fresh.get_xml_fragment("x").get(0).get(0).to_string() == "hi"
+
+
+def test_embed_blob():
+    doc = Doc()
+    apply_update(doc, BLOB_EMBED)
+    assert doc.get_text("t").to_delta() == [{"insert": {"a": 1}}]
+    assert _reencode_roundtrip(doc).get_text("t").to_delta() == [{"insert": {"a": 1}}]
+
+
+def test_plane_serves_golden_blobs_wire_compatibly():
+    """The TPU plane's serve path must emit bytes a spec-conforming peer
+    accepts, for every rich-content vector: blob -> plane (lower,
+    device flush, serve-log encode) -> fresh CPU doc == direct apply.
+    This ties the hand-authored wire literals to the serving rewrite
+    (tpu/serving.py builds items from op logs, not from a Y.Doc)."""
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+    from hocuspocus_tpu.tpu.serving import PlaneServing
+
+    cases = [
+        (BLOB_SIMPLE_INSERT, lambda d: d.get_text("t").to_string() == "hi"),
+        (BLOB_CONCURRENT, lambda d: d.get_text("t").to_string() == "aXb"),
+        (
+            BLOB_CONTENT_DELETED,
+            lambda d: d.get_text("t").to_string() == "ad",
+        ),
+        (BLOB_FORMAT, lambda d: d.get_text("t").to_delta()
+            == [{"insert": "x", "attributes": {"bold": True}}]),
+        (BLOB_MAP_LWW, lambda d: d.get_map("m").get("k") == "two"),
+        (BLOB_ARRAY, lambda d: d.get_array("a").to_json() == [True, 1, "x"]),
+        (
+            BLOB_XML_TREE,
+            lambda d: d.get_xml_fragment("x").get(0).get_attribute("lang") == "en"
+            and d.get_xml_fragment("x").get(0).get(0).to_string() == "hi",
+        ),
+        (BLOB_EMBED, lambda d: d.get_text("t").to_delta() == [{"insert": {"a": 1}}]),
+    ]
+    for i, (blob, check) in enumerate(cases):
+        plane = MergePlane(num_docs=8, capacity=256)
+        serving = PlaneServing(plane)
+        name = f"golden-{i}"
+        plane.register(name)
+        queued = plane.enqueue_update(name, blob)
+        assert plane.is_supported(name), f"case {i} retired from the plane"
+        assert queued > 0, f"case {i} lowered to zero ops"
+        plane.flush()
+        serving.refresh()
+        cpu = Doc()
+        apply_update(cpu, blob)
+        served = serving.encode_state_as_update(name, cpu, None)
+        assert served is not None, f"case {i} fell back to CPU serving"
+        peer = Doc()
+        apply_update(peer, served)
+        assert check(peer), f"case {i} served bytes diverged from the blob"
