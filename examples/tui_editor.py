@@ -1,0 +1,121 @@
+"""Interactive playground: a curses collaborative text editor.
+
+The reference ships a Next.js + Tiptap frontend playground
+(`playground/frontend`); this image has no node/npm and zero egress,
+so the interactive-editor equivalent is a terminal UI speaking the
+same wire protocol through HocuspocusProvider. Run the server first
+(examples/default.py or `python -m hocuspocus_tpu.cli --port 8000`),
+then open this editor in two terminals and type — keystrokes ride the
+CRDT, remote edits appear live, and presence (awareness) shows who
+else is in the document.
+
+    python examples/tui_editor.py [ws://127.0.0.1:8000] [doc-name]
+
+Keys: printable characters insert at the cursor; arrows move;
+backspace deletes; Ctrl-Q quits.
+"""
+
+import asyncio
+import curses
+import os
+import sys
+
+
+async def editor(stdscr, url: str, doc_name: str) -> None:
+    from hocuspocus_tpu.provider import HocuspocusProvider
+
+    curses.curs_set(1)
+    stdscr.nodelay(True)
+    stdscr.timeout(0)
+
+    provider = HocuspocusProvider(name=doc_name, url=url)
+    text = provider.document.get_text("content")
+    user = f"tui-{os.getpid()}"
+    cursor = 0
+    status = "connecting..."
+
+    try:
+        while not provider.synced:
+            height, width = stdscr.getmaxyx()
+            stdscr.erase()
+            stdscr.addnstr(0, 0, f"[{doc_name}] {status} (Ctrl-Q quits)", width - 1,
+                           curses.A_REVERSE)
+            stdscr.refresh()
+            if stdscr.getch() == 17:  # Ctrl-Q while connecting
+                return
+            await asyncio.sleep(0.05)
+        provider.set_awareness_field("user", {"name": user})
+        status = f"synced as {user} — Ctrl-Q quits"
+
+        while True:
+            content = text.to_string()
+            cursor = max(0, min(cursor, len(content)))
+
+            # presence line from awareness states
+            peers = []
+            for client_id, state in provider.awareness.get_states().items():
+                peer = (state or {}).get("user")
+                name = peer.get("name") if isinstance(peer, dict) else None
+                if name and name != user:
+                    peers.append(name)
+            presence = f"also here: {', '.join(sorted(peers))}" if peers else "alone"
+
+            height, width = stdscr.getmaxyx()
+            stdscr.erase()
+            stdscr.addnstr(0, 0, f"[{doc_name}] {status} | {presence}", width - 1,
+                           curses.A_REVERSE)
+            # wrap content into the window body
+            body_rows = height - 2
+            cols = max(1, width - 1)
+            lines = content.split("\n")
+            row = 1
+            cy, cx = 1, 0
+            seen = 0
+            for line in lines:
+                chunks = [line[i : i + cols] for i in range(0, len(line), cols)] or [""]
+                for chunk in chunks:
+                    if row <= body_rows:
+                        stdscr.addnstr(row, 0, chunk, width - 1)
+                        if seen <= cursor <= seen + len(chunk):
+                            cy, cx = row, cursor - seen
+                        seen += len(chunk)
+                        row += 1
+                seen += 1  # the newline itself
+            stdscr.move(min(cy, height - 1), min(cx, width - 1))
+            stdscr.refresh()
+
+            # drain pending keys, then yield to the event loop so the
+            # websocket keeps pumping
+            while True:
+                key = stdscr.getch()
+                if key == -1:
+                    break
+                if key == 17:  # Ctrl-Q
+                    return
+                if key in (curses.KEY_BACKSPACE, 127, 8):
+                    if cursor > 0:
+                        text.delete(cursor - 1, 1)
+                        cursor -= 1
+                elif key == curses.KEY_LEFT:
+                    cursor = max(0, cursor - 1)
+                elif key == curses.KEY_RIGHT:
+                    cursor = min(len(text.to_string()), cursor + 1)
+                elif key in (curses.KEY_ENTER, 10, 13):
+                    text.insert(cursor, "\n")
+                    cursor += 1
+                elif 32 <= key < 127:
+                    text.insert(cursor, chr(key))
+                    cursor += 1
+            await asyncio.sleep(0.03)
+    finally:
+        provider.destroy()
+
+
+def main() -> None:
+    url = sys.argv[1] if len(sys.argv) > 1 else "ws://127.0.0.1:8000"
+    doc_name = sys.argv[2] if len(sys.argv) > 2 else "playground"
+    curses.wrapper(lambda stdscr: asyncio.run(editor(stdscr, url, doc_name)))
+
+
+if __name__ == "__main__":
+    main()
