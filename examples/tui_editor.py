@@ -78,8 +78,11 @@ async def editor(stdscr, url: str, doc_name: str) -> None:
                         stdscr.addnstr(row, 0, chunk, width - 1)
                         if seen <= cursor <= seen + len(chunk):
                             cy, cx = row, cursor - seen
-                        seen += len(chunk)
-                        row += 1
+                    # offset accounting must cover off-screen chunks too,
+                    # or the cursor mapping goes stale once the doc grows
+                    # past the window
+                    seen += len(chunk)
+                    row += 1
                 seen += 1  # the newline itself
             stdscr.move(min(cy, height - 1), min(cx, width - 1))
             stdscr.refresh()

@@ -88,6 +88,35 @@ class Metrics(Extension):
             "Open connections (websocket + direct)",
             fn=lambda: instance.get_connections_count(),
         )
+        # TPU merge plane health (degradations, serve traffic): surface
+        # every plane counter so a 100k-doc deployment can alert on docs
+        # silently falling off the device path. The key set is complete
+        # by construction: MergePlane pre-declares every counter in
+        # __init__ and retire_doc uses strict key access.
+        for extension in getattr(instance.configuration, "extensions", []):
+            plane = getattr(extension, "plane", None)
+            counters = getattr(plane, "counters", None)
+            if not isinstance(counters, dict):
+                continue
+            for key in counters:
+                # keys like "plane_broadcasts" already carry the prefix
+                metric = f"hocuspocus_tpu_plane_{key.removeprefix('plane_')}"
+                self.registry.gauge(
+                    metric,
+                    f"TPU merge plane counter: {key}",
+                    fn=(lambda c=counters, k=key: c[k]),
+                )
+            self.registry.gauge(
+                "hocuspocus_tpu_plane_arena_rows_in_use",
+                "Arena rows (sequences) currently allocated on the plane",
+                fn=(lambda p=plane: p.num_docs - len(p.free)),
+            )
+            self.registry.gauge(
+                "hocuspocus_tpu_plane_ops_integrated",
+                "Ops integrated by the device since start",
+                fn=(lambda p=plane: p.total_integrated),
+            )
+            break  # one plane per server
 
     async def connected(self, data: Payload) -> None:
         self.connects.inc()

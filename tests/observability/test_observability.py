@@ -160,3 +160,40 @@ async def test_tracing_captures_message_spans_end_to_end():
 
 def _assert_positive(value: float) -> None:
     assert value > 0
+
+
+async def test_metrics_exposes_tpu_plane_counters():
+    """A serve-mode plane's health counters surface on /metrics."""
+    import aiohttp
+
+    from hocuspocus_tpu.observability import Metrics
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+    from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+    ext = TpuMergeExtension(num_docs=8, capacity=512, flush_interval_ms=1, serve=True)
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics, ext])
+    provider = new_provider(server, name="metered")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "counted")
+
+        def broadcasted():
+            assert ext.plane.counters["plane_broadcasts"] >= 1
+
+        await retryable_assertion(broadcasted)
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/metrics") as response:
+                body = await response.text()
+        lines = body.splitlines()
+        assert any(
+            line.startswith("hocuspocus_tpu_plane_broadcasts ") for line in lines
+        )
+        assert "hocuspocus_tpu_plane_docs_retired_unsupported 0" in lines
+        assert "hocuspocus_tpu_plane_arena_rows_in_use 1" in lines
+        assert any(
+            line.startswith("hocuspocus_tpu_plane_ops_integrated ") for line in lines
+        )
+    finally:
+        provider.destroy()
+        await server.destroy()
