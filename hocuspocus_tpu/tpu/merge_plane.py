@@ -67,13 +67,44 @@ class MergePlane:
 
     (The parameter keeps its historical name; for plain text docs
     sequences == documents. Tree docs consume one row per sequence.)
+
+    Pass a `jax.sharding.Mesh` (axes "doc" × "unit", see
+    tpu/sharding.py) to back the arenas with multi-chip sharded state:
+    the sequence axis is data-parallel over the mesh's doc axis (ICI
+    collectives only for the global op count), the arena axis optionally
+    sequence-parallel over the unit axis. Host-side logic (queues,
+    serve logs, health readbacks) is identical either way.
     """
 
-    def __init__(self, num_docs: int = 256, capacity: int = 4096, max_slots_per_flush: int = 16) -> None:
+    def __init__(
+        self,
+        num_docs: int = 256,
+        capacity: int = 4096,
+        max_slots_per_flush: int = 16,
+        mesh=None,
+    ) -> None:
         self.num_docs = num_docs
         self.capacity = capacity
         self.max_slots_per_flush = max_slots_per_flush
-        self.state: DocState = make_empty_state(num_docs, capacity)
+        self.mesh = mesh
+        self._sharded_step = None
+        self._op_shardings = None
+        if mesh is not None:
+            from .sharding import make_sharded_state, make_sharded_step, ops_sharding
+
+            doc_axis = mesh.shape["doc"]
+            unit_axis = mesh.shape["unit"]
+            if num_docs % doc_axis or capacity % unit_axis:
+                raise ValueError(
+                    f"num_docs ({num_docs}) must be a multiple of the mesh doc "
+                    f"axis ({doc_axis}) and capacity ({capacity}) a multiple of "
+                    f"the unit axis ({unit_axis})"
+                )
+            self.state = make_sharded_state(mesh, num_docs, capacity)
+            self._sharded_step = make_sharded_step(mesh)
+            self._op_shardings = ops_sharding(mesh)
+        else:
+            self.state: DocState = make_empty_state(num_docs, capacity)
         self.docs: dict[str, PlaneDoc] = {}
         self.free: list[int] = list(range(num_docs - 1, -1, -1))
         self.slot_owner: dict[int, str] = {}  # slot -> doc name
@@ -248,13 +279,14 @@ class MergePlane:
             # paths data-depend the count on the output state via
             # lax.optimization_barrier (buffer *readiness* of aliased
             # Pallas outputs is not trustworthy — see bench.py sync())
+            step = self._sharded_step or integrate_op_slots_fast
             if tracer.enabled:
                 with tracer.device_span("merge_plane.integrate", slots=k) as span:
-                    self.state, count = integrate_op_slots_fast(self.state, ops)
+                    self.state, count = step(self.state, ops)
                     count = int(count)
                     span.set("integrated", count)
             else:
-                self.state, count = integrate_op_slots_fast(self.state, ops)
+                self.state, count = step(self.state, ops)
                 count = int(count)
             total += count
         self.total_integrated += total
@@ -310,18 +342,23 @@ class MergePlane:
             left_clock[ri, ci] = vals[5]
             right_client[ri, ci] = np.asarray(vals[6], np.uint32)
             right_clock[ri, ci] = vals[7]
+        fields = (kind, client, clock, run_len, left_client, left_clock,
+                  right_client, right_clock)
+        if self._op_shardings is not None:
+            # upload straight to the mesh layout — routing through
+            # jnp.asarray would commit to the default device first and
+            # pay a second device-to-device reshard per field per flush
+            import jax
+
+            return OpBatch(
+                *(
+                    jax.device_put(field, sharding)
+                    for field, sharding in zip(fields, self._op_shardings)
+                )
+            )
         import jax.numpy as jnp
 
-        return OpBatch(
-            kind=jnp.asarray(kind),
-            client=jnp.asarray(client),
-            clock=jnp.asarray(clock),
-            run_len=jnp.asarray(run_len),
-            left_client=jnp.asarray(left_client),
-            left_clock=jnp.asarray(left_clock),
-            right_client=jnp.asarray(right_client),
-            right_clock=jnp.asarray(right_clock),
-        )
+        return OpBatch(*(jnp.asarray(field) for field in fields))
 
     # -- extraction --------------------------------------------------------
 
@@ -446,8 +483,14 @@ class TpuMergeExtension(Extension):
         flush_interval_ms: float = 5.0,
         plane: Optional[MergePlane] = None,
         serve: bool = False,
+        mesh=None,
     ) -> None:
-        self.plane = plane or MergePlane(num_docs=num_docs, capacity=capacity)
+        if plane is not None and mesh is not None:
+            raise ValueError(
+                "pass mesh= to the MergePlane you construct, not alongside plane= "
+                "(an explicit plane keeps its own device layout)"
+            )
+        self.plane = plane or MergePlane(num_docs=num_docs, capacity=capacity, mesh=mesh)
         self.flush_interval_ms = flush_interval_ms
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self.serve = serve
