@@ -1,0 +1,214 @@
+"""Fuzz the sequence-granular plane against the CPU engine.
+
+Random mixed-content edit streams (plain/rich text, maps, arrays, XML
+trees, nested types) are applied as wire updates to BOTH a CPU doc and
+a MergePlane; after every flush the plane must (a) stay healthy with
+zero unsupported retires, and (b) serve SyncStep2 bytes that rebuild a
+doc equal to the CPU doc (json/delta comparison). This hammers the new
+routing paths (wire parents, origin-id lookup, map successor chains,
+delete splitting across sequences) far beyond the hand-written cases.
+"""
+
+import numpy as np
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.tpu.merge_plane import MergePlane
+from hocuspocus_tpu.tpu.serving import PlaneServing
+
+WORDS = ["alpha", "béta", "γ", "𝕕elta", "e", "zz "]
+
+
+def _pair_align(text, pos: int) -> int:
+    """Snap a UTF-16 position out of the middle of a surrogate pair.
+
+    Real editors never emit mid-pair positions; a boundary inside a
+    pair triggers the yjs ContentString.splice U+FFFD replacement on
+    the editing doc, which wire-replaying peers (including the
+    reference's own remote yjs docs) do NOT reproduce — see
+    test_surrogate_split_wart_matches_reference_semantics."""
+    # build the COUNTABLE unit stream (embeds occupy one indexable unit
+    # but are invisible in to_string(), so to_string()-based alignment
+    # reads the wrong unit once embeds exist)
+    units: list[int] = []
+    for op in text.to_delta():
+        ins = op.get("insert")
+        if isinstance(ins, str):
+            data = ins.encode("utf-16-le")
+            units.extend(
+                int.from_bytes(data[i : i + 2], "little")
+                for i in range(0, len(data), 2)
+            )
+        else:
+            units.append(-1)  # embed: one countable, non-surrogate unit
+    if 0 < pos < len(units):
+        if 0xD800 <= units[pos - 1] <= 0xDBFF:  # boundary splits a pair
+            return pos + 1
+    return pos
+
+
+def _random_edit(rng, doc: Doc, step: int) -> None:
+    kind = rng.integers(0, 8)
+    if kind == 0:  # plain text insert
+        text = doc.get_text("t")
+        pos = _pair_align(text, int(rng.integers(0, len(text) + 1)))
+        text.insert(pos, WORDS[rng.integers(0, len(WORDS))])
+    elif kind == 1:  # text delete
+        text = doc.get_text("t")
+        if len(text) > 0:
+            pos = _pair_align(text, int(rng.integers(0, len(text))))
+            if pos < len(text):
+                end = _pair_align(
+                    text, min(pos + int(rng.integers(1, 4)), len(text))
+                )
+                if end > pos:
+                    text.delete(pos, end - pos)
+    elif kind == 2:  # rich format
+        text = doc.get_text("t")
+        if len(text) > 1:
+            pos = _pair_align(text, int(rng.integers(0, len(text) - 1)))
+            end = _pair_align(
+                text, min(pos + int(rng.integers(1, 5)), len(text))
+            )
+            if end > pos:
+                attr = ["bold", "em"][rng.integers(0, 2)]
+                text.format(pos, end - pos, {attr: bool(rng.integers(0, 2))})
+    elif kind == 3:  # map set (LWW churn on few keys)
+        doc.get_map("m").set(f"k{rng.integers(0, 3)}", int(rng.integers(0, 100)))
+    elif kind == 4:  # map delete
+        key = f"k{rng.integers(0, 3)}"
+        if doc.get_map("m").get(key) is not None:
+            doc.get_map("m").delete(key)
+    elif kind == 5:  # array ops
+        arr = doc.get_array("a")
+        if rng.integers(0, 3) == 0 and len(arr) > 0:
+            pos = int(rng.integers(0, len(arr)))
+            arr.delete(pos, min(int(rng.integers(1, 3)), len(arr) - pos))
+        else:
+            pos = int(rng.integers(0, len(arr) + 1))
+            arr.insert(pos, [int(step), f"s{step}"])
+    elif kind == 6:  # xml tree growth
+        from hocuspocus_tpu.crdt import YXmlElement, YXmlText
+
+        frag = doc.get_xml_fragment("x")
+        if rng.integers(0, 2) == 0 or len(frag) == 0:
+            element = YXmlElement("p")
+            frag.insert(int(rng.integers(0, len(frag) + 1)), [element])
+        else:
+            element = frag.get(int(rng.integers(0, len(frag))))
+            if rng.integers(0, 2) == 0:
+                element.set_attribute(f"a{rng.integers(0, 2)}", f"v{step}")
+            else:
+                if len(element) == 0:
+                    element.insert(0, [YXmlText(f"w{step}")])
+                else:
+                    child = element.get(0)
+                    child.insert(int(rng.integers(0, len(child) + 1)), "y")
+    else:  # embed
+        text = doc.get_text("t")
+        pos = _pair_align(text, int(rng.integers(0, len(text) + 1)))
+        text.insert_embed(pos, {"n": int(step)})
+
+
+def _doc_fingerprint(doc: Doc):
+    def xml_shape(frag):
+        out = []
+        for i in range(len(frag)):
+            node = frag.get(i)
+            if hasattr(node, "node_name"):
+                out.append((node.node_name, node.get_attributes(), xml_shape(node)))
+            else:
+                out.append(node.to_string())
+        return out
+
+    return (
+        doc.get_text("t").to_delta(),
+        dict(doc.get_map("m").to_json()),
+        doc.get_array("a").to_json(),
+        xml_shape(doc.get_xml_fragment("x")),
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_plane_fuzz_mixed_content_serves_cpu_equal(seed):
+    rng = np.random.default_rng(seed)
+    cpu = Doc()
+    updates = []
+    cpu.on("update", lambda update, *rest: updates.append(update))
+
+    plane = MergePlane(num_docs=64, capacity=2048)
+    serving = PlaneServing(plane)
+    plane.register("fuzz")
+
+    for step in range(120):
+        _random_edit(rng, cpu, step)
+        while updates:
+            plane.enqueue_update("fuzz", updates.pop(0))
+        if step % 10 == 9:
+            plane.flush()
+            serving.refresh()
+            assert plane.is_supported("fuzz"), (
+                seed,
+                step,
+                {k: v for k, v in plane.counters.items() if v},
+            )
+            served = serving.encode_state_as_update("fuzz", cpu, None)
+            assert served is not None, (seed, step)
+            rebuilt = Doc()
+            apply_update(rebuilt, served)
+            assert _doc_fingerprint(rebuilt) == _doc_fingerprint(cpu), (seed, step)
+
+    # final: a fresh peer applying the CPU snapshot equals one applying
+    # the served bytes (cross-validates our own encoder too)
+    plane.flush()
+    serving.refresh()
+    served = serving.encode_state_as_update("fuzz", cpu, None)
+    direct = Doc()
+    apply_update(direct, encode_state_as_update(cpu))
+    via_plane = Doc()
+    apply_update(via_plane, served)
+    assert _doc_fingerprint(via_plane) == _doc_fingerprint(direct)
+
+
+def test_surrogate_split_wart_matches_reference_semantics():
+    """Documents an inherited yjs wart, and pins which side the plane is
+    on: when an edit boundary lands INSIDE a surrogate pair and leaves
+    no wire anchor at the split point, the EDITING doc replaces both
+    halves with U+FFFD (yjs ContentString.splice, faithfully mirrored
+    by our CPU engine) while every wire-replaying peer — a remote yjs
+    doc in the reference deployment, or our plane — keeps the intact
+    pair. This is a CPU-vs-CPU divergence in the reference ecosystem
+    itself (editors avoid mid-pair positions); the plane serves what a
+    remote peer would compute."""
+    from hocuspocus_tpu.crdt import Doc, apply_update
+
+    editor = Doc()
+    updates = []
+    editor.on("update", lambda update, *rest: updates.append(update))
+    text = editor.get_text("t")
+    text.insert(0, "x𝕕")
+    text.format(0, 2, {})  # boundary at UTF-16 index 2: inside the pair
+
+    # the editing doc took the U+FFFD replacement...
+    assert "�" in editor.get_text("t").to_string()
+
+    # ...a wire-replaying CPU peer did not (reference remote semantics)
+    peer = Doc()
+    for update in updates:
+        apply_update(peer, update)
+    assert peer.get_text("t").to_string() == "x𝕕"
+
+    # the plane sides with the remote peer: healthy, intact pair,
+    # and its served bytes rebuild the peer's content
+    plane = MergePlane(num_docs=4, capacity=256)
+    serving = PlaneServing(plane)
+    plane.register("d")
+    for update in updates:
+        plane.enqueue_update("d", update)
+    plane.flush()
+    serving.refresh()
+    assert plane.text("d") == "x𝕕"
+    served = serving.encode_state_as_update("d", peer, None)
+    rebuilt = Doc()
+    apply_update(rebuilt, served)
+    assert rebuilt.get_text("t").to_string() == "x𝕕"
