@@ -180,8 +180,6 @@ def test_surrogate_split_wart_matches_reference_semantics():
     pair. This is a CPU-vs-CPU divergence in the reference ecosystem
     itself (editors avoid mid-pair positions); the plane serves what a
     remote peer would compute."""
-    from hocuspocus_tpu.crdt import Doc, apply_update
-
     editor = Doc()
     updates = []
     editor.on("update", lambda update, *rest: updates.append(update))
