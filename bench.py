@@ -425,6 +425,9 @@ def _measure_server_p99() -> "tuple[float, dict]":
         )
         server = Server(Configuration(quiet=True, extensions=[ext]))
         await server.listen(port=0)
+        # compile every flush batch shape up front so first edits pay
+        # serving latency, not XLA compile time
+        ext.plane.warmup_compiles()
         url = server.web_socket_url
         writers, readers = [], []
         try:

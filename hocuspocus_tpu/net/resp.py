@@ -155,9 +155,19 @@ class RedisClient(RedisCommands):
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self._lock = asyncio.Lock()
+        self._closed = False
 
     async def connect(self) -> "RedisClient":
-        self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
+        if self._closed:
+            raise ConnectionError("redis client closed")
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        if self._closed:
+            # close() landed while the socket was opening: honor it —
+            # installing the fresh pair would leak a connection nobody
+            # ever closes
+            writer.close()
+            raise ConnectionError("redis client closed")
+        self.reader, self.writer = reader, writer
         return self
 
     @property
@@ -169,6 +179,11 @@ class RedisClient(RedisCommands):
         # concurrent execute (or a close() racing the connected check)
         # must never see a half-replaced reader/writer pair
         async with self._lock:
+            if self._closed:
+                # close() is terminal: a late command (e.g. a store
+                # racing teardown) must fail, not silently reopen a
+                # connection nobody will ever close
+                raise ConnectionError("redis client closed")
             if not self.connected:
                 await self.connect()
             self.writer.write(encode_command(*args))
@@ -181,6 +196,8 @@ class RedisClient(RedisCommands):
         Error replies come back as RespError values, not raises, so the
         stream stays in sync."""
         async with self._lock:
+            if self._closed:
+                raise ConnectionError("redis client closed")
             if not self.connected:
                 await self.connect()
             for command in commands:
@@ -195,6 +212,7 @@ class RedisClient(RedisCommands):
             return replies
 
     def close(self) -> None:
+        self._closed = True
         if self.writer is not None:
             self.writer.close()
             self.writer = None
@@ -333,17 +351,26 @@ class RedisSubscriber:
         self._subscribed: dict[bytes, asyncio.Future] = {}
         self.channels: set[bytes] = set()
         self._conn_lock = asyncio.Lock()
+        self._closed = False
 
     async def connect(self) -> "RedisSubscriber":
         # concurrent subscribes during startup must not each open a
         # connection: two _read_loops on one stream raise "readuntil()
         # called while another coroutine is already waiting"
         async with self._conn_lock:
+            if self._closed:
+                # close() is terminal: a late unsubscribe racing
+                # teardown must not reopen a connection nobody closes
+                raise ConnectionError("redis subscriber closed")
             if self.connected:
                 return self
             if self._reader_task is not None:
                 self._reader_task.cancel()
-            self.reader, self.writer = await asyncio.open_connection(self.host, self.port)
+            reader, writer = await asyncio.open_connection(self.host, self.port)
+            if self._closed:  # close() landed while the socket opened
+                writer.close()
+                raise ConnectionError("redis subscriber closed")
+            self.reader, self.writer = reader, writer
             self._reader_task = asyncio.ensure_future(self._read_loop())
             # recover subscriptions that died with the previous
             # connection — without this, a Redis restart silently stops
@@ -412,6 +439,7 @@ class RedisSubscriber:
             await self._send("UNSUBSCRIBE", channel)
 
     def close(self) -> None:
+        self._closed = True
         if self._reader_task is not None:
             self._reader_task.cancel()
             self._reader_task = None

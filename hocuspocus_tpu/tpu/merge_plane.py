@@ -18,6 +18,7 @@ go straight to the doc's serve log.
 from __future__ import annotations
 
 import asyncio
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -87,6 +88,18 @@ class MergePlane:
         self.capacity = capacity
         self.max_slots_per_flush = max_slots_per_flush
         self.mesh = mesh
+        # serializes flush + device readbacks when the extension runs
+        # flushes off the event loop (direct synchronous use — tests,
+        # benches — never contends)
+        self.flush_lock = asyncio.Lock()
+        # thread-level companion: flush() donates the old state buffers
+        # to the kernel, so a reader interleaving with an executor-side
+        # flush can observe garbage (and must never RETIRE a doc based
+        # on it). flush() holds this for the duration of the device
+        # step; synchronous readers (text, health checks, the sync
+        # serve adapter) acquire it. Reentrant so a sync serve can hold
+        # it across its own flush()+reads sequence.
+        self._step_lock = threading.RLock()
         self._sharded_step = None
         self._op_shardings = None
         if mesh is not None:
@@ -253,21 +266,81 @@ class MergePlane:
         return count
 
     def pending_ops(self) -> int:
-        return sum(len(q) for q in self.queues.values())
+        # list() snapshot: the event-loop thread can insert new queues
+        # (doc load / new tree sequence) while an executor-side flush
+        # calls this — dict.values() iteration would raise
+        return sum(len(q) for q in list(self.queues.values()))
 
     # -- device step -------------------------------------------------------
 
-    def flush(self) -> int:
-        """Integrate queued ops in (K, D) batches. Returns ops integrated."""
+    def flush(self, max_batches: Optional[int] = None) -> int:
+        """Integrate queued ops in (K, D) batches. Returns ops integrated.
+
+        max_batches bounds the kernel calls in this cycle (one batch
+        already covers up to max_slots_per_flush ops for EVERY queue):
+        the serving flush loop uses 1 so broadcasts interleave with
+        integration instead of waiting for a full drain; sync serves
+        drain fully (covers() needs everything integrated)."""
+        with self._step_lock:
+            return self._flush_locked(max_batches)
+
+    def warmup_compiles(self, k: Optional[int] = None) -> None:
+        """Pre-compile the integrate step at flush batch shapes.
+
+        The first flush at each K otherwise pays the XLA/Mosaic compile
+        (seconds on CPU, tens of seconds cold on TPU) in the serving
+        path — with the flush off the event loop that surfaced as
+        broadcasts delayed until the compile finished. A no-op batch
+        (every slot KIND_NOOP) exercises the identical jitted program
+        without touching document state. Pass k to compile one shape
+        (callers can interleave lock acquisition per shape); default
+        compiles all of them.
+        """
+        from .pallas_kernels import integrate_op_slots_fast
+
+        step = self._sharded_step or integrate_op_slots_fast
+        shapes = [k] if k is not None else self.warmup_shapes()
+        with self._step_lock:
+            for shape in shapes:
+                ops = self._empty_batch(shape)
+                self.state, count = step(self.state, ops)
+                int(count)  # completion barrier (data-dependent)
+
+    def warmup_shapes(self) -> list[int]:
+        shapes = []
+        k = 1
+        while True:
+            shapes.append(k)
+            if k >= self.max_slots_per_flush:
+                return shapes
+            k *= 2
+
+    def _empty_batch(self, k: int) -> OpBatch:
+        d = self.num_docs
+        fields = (
+            np.zeros((k, d), np.int32),
+            np.zeros((k, d), np.uint32),
+            np.zeros((k, d), np.int32),
+            np.zeros((k, d), np.int32),
+            np.full((k, d), NONE_CLIENT, np.uint32),
+            np.zeros((k, d), np.int32),
+            np.full((k, d), NONE_CLIENT, np.uint32),
+            np.zeros((k, d), np.int32),
+        )
+        return self._upload_batch(fields)
+
+    def _flush_locked(self, max_batches: Optional[int] = None) -> int:
         from ..observability.tracing import get_tracer
 
         from .pallas_kernels import integrate_op_slots_fast
 
         tracer = get_tracer()
         total = 0
-        while self.pending_ops() > 0:
+        batches = 0
+        while self.pending_ops() > 0 and (max_batches is None or batches < max_batches):
+            batches += 1
             needed = min(
-                max(len(q) for q in self.queues.values()),
+                max(len(q) for q in list(self.queues.values())),
                 self.max_slots_per_flush,
             )
             # round K up to a power of two to bound jit recompilations
@@ -301,7 +374,10 @@ class MergePlane:
         rows: list[int] = []
         cols: list[int] = []
         vals: tuple[list[int], ...] = ([], [], [], [], [], [], [], [])
-        for slot, queue in self.queues.items():
+        # snapshot (atomic under the GIL): enqueue on the loop thread may
+        # add queues while this runs in the executor; new queues simply
+        # wait for the next cycle
+        for slot, queue in list(self.queues.items()):
             if not queue:
                 continue
             take = queue[:k]
@@ -344,6 +420,9 @@ class MergePlane:
             right_clock[ri, ci] = vals[7]
         fields = (kind, client, clock, run_len, left_client, left_clock,
                   right_client, right_clock)
+        return self._upload_batch(fields)
+
+    def _upload_batch(self, fields: tuple) -> OpBatch:
         if self._op_shardings is not None:
             # upload straight to the mesh layout — routing through
             # jnp.asarray would commit to the default device first and
@@ -407,21 +486,22 @@ class MergePlane:
             return None  # tree-shaped: byte-served, not materialized
         if not roots:
             return ""
-        if not self.check_doc_health(
-            name, doc, np.asarray(self.state.length), np.asarray(self.state.overflow)
-        ):
-            return None
-        slot = doc.seqs[roots[0]]
-        log = self.unit_logs[slot]
-        live = np.asarray(extract_live_mask(self.state))[slot]
-        occupied = np.nonzero(live)[0]
-        ranks_all = np.asarray(self.state.rank)[slot][occupied]
-        order = np.argsort(ranks_all)
-        sel = occupied[order]
-        ranks = ranks_all[order]
-        clients = np.asarray(self.state.id_client)[slot][sel]
-        clocks = np.asarray(self.state.id_clock)[slot][sel]
-        entries = [log[i] for i in sel]
+        with self._step_lock:  # never read state mid-flush (donation)
+            if not self.check_doc_health(
+                name, doc, np.asarray(self.state.length), np.asarray(self.state.overflow)
+            ):
+                return None
+            slot = doc.seqs[roots[0]]
+            log = self.unit_logs[slot]
+            live = np.asarray(extract_live_mask(self.state))[slot]
+            occupied = np.nonzero(live)[0]
+            ranks_all = np.asarray(self.state.rank)[slot][occupied]
+            order = np.argsort(ranks_all)
+            sel = occupied[order]
+            ranks = ranks_all[order]
+            clients = np.asarray(self.state.id_client)[slot][sel]
+            clocks = np.asarray(self.state.id_clock)[slot][sel]
+            entries = [log[i] for i in sel]
         out: list[int] = []
         i = 0
         count = len(entries)
@@ -496,6 +576,10 @@ class TpuMergeExtension(Extension):
         self.serve = serve
         self.serving = None
         self._docs: dict[str, object] = {}  # name -> server Document being served
+        # strong refs to in-flight flush tasks: the event loop only
+        # weakly references tasks, and a GC'd flush task silently stops
+        # the serve pipeline (or strands the flush lock mid-acquire)
+        self._flush_tasks: set = set()
         if serve:
             from .serving import PlaneServing
 
@@ -503,6 +587,31 @@ class TpuMergeExtension(Extension):
             self.serving.flush_failure_handler = self._degrade_all_served
 
     # -- hooks ---------------------------------------------------------------
+
+    async def on_listen(self, data: Payload) -> None:
+        """Kick off compile warmup so the first live flush at each batch
+        shape doesn't pay XLA/Mosaic compile time in the serving path."""
+
+        async def warm() -> None:
+            loop = asyncio.get_event_loop()
+            # one lock acquisition per shape: early client syncs and
+            # unloads interleave between compiles instead of stalling
+            # for the whole warmup
+            for shape in self.plane.warmup_shapes():
+                try:
+                    async with self.plane.flush_lock:
+                        await loop.run_in_executor(
+                            None, lambda s=shape: self.plane.warmup_compiles(s)
+                        )
+                except Exception:
+                    from ..server import logger as _logger_mod
+
+                    _logger_mod.log_error("plane compile warmup failed (continuing)")
+                    return
+
+        task = asyncio.ensure_future(warm())
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_tasks.discard)
 
     async def after_load_document(self, data: Payload) -> None:
         from ..crdt import encode_state_as_update
@@ -535,12 +644,16 @@ class TpuMergeExtension(Extension):
             document.broadcast_source = None
         if self.serving is not None:
             self.serving.broadcast_cursor.pop(name, None)
-        self.plane.release(name)
+        # release mutates the queue/log registries a concurrent
+        # executor-side flush iterates — serialize with it
+        async with self.plane.flush_lock:
+            self.plane.release(name)
 
     async def on_destroy(self, data: Payload) -> None:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
-        self._flush()
+        # full drain: no timer will fire after teardown to pick up a tail
+        await self._flush_now(max_batches=None)
 
     # -- serving: update capture (called by Document._handle_update) ---------
 
@@ -592,14 +705,7 @@ class TpuMergeExtension(Extension):
             except Exception:
                 _logger_mod.log_error(f"CPU fallback failed for {document.name!r}")
 
-    def _flush(self) -> None:
-        try:
-            self.plane.flush()
-            if self.serve:
-                self.serving.refresh()
-        except Exception:
-            self._degrade_all_served()
-            return
+    def _broadcast_served(self) -> None:
         if not self.serve:
             return
         for name, document in list(self._docs.items()):
@@ -625,13 +731,44 @@ class TpuMergeExtension(Extension):
                 except Exception:
                     _logger_mod.log_error(f"CPU fallback failed for {name!r}")
 
+    async def _flush_now(self, max_batches: Optional[int] = 1) -> None:
+        """Flush+serve with the DEVICE step off the event loop.
+
+        plane.flush() host-syncs on the integrate step; running it
+        inline froze the loop for the duration of every device step
+        (measured 16x send-throughput loss on the CPU backend at config2
+        shape). The executor hop keeps websockets pumping while the
+        device integrates; the lock serializes against the batched
+        catch-up drain and unload-time registry mutation.
+
+        The default of ONE kernel batch per cycle makes broadcasts
+        interleave with integration (observers wait ~one batch time, not
+        a full backlog drain); the remainder reschedules. on_destroy
+        passes None for a full drain — no timer fires after teardown.
+        """
+        async with self.plane.flush_lock:
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: self.plane.flush(max_batches)
+                )
+                if self.serve:
+                    self.serving.refresh()
+            except Exception:
+                self._degrade_all_served()
+                return
+            self._broadcast_served()
+        if self.plane.pending_ops() > 0:
+            self._schedule_flush()
+
     def _schedule_flush(self) -> None:
         if self._flush_handle is not None:
             return
 
         def run() -> None:
             self._flush_handle = None
-            self._flush()
+            task = asyncio.ensure_future(self._flush_now())
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_tasks.discard)
 
         self._flush_handle = asyncio.get_event_loop().call_later(
             self.flush_interval_ms / 1000, run

@@ -87,6 +87,7 @@ class PlaneServing:
         # window are triaged by ONE state_vector_diff kernel call
         self._catchup_queue: list[tuple] = []  # (name, document, sv_bytes, future)
         self._catchup_scheduled = False
+        self._drain_tasks: set = set()
         # set by TpuMergeExtension: invoked when a device flush dies so
         # served docs degrade to the CPU path (captured ops were already
         # popped from the queues — they only survive via the full-state
@@ -208,27 +209,36 @@ class PlaneServing:
     def encode_state_as_update(
         self, name: str, document, sv_bytes: Optional[bytes] = None
     ) -> Optional[bytes]:
-        """SyncStep2 payload from device state; None = CPU fallback."""
+        """SyncStep2 payload from device state; None = CPU fallback.
+
+        Synchronous path (tests, benches, the non-batched sync adapter):
+        holds the plane's step lock across its own flush AND the state
+        reads, so an extension-scheduled executor flush can neither
+        donate the buffers mid-read nor interleave between the drain
+        and the encode. The server core uses the async batched path.
+        """
         plane = self.plane
-        if plane.pending_ops() > 0:
-            plane.flush()
-            self.refresh()
-        doc = self.doc_healthy(name)
-        if doc is None or not self.covers(name, document):
-            return None
-        # plane-integrated clocks ARE the local state vector (queue was
-        # just flushed), so the diff is computed before building Items —
-        # a nearly-current reconnect pays for its tail, not the full doc
-        local_sv = dict(doc.lowerer.known)
-        target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
-        sm: dict[int, int] = {}
-        for client, clock in target_sv.items():
-            if local_sv.get(client, 0) > clock:
-                sm[client] = clock
-        for client in local_sv:
-            if client not in target_sv:
-                sm[client] = 0
-        return self._encode_from_sm(doc, sm)
+        with plane._step_lock:  # reentrant: flush() re-acquires
+            if plane.pending_ops() > 0:
+                plane.flush()
+                self.refresh()
+            doc = self.doc_healthy(name)
+            if doc is None or not self.covers(name, document):
+                return None
+            # plane-integrated clocks ARE the local state vector (queue
+            # was just flushed), so the diff is computed before building
+            # Items — a nearly-current reconnect pays for its tail, not
+            # the full doc
+            local_sv = dict(doc.lowerer.known)
+            target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
+            sm: dict[int, int] = {}
+            for client, clock in target_sv.items():
+                if local_sv.get(client, 0) > clock:
+                    sm[client] = clock
+            for client in local_sv:
+                if client not in target_sv:
+                    sm[client] = 0
+            return self._encode_from_sm(doc, sm)
 
     # -- batched catch-up (the storm path) -----------------------------------
 
@@ -247,23 +257,39 @@ class PlaneServing:
         self._catchup_queue.append((name, document, sv_bytes, future))
         if not self._catchup_scheduled:
             self._catchup_scheduled = True
-            asyncio.get_event_loop().call_soon(self._drain_catchup)
+            # strong ref: a GC'd drain task would strand every waiter
+            task = asyncio.ensure_future(self._drain_catchup())
+            self._drain_tasks.add(task)
+            task.add_done_callback(self._drain_tasks.discard)
         return await future
 
-    def _drain_catchup(self) -> None:
-        import jax.numpy as jnp
-
-        from .kernels import state_vector_diff
-
+    async def _drain_catchup(self) -> None:
         self._catchup_scheduled = False
         batch, self._catchup_queue = self._catchup_queue, []
         if not batch:
             return
         plane = self.plane
+        # the whole drain — flush, refresh, triage, item encode — holds
+        # the flush lock: every step reads device state, and a
+        # concurrent executor-side flush donates the buffers it reads
+        async with plane.flush_lock:
+            await self._drain_catchup_locked(batch)
+
+    async def _drain_catchup_locked(self, batch: list) -> None:
+        import asyncio
+
+        import jax.numpy as jnp
+
+        from .kernels import state_vector_diff
+
+        plane = self.plane
         try:
             if plane.pending_ops() > 0:
                 try:
-                    plane.flush()
+                    # device step off the loop (see _flush_now)
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, plane.flush
+                    )
                 except Exception:
                     # the dead flush already consumed queued ops — the
                     # same fault TpuMergeExtension._flush handles by

@@ -217,7 +217,7 @@ async def test_device_fault_between_capture_and_flush_loses_nothing():
         real_flush = ext.plane.flush
         fired = {"n": 0}
 
-        def dying_flush():
+        def dying_flush(max_batches=None):
             fired["n"] += 1
             raise RuntimeError("simulated device fault mid-flush")
 
