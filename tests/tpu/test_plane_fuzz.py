@@ -233,9 +233,9 @@ def test_plane_fuzz_concurrent_editors_converge(seed):
     def cross_deliver():
         """Randomly flush pending updates between replicas + the plane."""
         # the plane sees BOTH clients' updates in arbitrary interleave
-        pending = [(u, "a") for u in out_a] + [(u, "b") for u in out_b]
+        pending = out_a + out_b
         rng.shuffle(pending)
-        for update, _src in pending:
+        for update in pending:
             plane.enqueue_update("conc", update)
         for update in out_a:
             apply_update(b, update)

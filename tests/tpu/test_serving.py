@@ -316,3 +316,64 @@ async def test_catchup_storm_batches_sync_triage_on_device():
         for p in seeders:
             p.destroy()
         await server.destroy()
+
+
+async def test_serve_mode_survives_doc_churn_under_load():
+    """Load/unload churn concurrent with edits and executor-side
+    flushes: the new off-loop flush pipeline must never crash a flush
+    on registry mutation (queues dict changing mid-iteration degrades
+    EVERY served doc) nor lose an edit. Stresses the flush-lock
+    serialization added with the executor move."""
+    ext = TpuMergeExtension(num_docs=32, capacity=512, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    stable_a = new_provider(server, name="stable")
+    stable_b = new_provider(server, name="stable")
+    try:
+        await wait_synced(stable_a, stable_b)
+        text = stable_a.document.get_text("body")
+        expect = []
+        for wave in range(6):
+            # churn: short-lived docs load, edit once, unload — while
+            # the stable doc keeps editing through the plane
+            churners = [
+                new_provider(server, name=f"churn-{wave}-{i}") for i in range(4)
+            ]
+            token = f"w{wave};"
+            expect.append(token)
+            text.insert(len(text.to_string()), token)
+            await wait_synced(*churners)
+            for i, p in enumerate(churners):
+                p.document.get_text("t").insert(0, f"c{wave}-{i}")
+            # edits must actually be in the pipeline before destroy, or
+            # the unload races nothing and the test goes vacuous
+            await retryable_assertion(
+                lambda: _assert(
+                    all(
+                        ext.plane.docs[f"churn-{wave}-{i}"].lowerer.known
+                        for i in range(4)
+                        if f"churn-{wave}-{i}" in ext.plane.docs
+                    )
+                    and sum(
+                        f"churn-{wave}-{i}" in ext.plane.docs for i in range(4)
+                    )
+                    == 4
+                )
+            )
+            for p in churners:
+                p.destroy()  # triggers unloads racing in-flight flushes
+
+        def converged():
+            assert stable_b.document.get_text("body").to_string() == "".join(expect)
+
+        await retryable_assertion(converged)
+        # the stable doc must still be plane-served: churn never
+        # triggered the degrade-all path
+        assert "stable" in ext._docs, {
+            k: v for k, v in ext.plane.counters.items() if v
+        }
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        assert ext.plane.counters["docs_retired_desync"] == 0
+    finally:
+        stable_a.destroy()
+        stable_b.destroy()
+        await server.destroy()
