@@ -441,3 +441,62 @@ def test_root_type_upgrade():
     b = Doc()
     apply_update(b, encode_state_as_update(a))
     assert b.get_text("t").to_string() == "hi"
+
+
+def test_subdocuments_round_trip():
+    """Subdocuments (ContentDoc): guid + opts survive the wire, the
+    parent tracks them in `subdocs`, and overwriting a subdoc entry
+    tombstones the old one. Reference parity: yjs subdocs pass through
+    the reference server as ordinary content
+    (`packages/server/src/MessageReceiver.ts` readUpdate)."""
+    from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+
+    a = Doc()
+    sub = Doc(guid="sub-123")
+    a.get_map("docs").set("child", sub)
+    assert [d.guid for d in a.subdocs] == ["sub-123"]
+
+    b = Doc()
+    apply_update(b, encode_state_as_update(a))
+    child = b.get_map("docs").get("child")
+    assert type(child).__name__ == "Doc" and child.guid == "sub-123"
+    assert [d.guid for d in b.subdocs] == ["sub-123"]
+
+    # replace the entry: LWW tombstoning applies to subdocs too
+    a.get_map("docs").set("child", Doc(guid="sub-456"))
+    apply_update(b, encode_state_as_update(a, None))
+    assert b.get_map("docs").get("child").guid == "sub-456"
+
+
+async def test_subdoc_containing_doc_served_via_cpu_path():
+    """A doc holding a subdocument flows through the live serve-mode
+    server: the plane retires it as unsupported (subdocs are host-only)
+    and the CPU path serves — no data loss, converged peers."""
+    from hocuspocus_tpu.crdt import Doc
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+    from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+    ext = TpuMergeExtension(num_docs=8, capacity=256, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="withsub")
+    b = new_provider(server, name="withsub")
+    try:
+        await wait_synced(a, b)
+        a.document.get_map("docs").set("child", Doc(guid="nested-doc"))
+        a.document.get_text("t").insert(0, "beside the subdoc")
+
+        def converged():
+            child = b.document.get_map("docs").get("child")
+            assert child is not None and child.guid == "nested-doc"
+            assert b.document.get_text("t").to_string() == "beside the subdoc"
+
+        await retryable_assertion(converged)
+        # late joiner syncs the subdoc through the CPU path
+        c = new_provider(server, name="withsub")
+        await wait_synced(c)
+        assert c.document.get_map("docs").get("child").guid == "nested-doc"
+        c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
