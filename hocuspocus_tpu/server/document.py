@@ -42,6 +42,9 @@ class Document(Doc):
         # broadcast_source claims updates for batched device broadcast
         self.sync_source = None
         self.broadcast_source = None
+        # same-tick awareness coalescing (see _handle_awareness_update)
+        self._pending_awareness: set[int] = set()
+        self._awareness_scheduled = False
         self.awareness.on("update", self._handle_awareness_update)
         self.on("update", self._handle_update)
 
@@ -118,8 +121,31 @@ class Document(Doc):
                 entry["clients"].add(client_id)
             for client_id in changes["removed"]:
                 entry["clients"].discard(client_id)
+        # coalesce bursts within one event-loop iteration: awareness is
+        # per-client LWW state, so N updates in a tick collapse into ONE
+        # frame carrying each changed client's CURRENT state — same
+        # latency (call_soon, no timer), 1/N the fan-out encodes+sends
+        # the reference pays (`packages/server/src/Document.ts:199-226`
+        # re-encodes and fans out per update)
+        self._pending_awareness.update(changed_clients)
+        if self._awareness_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._flush_awareness()  # no loop (direct/test use): immediate
+            return
+        self._awareness_scheduled = True
+        loop.call_soon(self._flush_awareness)
+
+    def _flush_awareness(self) -> None:
+        self._awareness_scheduled = False
+        changed = list(self._pending_awareness)
+        self._pending_awareness.clear()
+        if not changed:
+            return
         message = OutgoingMessage(self.name).create_awareness_update_message(
-            self.awareness, changed_clients
+            self.awareness, changed
         )
         data = message.to_bytes()
         for connection in self.get_connections():

@@ -146,3 +146,48 @@ async def test_awareness_propagates():
         provider_a.destroy()
         provider_b.destroy()
         await server.destroy()
+
+
+async def test_awareness_burst_coalesces_to_one_frame_per_tick():
+    """N awareness updates landing in one event-loop iteration fan out
+    as ONE frame per connection carrying every changed client's current
+    state (the reference re-encodes and sends per update)."""
+    from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+    server = await new_hocuspocus()
+    providers = [new_provider(server, name="aware-burst") for _ in range(5)]
+    observer = new_provider(server, name="aware-burst")
+    try:
+        await wait_synced(*providers, observer)
+        document = server.documents["aware-burst"]
+        sends = {"n": 0}
+        real_flush = document._flush_awareness
+
+        def counting_flush():
+            sends["n"] += 1
+            real_flush()
+
+        document._flush_awareness = counting_flush
+
+        # burst: each provider's awareness message arrives separately,
+        # but several get applied within the same loop iterations
+        for i, p in enumerate(providers):
+            p.set_awareness_field("user", {"name": f"u{i}"})
+
+        def all_seen():
+            states = observer.awareness.get_states()
+            names = {
+                (state or {}).get("user", {}).get("name")
+                for state in states.values()
+            }
+            assert {f"u{i}" for i in range(5)} <= names
+
+        await retryable_assertion(all_seen)
+        # coalescing bound: flushes can never exceed awareness events,
+        # and the frame count must stay small (one per tick, not per
+        # client-message retransmit)
+        assert 1 <= sends["n"] <= 10, sends
+    finally:
+        for p in providers + [observer]:
+            p.destroy()
+        await server.destroy()
