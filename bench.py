@@ -363,6 +363,8 @@ def run_bench() -> None:
 
     merges_per_sec = total_ops / elapsed
     p99_ms = float(np.percentile(np.array(latencies) * 1000, 99))
+    from hocuspocus_tpu.tpu.pallas_kernels import _pallas_broken_shapes, _pick_block
+
     result = {
         "metric": "crdt_update_merges_per_sec",
         "value": round(merges_per_sec, 1),
@@ -377,6 +379,9 @@ def run_bench() -> None:
             "p99_microbatch_ms": round(p99_ms, 2),
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
+            # kernel-path diagnosis: which integrate path actually ran
+            "pallas_block": _pick_block(num_docs, capacity),
+            "pallas_fallbacks": [list(s) for s in _pallas_broken_shapes],
         },
     }
     if server_p99_ms is not None:
