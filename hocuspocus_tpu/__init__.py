@@ -47,6 +47,10 @@ def __getattr__(name):
         from .observability import Metrics
 
         return Metrics
+    if name == "TpuMergeExtension":
+        from .tpu import TpuMergeExtension
+
+        return TpuMergeExtension
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -60,5 +64,6 @@ __all__ = [
     "HocuspocusProviderWebsocket",
     "Doc",
     "Metrics",
+    "TpuMergeExtension",
     "__version__",
 ]

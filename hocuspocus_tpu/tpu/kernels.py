@@ -59,7 +59,10 @@ import jax
 import jax.numpy as jnp
 
 NONE_CLIENT = 0xFFFFFFFF  # "no origin" sentinel (client ids are uint32)
-_INF = jnp.int32(0x7FFFFFFF)
+# plain int, NOT jnp.int32: a module-level jnp scalar initializes the
+# JAX backend at import time, which hangs any process that merely
+# imports the package while the remote-attached TPU tunnel is dead
+_INF = 0x7FFFFFFF
 
 KIND_NOOP = 0
 KIND_INSERT = 1
