@@ -21,8 +21,11 @@ Content handling:
   straight to the doc's serve log. Successor map writes arrive with an
   origin pointing at the previous entry and are routed by id.
 
-Documents containing GC'd ranges (origins unrecoverable) or subdocs
-are flagged unsupported — the CPU path stays authoritative for them.
+GC structs (collected subtrees) are host-side clock ranges re-encoded
+verbatim at serve time; items anchored into a collected range become GC
+themselves, mirroring the CPU engine. Documents containing Skip structs
+or subdocs are flagged unsupported — the CPU path stays authoritative
+for them.
 
 Decoding uses the native C++ codec (hocuspocus_tpu.native) as the fast
 screen: updates made only of origin-carrying string/delete runs (the
@@ -87,6 +90,9 @@ class DenseOp:
     # insert lowered from a ContentDeleted struct: the arena stores the
     # units (as zeros) but serving re-encodes the struct as ContentDeleted
     deleted_content: bool = False
+    # GC struct (collected subtree): host-only clock range with no
+    # content/origins, re-encoded verbatim at serve time
+    gc: bool = False
     # decoded Content object for non-string payloads (format/embed/type/
     # any/binary and every map value) — re-written verbatim at serve time
     content: Any = None
@@ -280,6 +286,19 @@ class DocLowerer:
 
     # -- emission ------------------------------------------------------------
 
+    def _collected_by_gc(self, struct: LoweredStruct) -> bool:
+        """True when EITHER origin or the explicit parent id resolves
+        into a collected range — the CPU engine integrates such items
+        as GC structs (`parent = None` when a resolved left/right is GC
+        or the parent item is GC, crdt/structs.py)."""
+        for ref in (struct.origin, struct.right_origin):
+            if ref is not None and self._route_of_id(ref[0], ref[1]) == ("gc",):
+                return True
+        if struct.parent is not None and struct.parent[0] == "item":
+            if self._route_of_id(struct.parent[1], struct.parent[2]) == ("gc",):
+                return True
+        return False
+
     def _resolve_route(self, struct: LoweredStruct) -> Optional[tuple]:
         """("seq", seq_key) | ("map", parent_key, sub) | None=undecidable."""
         if struct.parent_sub is not None:
@@ -308,6 +327,28 @@ class DocLowerer:
         known = self.known.get(client, 0)
         if clock + struct.length <= known:
             return  # full duplicate
+        if struct.kind == STRUCT_GC or self._collected_by_gc(struct):
+            # A pure clock range with no content/origins: a GC struct
+            # from the wire, OR an item whose origin / explicit parent
+            # resolves into a collected range — the CPU engine converts
+            # such items to GC structs at integrate time (yjs
+            # Item.getMissing semantics, crdt/structs.py), and the
+            # lowerer mirrors that so reconnecting offline editors
+            # can't retire the doc from the plane. Recorded host-side
+            # and re-encoded verbatim at serve time (GC.write).
+            offset = max(known - clock, 0)
+            map_out.append(
+                DenseOp(
+                    kind=KIND_INSERT,
+                    client=client,
+                    clock=clock + offset,
+                    run_len=struct.length - offset,
+                    gc=True,
+                )
+            )
+            self._record_route(client, clock + offset, struct.length - offset, ("gc",))
+            self.known[client] = clock + struct.length
+            return
         route = self._resolve_route(struct)
         if route is None:
             # origin belongs to content we never integrated (shouldn't
@@ -331,6 +372,9 @@ class DocLowerer:
                 return
         if route[0] == "map":
             self._emit_map(struct, route, offset, map_out)
+            return
+        if route[0] != "seq":  # unexpected route kind: degrade, not crash
+            self.unsupported = True
             return
         self._emit_seq(struct, route[1], offset, seq_out)
 
@@ -449,9 +493,10 @@ class DocLowerer:
             self.unsupported = True
             return {}, [], []
         for struct in structs:
-            if struct.kind in (STRUCT_SKIP, STRUCT_GC, STRUCT_OTHER):
-                # GC structs lose origin info and cannot be re-placed;
-                # Skips and subdocs are host-only.
+            if struct.kind in (STRUCT_SKIP, STRUCT_OTHER):
+                # Skips (partial-update placeholders) and subdocs are
+                # host-only; GC structs ARE supported — they carry no
+                # origins and re-encode verbatim (see _emit_struct).
                 self.unsupported = True
             else:
                 self.pending.append(struct)
@@ -512,6 +557,8 @@ class DocLowerer:
             upto = min(end, run_end)
             if route[0] == "map":
                 map_tombs.append((client, clock, upto - clock))
+            elif route[0] == "gc":
+                pass  # already collected: tombstones are meaningless
             else:
                 seq_out.setdefault(route[1], []).append(
                     DenseOp(kind=KIND_DELETE, client=client, clock=clock, run_len=upto - clock)

@@ -31,7 +31,7 @@ from ..crdt.content import ContentDeleted, ContentString
 from ..crdt.delete_set import DeleteSet
 from ..crdt.encoding import Encoder
 from ..crdt.ids import ID
-from ..crdt.structs import Item
+from ..crdt.structs import GC, Item
 from ..crdt.update import _write_structs, decode_state_vector
 from .kernels import KIND_DELETE, KIND_INSERT, NONE_CLIENT
 from .lowering import DenseOp, units_to_text
@@ -47,8 +47,11 @@ def _wire_parent(parent: Optional[tuple]):
     return ID(parent[1], parent[2])
 
 
-def _make_item(rec: LogRec, unit_logs: dict) -> Item:
+def _make_item(rec: LogRec, unit_logs: dict):
     op = rec.op
+    if op.gc:
+        # collected subtree: re-encode the clock range verbatim
+        return GC(ID(op.client, op.clock), op.run_len)
     origin = ID(op.left_client, op.left_clock) if op.left_client != NONE_CLIENT else None
     right_origin = (
         ID(op.right_client, op.right_clock) if op.right_client != NONE_CLIENT else None

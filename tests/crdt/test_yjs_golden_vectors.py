@@ -343,3 +343,54 @@ def test_plane_serves_golden_blobs_wire_compatibly():
         peer = Doc()
         apply_update(peer, served)
         assert check(peer), f"case {i} served bytes diverged from the blob"
+
+
+def test_plane_handles_gc_blobs():
+    """GC ranges ride the plane (re-encoded verbatim at serve time);
+    a live item whose origin resolves INTO a collected range becomes a
+    GC struct itself — exactly what the CPU engine does at integrate
+    time (yjs Item.getMissing semantics)."""
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+    from hocuspocus_tpu.tpu.serving import PlaneServing
+
+    # plain GC in the middle of a client's range: supported, served
+    plane = MergePlane(num_docs=4, capacity=256)
+    serving = PlaneServing(plane)
+    plane.register("g")
+    gc_blob = _h(
+        "01 02 2A 00"
+        " 04 01 01 74 01 61"  # Item "a"
+        " 00 02"              # GC struct, length 2
+        " 00"
+    )
+    plane.enqueue_update("g", gc_blob)
+    assert plane.is_supported("g")
+    plane.flush()
+    serving.refresh()
+    cpu = Doc()
+    apply_update(cpu, gc_blob)
+    served = serving.encode_state_as_update("g", cpu, None)
+    assert served is not None
+    peer = Doc()
+    apply_update(peer, served)
+    assert peer.get_text("t").to_string() == "a"
+    assert peer.store.get_state_vector() == {42: 3}
+
+    # gc-ANCHORED: the plane collects "d" like the CPU engine and still
+    # serves — a reconnecting offline editor must not retire the doc
+    plane2 = MergePlane(num_docs=4, capacity=256)
+    serving2 = PlaneServing(plane2)
+    plane2.register("d")
+    plane2.enqueue_update("d", BLOB_GC_ANCHORED)
+    assert plane2.is_supported("d")
+    plane2.flush()
+    serving2.refresh()
+    cpu2 = Doc()
+    apply_update(cpu2, BLOB_GC_ANCHORED)
+    assert cpu2.get_text("t").to_string() == "a"
+    served2 = serving2.encode_state_as_update("d", cpu2, None)
+    assert served2 is not None
+    peer2 = Doc()
+    apply_update(peer2, served2)
+    assert peer2.get_text("t").to_string() == "a"
+    assert peer2.store.get_state_vector() == {42: 4}

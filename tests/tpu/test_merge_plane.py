@@ -148,8 +148,10 @@ def test_map_content_stays_on_plane():
     assert rec.slot is None and rec.op.parent_sub == "k"
 
 
-def test_gc_content_falls_back():
-    """GC structs lose origin info and cannot be re-placed: unsupported."""
+def test_gc_structs_stay_on_plane():
+    """GC structs (collected subtrees) are pure clock ranges: recorded
+    host-side and re-encoded verbatim — the doc stays plane-served
+    (reloaded ProseMirror docs with deleted paragraphs hit this)."""
     from hocuspocus_tpu.crdt.encoding import Encoder
 
     enc = Encoder()
@@ -159,6 +161,27 @@ def test_gc_content_falls_back():
     enc.write_var_uint(0)  # clock
     enc.write_uint8(0x00)  # GC ref
     enc.write_var_uint(3)  # gc length
+    enc.write_var_uint(0)  # ds clients
+    plane = MergePlane(num_docs=4, capacity=256)
+    plane.register("d")
+    assert plane.enqueue_update("d", enc.to_bytes()) == 1
+    assert plane.is_supported("d")
+    assert plane.docs["d"].lowerer.known == {9: 3}
+    rec = plane.docs["d"].serve_log[-1]
+    assert rec.op.gc and rec.op.run_len == 3
+
+
+def test_skip_content_falls_back():
+    """Skip structs (partial-update placeholders) stay host-only."""
+    from hocuspocus_tpu.crdt.encoding import Encoder
+
+    enc = Encoder()
+    enc.write_var_uint(1)  # sections
+    enc.write_var_uint(1)  # structs
+    enc.write_var_uint(9)  # client
+    enc.write_var_uint(0)  # clock
+    enc.write_uint8(0x0A)  # Skip ref
+    enc.write_var_uint(3)  # skip length
     enc.write_var_uint(0)  # ds clients
     plane = MergePlane(num_docs=4, capacity=256)
     plane.register("d")

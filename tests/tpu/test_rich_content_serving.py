@@ -234,3 +234,76 @@ async def test_nested_types_in_map_served_from_plane():
         a.destroy()
         b.destroy()
         await server.destroy()
+
+
+async def test_reloaded_doc_with_gc_subtree_stays_on_plane():
+    """A ProseMirror doc whose snapshot contains GC structs (a deleted
+    paragraph's collected subtree) must load onto the plane and serve —
+    previously any GC'd range retired the doc to the CPU path forever,
+    so every long-lived rich doc degraded after its first reload."""
+    from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+    from hocuspocus_tpu.transformer import ProsemirrorTransformer
+
+    pm = {
+        "type": "doc",
+        "content": [
+            {"type": "paragraph", "content": [{"type": "text", "text": "keep me"}]},
+            {"type": "paragraph", "content": [{"type": "text", "text": "delete me"}]},
+        ],
+    }
+    # build the pre-loaded state: delete paragraph 2 so gc collects it
+    source = Doc()
+    apply_update(source, encode_state_as_update(ProsemirrorTransformer.to_ydoc(pm, "pm")))
+    source.get_xml_fragment("pm").delete(1, 1)
+    snapshot = encode_state_as_update(source)
+    from hocuspocus_tpu.tpu.lowering import STRUCT_GC, _decode_update
+
+    structs, _ = _decode_update(snapshot)
+    assert any(s.kind == STRUCT_GC for s in structs), "precondition: snapshot has GC"
+
+    # the doc loads from persistence (snapshot WITH gc) on first connect
+    from hocuspocus_tpu.extensions import Database
+
+    async def fetch(data):
+        return snapshot if data.document_name == "gcdoc" else None
+
+    ext = _plane_ext()
+    server = await new_hocuspocus(extensions=[Database(fetch=fetch), ext])
+    a = new_provider(server, name="gcdoc")
+    b = new_provider(server, name="gcdoc")
+    try:
+        await wait_synced(a, b)
+        expected = {
+            "type": "doc",
+            "content": [
+                {"type": "paragraph", "content": [{"type": "text", "text": "keep me"}]}
+            ],
+        }
+        assert ProsemirrorTransformer.from_ydoc(a.document, "pm") == expected
+        assert ext.plane.counters["docs_retired_unsupported"] == 0, {
+            k: v for k, v in ext.plane.counters.items() if v
+        }
+        assert "gcdoc" in ext._docs  # plane-served despite the GC range
+
+        # live edits keep flowing through the plane
+        a.document.get_xml_fragment("pm").get(0).get(0).insert(0, "still ")
+
+        def edited():
+            result = ProsemirrorTransformer.from_ydoc(b.document, "pm")
+            assert result["content"][0]["content"][0]["text"] == "still keep me"
+
+        await retryable_assertion(edited)
+        assert ext.plane.counters["docs_retired_unsupported"] == 0
+
+        # late joiner rebuilds from the plane, GC range included
+        serves = ext.plane.counters["sync_serves"]
+        c = new_provider(server, name="gcdoc")
+        await wait_synced(c)
+        result = ProsemirrorTransformer.from_ydoc(c.document, "pm")
+        assert result["content"][0]["content"][0]["text"] == "still keep me"
+        assert ext.plane.counters["sync_serves"] > serves
+        c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
