@@ -266,3 +266,52 @@ def test_plane_fuzz_concurrent_editors_converge(seed):
         rebuilt = Doc()
         apply_update(rebuilt, served)
         assert _doc_fingerprint(rebuilt) == _doc_fingerprint(a), (seed, round_no)
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_plane_fuzz_reload_from_gc_snapshot(seed):
+    """Simulates the server reload path mid-stream: every ~30 steps a
+    FRESH plane loads the doc from a snapshot (which may contain GC
+    structs once tree deletions ran) and must keep serving the ongoing
+    edit stream. Covers GC lowering, snapshot overlap dedup, and
+    routing continuity across reloads."""
+    rng = np.random.default_rng(seed)
+    cpu = Doc()
+    updates = []
+    cpu.on("update", lambda update, *rest: updates.append(update))
+
+    def tree_delete(step):
+        frag = cpu.get_xml_fragment("x")
+        if len(frag) > 0:
+            frag.delete(int(rng.integers(0, len(frag))), 1)
+
+    plane = MergePlane(num_docs=64, capacity=4096)
+    serving = PlaneServing(plane)
+    plane.register("r")
+
+    for step in range(90):
+        if step % 7 == 6:
+            tree_delete(step)  # creates gc'd subtrees in later snapshots
+        else:
+            _random_edit(rng, cpu, step)
+        while updates:
+            plane.enqueue_update("r", updates.pop(0))
+        if step % 30 == 29:
+            # "server restart": fresh plane, loaded from the snapshot
+            plane = MergePlane(num_docs=64, capacity=4096)
+            serving = PlaneServing(plane)
+            plane.register("r")
+            plane.enqueue_update("r", encode_state_as_update(cpu))
+        if step % 10 == 9:
+            plane.flush()
+            serving.refresh()
+            assert plane.is_supported("r"), (
+                seed,
+                step,
+                {k: v for k, v in plane.counters.items() if v},
+            )
+            served = serving.encode_state_as_update("r", cpu, None)
+            assert served is not None, (seed, step)
+            rebuilt = Doc()
+            apply_update(rebuilt, served)
+            assert _doc_fingerprint(rebuilt) == _doc_fingerprint(cpu), (seed, step)
