@@ -14,11 +14,16 @@ Safety model:
 - The CPU document stays the fallback: every serve checks the plane is
   healthy (supported, no overflow, host/device logs in sync) AND covers
   the CPU document's state vector; otherwise the caller falls back.
-- Delete sets for *sequence* content are always read from the DEVICE
-  tombstone mask — a deletion the kernel did not apply can never be
-  served. Map-item deletions (host-only content that never rides the
-  device) are merged in from the host tombstone log, which is applied
-  synchronously at lowering time.
+- SYNC serves read delete sets for *sequence* content from the DEVICE
+  tombstone mask — a cold joiner can never receive a deletion the
+  kernel did not apply. Map-item deletions (host-only content that
+  never rides the device) are merged in from the host tombstone log.
+- BROADCASTS ship the window's own delete ranges from the serve log
+  (O(window), not O(doc-lifetime tombstones)): the kernel applies
+  id-range tombstones unconditionally over ids the lowerer proved
+  integrated, and any host/device divergence retires the doc via the
+  health check (full-state CPU fallback) before the next broadcast —
+  see build_broadcast.
 """
 
 from __future__ import annotations
@@ -383,13 +388,17 @@ class PlaneServing:
 
         Items come from the doc's serve log (everything consumed by the
         device or host-integrated since the cursor, minus presync
-        records — receivers get pre-load state via sync); when the
-        window contained delete ops, the delete set is the full device
-        tombstone state — receivers treat already-known ranges as
-        no-ops, so device-applied deletions are never lost without
-        per-slot delta bookkeeping. The cursor only advances on a
-        successfully encoded payload (or a genuinely empty window), so
-        a bail-out never strands ops.
+        records — receivers get pre-load state via sync). The delete
+        set carries exactly the WINDOW's delete ranges: the kernel
+        applies id-range tombstones unconditionally over ids the
+        lowerer proved integrated, and a host/device divergence is
+        caught by the health check (retire + full-state CPU fallback)
+        before the next broadcast — so shipping the full device
+        tombstone state every time (O(doc-lifetime deletes) per
+        broadcast) is not needed for safety. Cold joiners still get the
+        complete device-proved set via the sync path. The cursor only
+        advances on a successfully encoded payload (or a genuinely
+        empty window), so a bail-out never strands ops.
         """
         plane = self.plane
         doc = plane.docs.get(name)
@@ -402,8 +411,11 @@ class PlaneServing:
             self.broadcast_cursor[name] = len(log)
             return None
         by = self._group_items(doc, window)
-        has_delete = any(rec.op.kind == KIND_DELETE for rec in window)
-        if not by and not has_delete:
+        window_ds = DeleteSet()
+        for rec in window:
+            if rec.op.kind == KIND_DELETE:
+                window_ds.add(rec.op.client, rec.op.clock, rec.op.run_len)
+        if not by and not window_ds.clients:
             self.broadcast_cursor[name] = len(log)
             return None
         encoder = Encoder()
@@ -411,10 +423,8 @@ class PlaneServing:
         for client in sorted(by, reverse=True):
             items = by[client]
             _write_structs(encoder, items, client, items[0].id.clock)
-        if has_delete:
-            self._device_delete_set(doc).write(encoder)
-        else:
-            DeleteSet().write(encoder)
+        window_ds.sort_and_merge()
+        window_ds.write(encoder)
         self.broadcast_cursor[name] = len(log)
         plane.counters["plane_broadcasts"] += 1
         return encoder.to_bytes()

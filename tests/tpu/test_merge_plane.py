@@ -358,3 +358,38 @@ def test_partial_delete_range_applies_known_prefix():
     deletes2 = [op for op in ops2 if op.kind == KIND_DELETE]
     assert [(d.clock, d.run_len) for d in deletes2] == [(3, 2)]
     assert lowerer.pending_deletes == []
+
+
+def test_broadcast_delete_sets_are_window_sized():
+    """Broadcast ds carries the WINDOW's delete ranges only: with N
+    delete rounds, broadcast sizes must stay bounded instead of growing
+    with the doc's full tombstone history (previously every broadcast
+    containing a delete shipped the complete device delete set)."""
+    from hocuspocus_tpu.crdt import apply_update
+    from hocuspocus_tpu.tpu.serving import PlaneServing
+
+    plane = MergePlane(num_docs=4, capacity=4096)
+    serving = PlaneServing(plane)
+    doc = Doc()
+    mirror_doc_updates(plane, "d", doc)
+    text = doc.get_text("t")
+    text.insert(0, "x" * 1024)
+    plane.flush()
+    serving.refresh()
+    assert serving.build_broadcast("d")  # drain the seed window
+
+    sizes = []
+    peer = Doc()
+    apply_update(peer, encode_state_as_update(doc))
+    for round_no in range(30):
+        text.delete(0, 4)  # steadily accumulate tombstones
+        plane.flush()
+        serving.refresh()
+        payload = serving.build_broadcast("d")
+        assert payload is not None
+        apply_update(peer, payload)
+        sizes.append(len(payload))
+        assert peer.get_text("t").to_string() == text.to_string(), round_no
+    # each round deletes the same amount; payloads must not trend up
+    # with tombstone history (allow codec jitter from varint widths)
+    assert max(sizes) <= min(sizes) + 8, sizes
