@@ -1,10 +1,17 @@
-"""Server with the TPU batched merge plane enabled.
+"""Server with the TPU merge plane as the SERVING path.
 
-Every supported text document is mirrored onto device-resident arenas;
-updates from all documents are integrated in micro-batched kernel steps
-(see docs/tpu/merge-plane.md and bench.py).
+Documents live on device-resident arenas (one row per sequence — plain
+and rich text, ProseMirror trees, arrays; maps host-side): updates from
+all documents are integrated in micro-batched kernel steps, SyncStep2
+replies are served from device state with storm-batched state-vector
+triage, and fan-out rides one merged broadcast per flush. Device steps
+run off the event loop; flush shapes pre-compile at listen. Any
+degradation falls the affected doc back to the CPU path with no data
+loss (see docs/tpu/merge-plane.md and bench.py).
 
 Run: python examples/tpu_merge.py
+Multi-chip: pass mesh=hocuspocus_tpu.tpu.sharding.make_mesh() to shard
+the arenas over the available devices.
 """
 
 import asyncio
@@ -20,7 +27,7 @@ async def main() -> None:
             name="tpu-merge",
             extensions=[
                 Logger(),
-                TpuMergeExtension(num_docs=1024, capacity=4096, flush_interval_ms=5),
+                TpuMergeExtension(num_docs=1024, capacity=4096, flush_interval_ms=5, serve=True),
             ],
         )
     )

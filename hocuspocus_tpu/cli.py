@@ -5,6 +5,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import sys
 
@@ -32,7 +33,24 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--tpu-merge",
         action="store_true",
-        help="enable the TPU batched merge plane extension",
+        help="enable the TPU batched merge plane extension (shadow mode)",
+    )
+    parser.add_argument(
+        "--tpu-serve",
+        action="store_true",
+        help="serve sync replies and broadcasts FROM the TPU plane (implies --tpu-merge)",
+    )
+    parser.add_argument(
+        "--tpu-docs",
+        type=int,
+        default=1024,
+        help="merge plane arena rows (sequences), default 1024",
+    )
+    parser.add_argument(
+        "--tpu-capacity",
+        type=int,
+        default=4096,
+        help="merge plane arena capacity per row (units), default 4096",
     )
     return parser
 
@@ -58,10 +76,18 @@ async def run(args: argparse.Namespace) -> None:
         )
     if args.webhook:
         extensions.append(Webhook(url=args.webhook))
-    if args.tpu_merge:
+    if args.tpu_merge or args.tpu_serve:
+        # importing .tpu pins the backend to CPU when JAX_PLATFORMS=cpu
+        # (see hocuspocus_tpu/tpu/__init__.py)
         from .tpu import TpuMergeExtension
 
-        extensions.append(TpuMergeExtension())
+        extensions.append(
+            TpuMergeExtension(
+                num_docs=args.tpu_docs,
+                capacity=args.tpu_capacity,
+                serve=args.tpu_serve,
+            )
+        )
 
     server = Server(Configuration(extensions=extensions, quiet=False))
     await server.listen(port=args.port, host=args.host)

@@ -1,3 +1,18 @@
+import os
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # honor a CPU request even when a TPU plugin hijacks the env var:
+    # plugin backends (e.g. the remote-attached axon TPU) register
+    # regardless of JAX_PLATFORMS, and only the config route reliably
+    # pins the backend. Doing it at import of THIS package fixes every
+    # entrypoint (CLI, examples, library use) before first device use.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # backend already initialized: caller's choice stands
+        pass
+
 from .merge_plane import MergePlane, TpuMergeExtension
 
 __all__ = ["MergePlane", "TpuMergeExtension"]

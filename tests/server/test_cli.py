@@ -1,17 +1,19 @@
 """CLI smoke test: boot the binary, connect a provider, shut down."""
 
 import asyncio
+import contextlib
 import os
 import signal
+import socket
 import sys
 
 from hocuspocus_tpu.provider import HocuspocusProvider
 from tests.utils import wait_for
 
 
-async def test_cli_serves_connections(tmp_path, unused_tcp_port=None):
-    import socket
-
+@contextlib.asynccontextmanager
+async def _launch_cli(*extra_args: str):
+    """Boot `python -m hocuspocus_tpu.cli` on a free port; yield the port."""
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
@@ -27,24 +29,52 @@ async def test_cli_serves_connections(tmp_path, unused_tcp_port=None):
         str(port),
         "--host",
         "127.0.0.1",
-        "--sqlite",
-        str(tmp_path / "cli.db"),
+        *extra_args,
         cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         env=env,
         stdout=asyncio.subprocess.PIPE,
         stderr=asyncio.subprocess.STDOUT,
     )
-    provider = None
     try:
-        provider = HocuspocusProvider(name="cli-doc", url=f"ws://127.0.0.1:{port}")
-        await wait_for(lambda: provider.synced, timeout=20)
-        provider.document.get_text("t").insert(0, "via cli")
-        await wait_for(lambda: not provider.has_unsynced_changes, timeout=10)
+        yield port
     finally:
-        if provider is not None:
-            provider.destroy()
         process.send_signal(signal.SIGTERM)
         try:
             await asyncio.wait_for(process.wait(), 10)
         except asyncio.TimeoutError:
             process.kill()
+
+
+async def test_cli_serves_connections(tmp_path):
+    async with _launch_cli("--sqlite", str(tmp_path / "cli.db")) as port:
+        provider = None
+        try:
+            provider = HocuspocusProvider(name="cli-doc", url=f"ws://127.0.0.1:{port}")
+            await wait_for(lambda: provider.synced, timeout=20)
+            provider.document.get_text("t").insert(0, "via cli")
+            await wait_for(lambda: not provider.has_unsynced_changes, timeout=10)
+        finally:
+            if provider is not None:
+                provider.destroy()
+
+
+async def test_cli_tpu_serve_mode():
+    """--tpu-serve boots a serve-mode plane; two providers converge
+    through plane broadcasts over the CLI-launched server."""
+    async with _launch_cli(
+        "--tpu-serve", "--tpu-docs", "64", "--tpu-capacity", "512"
+    ) as port:
+        a = b = None
+        try:
+            a = HocuspocusProvider(name="cli-tpu", url=f"ws://127.0.0.1:{port}")
+            b = HocuspocusProvider(name="cli-tpu", url=f"ws://127.0.0.1:{port}")
+            await wait_for(lambda: a.synced and b.synced, timeout=30)
+            a.document.get_text("t").insert(0, "served by the plane")
+            await wait_for(
+                lambda: b.document.get_text("t").to_string() == "served by the plane",
+                timeout=20,
+            )
+        finally:
+            for p in (a, b):
+                if p is not None:
+                    p.destroy()
