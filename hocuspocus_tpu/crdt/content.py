@@ -32,17 +32,22 @@ def utf16_len(s: str) -> int:
     return len(s) + sum(1 for ch in s if ch > "￿")
 
 
-def utf16_index(s: str, offset: int) -> tuple[int, bool]:
+def utf16_index(s: str, offset: int, units: int = -1) -> tuple[int, bool]:
     """Map a UTF-16 offset to a Python str index.
 
     Returns (index, mid_surrogate): mid_surrogate is True when the offset
     falls inside a surrogate pair (an astral char split point).
+
+    `units` is the string's UTF-16 length when the caller has it cached
+    (ContentString._len16): no-astral detection then costs O(1) instead
+    of a scan.
     """
-    if offset >= len(s):
-        # fast path: all-BMP prefix or offset at/after end
-        u = utf16_len(s)
-        if u == len(s):
-            return offset, False
+    # C-speed fast paths first: the update writer calls this with
+    # offset ~ len(s) for every merged-item append, and the O(offset)
+    # ord() walk below dominated the whole client edit path (measured
+    # ~440us/edit at 3k chars, ~90% in this function)
+    if s.isascii() or (units if units >= 0 else utf16_len(s)) == len(s):
+        return min(offset, len(s)), False  # no astral chars: unit == char
     cursor = 0
     for i, ch in enumerate(s):
         if cursor == offset:
@@ -212,7 +217,7 @@ class ContentString(Content):
         return ContentString(self.s)
 
     def splice(self, offset: int) -> "ContentString":
-        idx, mid = utf16_index(self.s, offset)
+        idx, mid = utf16_index(self.s, offset, self._len16)
         if mid:
             # Splitting a surrogate pair: replace both halves with U+FFFD
             # (yjs ContentString.splice does the same).
@@ -237,7 +242,7 @@ class ContentString(Content):
         if offset == 0:
             encoder.write_var_string(self.s)
         else:
-            idx, mid = utf16_index(self.s, offset)
+            idx, mid = utf16_index(self.s, offset, self._len16)
             s = ("�" + self.s[idx + 1 :]) if mid else self.s[idx:]
             encoder.write_var_string(s)
 
