@@ -122,6 +122,36 @@ class MergePlane:
         self.free: list[int] = list(range(num_docs - 1, -1, -1))
         self.slot_owner: dict[int, str] = {}  # slot -> doc name
         self.queues: dict[int, list[DenseOp]] = {}
+        # per-slot insert units handed to the device so far / as of the
+        # last completed flush. Serve logs are written at ENQUEUE time
+        # (so broadcasts never wait on the device); health checks
+        # therefore compare device lengths against the VALIDATED
+        # snapshot — the dispatch tally at the moment the readback was
+        # taken — never against the (optimistically ahead) host logs.
+        # ndarrays so the post-flush sweep is one vectorized compare
+        # over every slot instead of a Python loop over every doc.
+        self.dispatched_units = np.zeros(num_docs, np.int64)
+        self.validated_units = np.zeros(num_docs, np.int64)
+        # slots currently bound to a live (non-retired) doc: the post-
+        # flush health sweep masks with this so freed/retired rows
+        # compared against stale caches can't read as desyncs
+        self.slot_live = np.zeros(num_docs, bool)
+        # per-slot binding generation, bumped at every alloc/release/
+        # retire. Health snapshots (_sync_health) record the generations
+        # they were taken under; a compare is only meaningful when the
+        # snapshot's generation matches the slot's current one —
+        # otherwise the cached device row belongs to a previous tenant
+        # of the slot and must not condemn the new one.
+        self.slot_gen = np.zeros(num_docs, np.int64)
+        self.last_gen: Optional[np.ndarray] = None
+        # docs with new serve-log records since the last broadcast pass
+        self.dirty: set[str] = set()
+        # last combined health readback (see _sync_health): the remote-
+        # attached runtime charges ~a full RTT per transfer, so the
+        # flush cycle fetches lengths+overflow as ONE array and callers
+        # adopt these instead of re-reading device state
+        self.last_lengths: Optional[np.ndarray] = None
+        self.last_overflows: Optional[np.ndarray] = None
         # unit payloads never touch the device: slot assignment in the
         # append-only arena is deterministic (arena slot = arrival
         # index), so shipped payloads land here, indexed by slot. An
@@ -165,17 +195,26 @@ class MergePlane:
         self.queues[slot] = []
         self.unit_logs[slot] = []
         self.projected_len[slot] = 0
+        self.dispatched_units[slot] = 0
+        self.validated_units[slot] = 0  # freed slots keep length 0 too
+        self.slot_live[slot] = True
+        self.slot_gen[slot] += 1
         return slot
 
     def release(self, name: str) -> None:
         doc = self.docs.pop(name, None)
         if doc is None:
             return
+        self.dirty.discard(name)
         for slot in doc.seqs.values():
             self.slot_owner.pop(slot, None)
             self.queues.pop(slot, None)
             self.unit_logs.pop(slot, None)
             self.projected_len.pop(slot, None)
+            self.dispatched_units[slot] = 0
+            self.validated_units[slot] = 0
+            self.slot_live[slot] = False
+            self.slot_gen[slot] += 1
             self._clear_slot(slot)
             self.free.append(slot)
 
@@ -195,9 +234,12 @@ class MergePlane:
         doc.lowerer.unsupported = True
         doc.serve_log = []
         doc.map_tombstones = []
+        self.dirty.discard(name)
         for slot in doc.seqs.values():
             self.queues[slot].clear()
             self.unit_logs[slot] = []
+            self.slot_live[slot] = False
+            self.slot_gen[slot] += 1
 
     def _clear_slot(self, slot: int) -> None:
         empty = make_empty_state(1, self.capacity)
@@ -247,6 +289,18 @@ class MergePlane:
                 for op in ops:
                     op.presync = True
             self.queues[slot].extend(ops)
+            # log at ENQUEUE time: broadcast frames build from the host
+            # log without waiting for the device flush (the device round
+            # trip must never sit on the edit->broadcast critical path —
+            # ~an RTT per transfer on remote-attached TPUs). Arena slot
+            # assignment is deterministic (arrival order), so unit
+            # offsets are final here; health checks compare device state
+            # against dispatched tallies, not these logs.
+            log = self.unit_logs[slot]
+            for op in ops:
+                doc.serve_log.append(LogRec(op=op, slot=slot, unit_off=len(log)))
+                if op.kind == KIND_INSERT:
+                    log.extend(op.chars)
             count += len(ops)
         for op in map_ops:
             op.presync = presync
@@ -263,6 +317,11 @@ class MergePlane:
                     slot=None,
                 )
             )
+            # a map-tombstone-only update still produces a serve-log
+            # record that must broadcast: count it like every other op
+            count += 1
+        if count:
+            self.dirty.add(name)
         return count
 
     def pending_ops(self) -> int:
@@ -347,25 +406,52 @@ class MergePlane:
             k = 1
             while k < needed:
                 k *= 2
-            ops = self._build_batch(k)
-            # int(count) is a sound completion barrier: both integrate
-            # paths data-depend the count on the output state via
-            # lax.optimization_barrier (buffer *readiness* of aliased
-            # Pallas outputs is not trustworthy — see bench.py sync())
+            ops, built = self._build_batch(k)
+            # `built` is the host-side op count — identical to the
+            # device's kind!=NOOP sum by construction, so the flush
+            # needs no per-batch count readback (a full RTT each on
+            # remote-attached TPUs); _sync_health below is the cycle's
+            # single completion barrier (content readback — buffer
+            # *readiness* of aliased Pallas outputs is not trustworthy,
+            # see bench.py sync())
             step = self._sharded_step or integrate_op_slots_fast
             if tracer.enabled:
                 with tracer.device_span("merge_plane.integrate", slots=k) as span:
-                    self.state, count = step(self.state, ops)
-                    count = int(count)
-                    span.set("integrated", count)
+                    self.state, _count = step(self.state, ops)
+                    span.set("integrated", built)
             else:
-                self.state, count = step(self.state, ops)
-                count = int(count)
-            total += count
+                self.state, _count = step(self.state, ops)
+            total += built
+        if batches:
+            self._sync_health()
         self.total_integrated += total
         return total
 
-    def _build_batch(self, k: int) -> OpBatch:
+    def _sync_health(self) -> None:
+        """ONE combined device->host readback per flush cycle.
+
+        Fetches lengths + overflow as a single array (each transfer
+        costs ~a full RTT on remote-attached runtimes) — this read is
+        also the completion barrier for every batch dispatched above,
+        by data dependence. The dispatched->validated snapshot is taken
+        at the same point (under the step lock), so health checks
+        compare device rows against exactly the ops the device has
+        integrated, never against optimistically-ahead host logs. A
+        launch failure surfaces here and propagates to the caller
+        (flush -> extension degrade path)."""
+        import jax.numpy as jnp
+
+        combined = np.asarray(
+            jnp.concatenate(
+                [self.state.length, self.state.overflow.astype(jnp.int32)]
+            )
+        )
+        self.last_lengths = combined[: self.num_docs]
+        self.last_overflows = combined[self.num_docs :].astype(bool)
+        self.validated_units = self.dispatched_units.copy()
+        self.last_gen = self.slot_gen.copy()
+
+    def _build_batch(self, k: int) -> "tuple[OpBatch, int]":
         d = self.num_docs
         # accumulate coordinates + per-field columns in flat Python
         # lists and scatter once per field: per-element numpy stores
@@ -377,14 +463,13 @@ class MergePlane:
         # snapshot (atomic under the GIL): enqueue on the loop thread may
         # add queues while this runs in the executor; new queues simply
         # wait for the next cycle
+        built = 0
         for slot, queue in list(self.queues.items()):
             if not queue:
                 continue
             take = queue[:k]
             del queue[:k]
-            log = self.unit_logs[slot]
-            doc = self.docs[self.slot_owner[slot]]
-            serve_log = doc.serve_log
+            dispatched = 0
             for i, op in enumerate(take):
                 rows.append(i)
                 cols.append(slot)
@@ -396,9 +481,10 @@ class MergePlane:
                 vals[5].append(op.left_clock)
                 vals[6].append(op.right_client)
                 vals[7].append(op.right_clock)
-                serve_log.append(LogRec(op=op, slot=slot, unit_off=len(log)))
-                if op.kind == KIND_INSERT:  # payload goes to the host log
-                    log.extend(op.chars)
+                if op.kind == KIND_INSERT:
+                    dispatched += op.run_len
+            built += len(take)
+            self.dispatched_units[slot] += dispatched
         kind = np.zeros((k, d), np.int32)
         client = np.zeros((k, d), np.uint32)
         clock = np.zeros((k, d), np.int32)
@@ -420,7 +506,7 @@ class MergePlane:
             right_clock[ri, ci] = vals[7]
         fields = (kind, client, clock, run_len, left_client, left_clock,
                   right_client, right_clock)
-        return self._upload_batch(fields)
+        return self._upload_batch(fields), built
 
     def _upload_batch(self, fields: tuple) -> OpBatch:
         if self._op_shardings is not None:
@@ -442,22 +528,43 @@ class MergePlane:
     # -- extraction --------------------------------------------------------
 
     def check_doc_health(
-        self, name: str, doc: PlaneDoc, lengths: np.ndarray, overflows: np.ndarray
+        self,
+        name: str,
+        doc: PlaneDoc,
+        lengths: np.ndarray,
+        overflows: np.ndarray,
+        validated: Optional[np.ndarray] = None,
+        gens: Optional[np.ndarray] = None,
     ) -> bool:
         """Device/host invariants for every row of a doc; retires on fail.
 
         The single health definition shared by text() and the serving
         path (PlaneServing.doc_healthy) — callers supply the (D,)
-        length/overflow rows so serving can reuse its refresh() caches.
+        length/overflow rows AND the validated-unit + generation
+        snapshots taken with them, so serving can reuse its refresh()
+        caches. Device lengths are compared against VALIDATED dispatch
+        tallies (what the device had been given as of that readback),
+        never the host unit logs — those run optimistically ahead of
+        the device by design. A slot whose binding generation changed
+        since the snapshot (released + reallocated) is skipped: the
+        cached row describes the previous tenant, and the next
+        consistent snapshot will cover the new one.
         """
+        if validated is None:
+            validated = self.validated_units
+        if gens is None:
+            gens = self.last_gen
         for slot in doc.seqs.values():
+            if gens is None or gens[slot] != self.slot_gen[slot]:
+                continue  # snapshot predates this slot's binding
             if bool(overflows[slot]):
                 self.retire_doc(name, "overflow")
                 return False
-            if len(self.unit_logs[slot]) != int(lengths[slot]):
-                # host log and arena desynced (op rejected on device) —
-                # the CPU document stays authoritative; retire the doc
-                # so it stops consuming queue/log/kernel resources
+            if int(validated[slot]) != int(lengths[slot]):
+                # dispatched ops and arena desynced (op rejected on
+                # device) — the CPU document stays authoritative; retire
+                # the doc so it stops consuming queue/log/kernel
+                # resources
                 self.retire_doc(name, "desync")
                 return False
         return True
@@ -487,6 +594,12 @@ class MergePlane:
         if not roots:
             return ""
         with self._step_lock:  # never read state mid-flush (donation)
+            if self.pending_ops() > 0:
+                # broadcasts run ahead of the device on purpose; a
+                # direct device read must first drain the queues so
+                # "live text" means everything enqueued (reentrant lock:
+                # _flush_locked re-acquires)
+                self._flush_locked(None)
             if not self.check_doc_health(
                 name, doc, np.asarray(self.state.length), np.asarray(self.state.overflow)
             ):
@@ -564,6 +677,7 @@ class TpuMergeExtension(Extension):
         plane: Optional[MergePlane] = None,
         serve: bool = False,
         mesh=None,
+        broadcast_interval_ms: float = 2.0,
     ) -> None:
         if plane is not None and mesh is not None:
             raise ValueError(
@@ -572,7 +686,14 @@ class TpuMergeExtension(Extension):
             )
         self.plane = plane or MergePlane(num_docs=num_docs, capacity=capacity, mesh=mesh)
         self.flush_interval_ms = flush_interval_ms
+        # broadcasts build from the HOST serve logs and run on their own
+        # (shorter) coalescing window, decoupled from the device flush:
+        # edits landing within the window share one frame per doc, and
+        # the device round trip (an RTT per transfer when the chip is
+        # remote-attached) never sits on the edit->observe path
+        self.broadcast_interval_ms = broadcast_interval_ms
         self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._broadcast_handle: Optional[asyncio.TimerHandle] = None
         self.serve = serve
         self.serving = None
         self._docs: dict[str, object] = {}  # name -> server Document being served
@@ -652,7 +773,11 @@ class TpuMergeExtension(Extension):
     async def on_destroy(self, data: Payload) -> None:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
-        # full drain: no timer will fire after teardown to pick up a tail
+        if self._broadcast_handle is not None:
+            self._broadcast_handle.cancel()
+        # flush the broadcast tail, then fully drain the device queues:
+        # no timer will fire after teardown to pick up either
+        self._broadcast_served()
         await self._flush_now(max_batches=None)
 
     # -- serving: update capture (called by Document._handle_update) ---------
@@ -672,6 +797,7 @@ class TpuMergeExtension(Extension):
             self._fallback_to_cpu(document)
             return False
         self._schedule_flush()
+        self._schedule_broadcast()
         return True
 
     def _fallback_to_cpu(self, document) -> None:
@@ -706,9 +832,20 @@ class TpuMergeExtension(Extension):
                 _logger_mod.log_error(f"CPU fallback failed for {document.name!r}")
 
     def _broadcast_served(self) -> None:
+        """One broadcast pass: every doc with new serve-log records gets
+        one merged frame. Pure host work (serve logs + cached health
+        rows) — never waits on the device flush; a desync the validator
+        finds a cycle later degrades that doc via full-state CPU
+        fallback, which supersedes any optimistic frames (receivers
+        converge by CRDT idempotence either way)."""
         if not self.serve:
             return
-        for name, document in list(self._docs.items()):
+        dirty = list(self.plane.dirty)
+        self.plane.dirty.clear()
+        for name in dirty:
+            document = self._docs.get(name)
+            if document is None:
+                continue
             # per-doc guard: the stated safety model is "any serving
             # error degrades that doc to the CPU path" — an exception
             # here must neither strand this doc's ops nor skip the
@@ -741,10 +878,14 @@ class TpuMergeExtension(Extension):
         device integrates; the lock serializes against the batched
         catch-up drain and unload-time registry mutation.
 
-        The default of ONE kernel batch per cycle makes broadcasts
-        interleave with integration (observers wait ~one batch time, not
-        a full backlog drain); the remainder reschedules. on_destroy
-        passes None for a full drain — no timer fires after teardown.
+        Broadcasts do NOT run here: they build from the host serve logs
+        on their own timer (_schedule_broadcast), so the device cycle —
+        upload + kernel + one combined health readback, each transfer ~a
+        full RTT on a remote-attached chip — only gates validation and
+        sync serves, never the edit->observe path. The default of ONE
+        kernel batch per cycle keeps cycles short; the remainder
+        reschedules. on_destroy passes None for a full drain — no timer
+        fires after teardown.
         """
         async with self.plane.flush_lock:
             try:
@@ -756,9 +897,41 @@ class TpuMergeExtension(Extension):
             except Exception:
                 self._degrade_all_served()
                 return
-            self._broadcast_served()
+            if self.serve:
+                self._validate_served()
         if self.plane.pending_ops() > 0:
             self._schedule_flush()
+
+    def _validate_served(self) -> None:
+        """Post-flush desync sweep, vectorized over every slot.
+
+        Broadcasts run optimistically ahead of the device, so this
+        sweep — one numpy compare of the flush's combined readback
+        against the validated dispatch tallies — is what catches a
+        device-side op rejection even when no further edit or sync
+        would touch the doc. Affected served docs degrade to the CPU
+        path via the usual full-state fallback broadcast.
+        """
+        plane = self.plane
+        if plane.last_lengths is None or plane.last_gen is None:
+            return
+        bad = (
+            plane.slot_live
+            & (plane.last_gen == plane.slot_gen)
+            & ((plane.validated_units != plane.last_lengths) | plane.last_overflows)
+        )
+        if not bad.any():
+            return
+        for slot in np.nonzero(bad)[0]:
+            name = plane.slot_owner.get(int(slot))
+            if name is None:
+                continue
+            # doc_healthy retires with the right reason; served docs
+            # then fall back with the one-time full-state broadcast
+            if self.serving.doc_healthy(name) is None:
+                document = self._docs.get(name)
+                if document is not None:
+                    self._fallback_to_cpu(document)
 
     def _schedule_flush(self) -> None:
         if self._flush_handle is not None:
@@ -772,4 +945,16 @@ class TpuMergeExtension(Extension):
 
         self._flush_handle = asyncio.get_event_loop().call_later(
             self.flush_interval_ms / 1000, run
+        )
+
+    def _schedule_broadcast(self) -> None:
+        if not self.serve or self._broadcast_handle is not None:
+            return
+
+        def run() -> None:
+            self._broadcast_handle = None
+            self._broadcast_served()
+
+        self._broadcast_handle = asyncio.get_event_loop().call_later(
+            self.broadcast_interval_ms / 1000, run
         )

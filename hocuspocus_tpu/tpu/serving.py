@@ -91,6 +91,8 @@ class PlaneServing:
         self.broadcast_cursor: dict[str, int] = {}
         self._length_cache: Optional[np.ndarray] = None
         self._overflow_cache: Optional[np.ndarray] = None
+        self._validated_cache: Optional[np.ndarray] = None
+        self._gen_cache: Optional[np.ndarray] = None
         # catch-up batching: SyncStep1s that arrive in the same storm
         # window are triaged by ONE state_vector_diff kernel call
         self._catchup_queue: list[tuple] = []  # (name, document, sv_bytes, future)
@@ -105,9 +107,26 @@ class PlaneServing:
     # -- device readback cache ---------------------------------------------
 
     def refresh(self) -> None:
-        """Pull the (D,) health rows once; per-slot checks then stay host-side."""
-        self._length_cache = np.asarray(self.plane.state.length)
-        self._overflow_cache = np.asarray(self.plane.state.overflow)
+        """Adopt the plane's last combined health readback; per-slot
+        checks then stay host-side.
+
+        The three caches — lengths, overflows, validated dispatch
+        tallies — are snapshotted together under the step lock so they
+        describe ONE device state: serve logs run optimistically ahead
+        of the device, and comparing rows from flush N against tallies
+        from flush N+1 would misread healthy docs as desynced. When the
+        plane has already fetched the rows this cycle (_sync_health),
+        this costs no device I/O at all."""
+        plane = self.plane
+        with plane._step_lock:
+            if plane.last_lengths is not None:
+                self._length_cache = plane.last_lengths
+                self._overflow_cache = plane.last_overflows
+            else:
+                self._length_cache = np.asarray(plane.state.length)
+                self._overflow_cache = np.asarray(plane.state.overflow)
+            self._validated_cache = plane.validated_units.copy()
+            self._gen_cache = None if plane.last_gen is None else plane.last_gen.copy()
 
     def _lengths(self) -> np.ndarray:
         if self._length_cache is None:
@@ -128,7 +147,22 @@ class PlaneServing:
             return None
         if doc.lowerer.unsupported:
             return None
-        if not plane.check_doc_health(name, doc, self._lengths(), self._overflows()):
+        if self._length_cache is None:
+            # no completed flush has been adopted yet — there is nothing
+            # to validate against, and the broadcast path must NEVER
+            # block on the step lock / pull device state on the event
+            # loop (a first flush may be mid-executor right now). The
+            # post-flush sweep covers these docs the moment a snapshot
+            # exists.
+            return doc
+        if not plane.check_doc_health(
+            name,
+            doc,
+            self._length_cache,
+            self._overflow_cache,
+            self._validated_cache,
+            self._gen_cache,
+        ):
             return None
         return doc
 

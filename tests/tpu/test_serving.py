@@ -54,11 +54,18 @@ async def test_sync_reply_served_from_device_state(monkeypatch):
         await server.destroy()
 
 
-async def test_broadcast_is_batched_through_device_flush():
-    """With a long flush interval, edits reach peers only after the device
-    flush — proof the per-update CPU fan-out was suppressed and replaced
-    by the plane's merged broadcast."""
-    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1500, serve=True)
+async def test_broadcast_is_batched_through_coalescing_window():
+    """With a long broadcast window, edits reach peers only when the
+    window closes — proof the per-update CPU fan-out was suppressed and
+    replaced by the plane's merged (coalesced) broadcast. The device
+    flush runs on its own cadence and does not gate delivery."""
+    ext = TpuMergeExtension(
+        num_docs=8,
+        capacity=1024,
+        flush_interval_ms=1,
+        broadcast_interval_ms=1500,
+        serve=True,
+    )
     server = await new_hocuspocus(extensions=[ext])
     provider_a = new_provider(server, name="batched")
     provider_b = new_provider(server, name="batched")
@@ -66,9 +73,9 @@ async def test_broadcast_is_batched_through_device_flush():
         await wait_synced(provider_a, provider_b)
         text_b = provider_b.document.get_text("body")
         provider_a.document.get_text("body").insert(0, "deferred")
-        # the update reaches the server well before the 1.5 s flush, and
-        # must NOT have been fan-out broadcast immediately (generous
-        # margins so a loaded CI host can't blur the two paths)
+        # the update reaches the server well before the 1.5 s window
+        # closes, and must NOT have been fan-out broadcast immediately
+        # (generous margins so a loaded CI host can't blur the two paths)
         await asyncio.sleep(0.3)
         assert text_b.to_string() == ""
         await retryable_assertion(lambda: _assert(text_b.to_string() == "deferred"))
@@ -137,12 +144,21 @@ async def test_map_content_served_from_plane():
                 and provider_a.document.get_map("m").get("k2") == "w"
             )
         )
+        # a map-tombstone-ONLY update (key deletion, no inserts) must
+        # still dirty the doc and broadcast through the plane — the
+        # deletion's serve-log record is the whole payload
+        provider_a.document.get_map("m").delete("k2")
+        await retryable_assertion(
+            lambda: _assert(provider_b.document.get_map("m").get("k2") is None)
+        )
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        assert "mapdoc" in ext._docs
         # late joiner syncs from the plane
         serves_before = ext.plane.counters["sync_serves"]
         provider_c = new_provider(server, name="mapdoc")
         await wait_synced(provider_c)
         assert provider_c.document.get_map("m").get("k") == "v2"
-        assert provider_c.document.get_map("m").get("k2") == "w"
+        assert provider_c.document.get_map("m").get("k2") is None
         assert ext.plane.counters["sync_serves"] > serves_before
         provider_c.destroy()
     finally:
@@ -165,9 +181,12 @@ async def test_forced_desync_detected_and_recovered():
         await retryable_assertion(
             lambda: _assert(provider_b.document.get_text("body").to_string() == "healthy")
         )
-        # corrupt: host log claims a unit the device never integrated
+        # corrupt: the host dispatch tally claims a unit the device
+        # never integrated (the shape of a device-side op rejection —
+        # the next flush's validated snapshot adopts the lie and the
+        # health check sees device length != validated units)
         (slot,) = ext.plane.docs["desynced"].seqs.values()
-        ext.plane.unit_logs[slot].append(ord("x"))
+        ext.plane.dispatched_units[slot] += 1
 
         provider_a.document.get_text("body").insert(7, " again")
 
