@@ -41,11 +41,22 @@ async def server_storm(num_docs: int, waves: int) -> dict:
 
     from _common import wait_synced
 
+    from hocuspocus_tpu.extensions import SQLite
+
+    # BASELINE config 5 is "database snapshot load + state-vector diff
+    # replay": persistence is part of the config. It also makes the
+    # storm robust — docs that unload between waves (store debounce
+    # fired, all connections gone) reload from their snapshot instead
+    # of silently coming back empty when waves outlast the debounce.
     ext = TpuMergeExtension(
         num_docs=num_docs * 2, capacity=8192, flush_interval_ms=2.0, serve=True
     )
     server = Server(
-        Configuration(quiet=True, extensions=[ext], unload_immediately=False)
+        Configuration(
+            quiet=True,
+            extensions=[SQLite(), ext],
+            unload_immediately=False,
+        )
     )
     await server.listen(port=0)
     url = server.web_socket_url
@@ -178,8 +189,12 @@ def main() -> None:
     apply_update(probe, served)
     assert probe.get_text("t").to_string() == full_text
 
+    serving.warmup_gathers()  # a live server compiles these at listen
     t0 = time.perf_counter()
     served_bytes = 0
+    # what the live storm path does per drain: one gathered tombstone
+    # read for the whole doc batch instead of a per-slot RTT each
+    serving.prefetch_tombstones([plane.docs[f"cold-{d}"] for d in range(plane_docs)])
     for i in range(catchups):
         name = f"cold-{i % plane_docs}"
         sv = None if i % 2 == 0 else mid_sv  # alternate cold / stale

@@ -53,6 +53,8 @@ from ..crdt.content import (
 )
 from ..crdt.delete_set import DeleteSet
 from ..crdt.encoding import Decoder
+import numpy as np
+
 from ..crdt.ids import ID
 from ..crdt.structs import GC, Item, Skip
 from ..crdt.update import _read_client_struct_refs
@@ -576,9 +578,11 @@ def _utf16_len(s: str) -> int:
 
 def _utf16_units(s: str) -> list[int]:
     data = s.encode("utf-16-le", errors="replace")
-    return [int.from_bytes(data[i : i + 2], "little") for i in range(0, len(data), 2)]
+    return np.frombuffer(data, np.uint16).tolist()
 
 
 def units_to_text(units) -> str:
-    data = b"".join(int(u).to_bytes(2, "little") for u in units)
-    return data.decode("utf-16-le", errors="replace")
+    # vectorized: serve-path item encodes call this once per run (up to
+    # thousands of units); the per-unit to_bytes/join version was the
+    # top cost of a warm catch-up serve
+    return np.asarray(units, np.uint16).tobytes().decode("utf-16-le", errors="replace")

@@ -144,6 +144,10 @@ class MergePlane:
         # of the slot and must not condemn the new one.
         self.slot_gen = np.zeros(num_docs, np.int64)
         self.last_gen: Optional[np.ndarray] = None
+        # bumped whenever device state may have changed (a flush cycle
+        # completed, a slot was cleared): consumers caching device
+        # readbacks (serving's tombstone cache) key on (slot_gen, this)
+        self.flush_epoch = 0
         # docs with new serve-log records since the last broadcast pass
         self.dirty: set[str] = set()
         # last combined health readback (see _sync_health): the remote-
@@ -249,6 +253,7 @@ class MergePlane:
                 for field, empty_field in zip(self.state, empty)
             )
         )
+        self.flush_epoch += 1
 
     def is_supported(self, name: str) -> bool:
         doc = self.docs.get(name)
@@ -450,6 +455,7 @@ class MergePlane:
         self.last_overflows = combined[self.num_docs :].astype(bool)
         self.validated_units = self.dispatched_units.copy()
         self.last_gen = self.slot_gen.copy()
+        self.flush_epoch += 1
 
     def _build_batch(self, k: int) -> "tuple[OpBatch, int]":
         d = self.num_docs
@@ -729,6 +735,14 @@ class TpuMergeExtension(Extension):
 
                     _logger_mod.log_error("plane compile warmup failed (continuing)")
                     return
+            if self.serving is not None:
+                try:
+                    async with self.plane.flush_lock:
+                        await loop.run_in_executor(None, self.serving.warmup_gathers)
+                except Exception:
+                    from ..server import logger as _logger_mod
+
+                    _logger_mod.log_error("gather warmup failed (continuing)")
 
         task = asyncio.ensure_future(warm())
         self._flush_tasks.add(task)
@@ -759,16 +773,42 @@ class TpuMergeExtension(Extension):
 
     async def after_unload_document(self, data: Payload) -> None:
         name = data.document_name
-        document = self._docs.pop(name, None)
-        if document is not None:
-            document.sync_source = None
-            document.broadcast_source = None
-        if self.serving is not None:
-            self.serving.broadcast_cursor.pop(name, None)
+        instance = data.instance
         # release mutates the queue/log registries a concurrent
-        # executor-side flush iterates — serialize with it
-        async with self.plane.flush_lock:
-            self.plane.release(name)
+        # executor-side flush iterates — serialize with it. ALL of the
+        # teardown sits inside the lock and behind a liveness re-check:
+        # a rejoin can re-load the document while unload hooks await,
+        # and plane.register() then reuses this registration (same
+        # rows, same lowerer clocks — the arena already mirrors the
+        # doc), so a late release here would silently detach the NEW
+        # incarnation from the plane for the rest of its life.
+        while True:
+            async with self.plane.flush_lock:
+                loading = (
+                    None if instance is None else instance.loading_documents.get(name)
+                )
+                if loading is None:
+                    if instance is not None and name in instance.documents:
+                        return  # re-loaded while we waited: registration lives on
+                    document = self._docs.pop(name, None)
+                    if document is not None:
+                        document.sync_source = None
+                        document.broadcast_source = None
+                    if self.serving is not None:
+                        self.serving.forget(name, self.plane.docs.get(name))
+                    self.plane.release(name)
+                    return
+            # A re-load is in flight. Wait for it OUTSIDE the lock: on
+            # success its own eventual unload fires this hook again; on
+            # FAILURE no further after_unload will ever fire for this
+            # name (failed loads never enter instance.documents), so we
+            # must loop back and do the teardown ourselves or the plane
+            # registration leaks forever.
+            try:
+                await asyncio.shield(loading)
+                return
+            except Exception:
+                pass
 
     async def on_destroy(self, data: Payload) -> None:
         if self._flush_handle is not None:
@@ -806,6 +846,8 @@ class TpuMergeExtension(Extension):
             return  # already degraded
         document.sync_source = None
         document.broadcast_source = None
+        if self.serving is not None:
+            self.serving.forget(name, self.plane.docs.get(name))
         if name in self.plane.docs:
             self.plane.retire_doc(name, "fallback")
         self.plane.counters["cpu_fallbacks"] += 1

@@ -93,6 +93,15 @@ class PlaneServing:
         self._overflow_cache: Optional[np.ndarray] = None
         self._validated_cache: Optional[np.ndarray] = None
         self._gen_cache: Optional[np.ndarray] = None
+        # slot -> ((slot_gen, flush_epoch), sorted deleted (client,
+        # clock) pairs): see _slot_deleted_pairs
+        self._tombstone_cache: dict[int, tuple] = {}
+        # doc name -> (PlaneDoc identity, (log_len, tomb_len), bytes):
+        # every cold joiner of a doc receives the SAME SyncStep2 (sync
+        # serves drain the queues first, so the payload is a pure
+        # function of the serve log) — a reconnect storm re-encodes
+        # once per doc state, not once per joiner
+        self._cold_sync_cache: dict[str, tuple] = {}
         # catch-up batching: SyncStep1s that arrive in the same storm
         # window are triaged by ONE state_vector_diff kernel call
         self._catchup_queue: list[tuple] = []  # (name, document, sv_bytes, future)
@@ -137,6 +146,19 @@ class PlaneServing:
         if self._overflow_cache is None:
             self.refresh()
         return self._overflow_cache
+
+    def forget(self, name: str, doc: Optional[PlaneDoc]) -> None:
+        """Drop every per-doc serving cache at unload/degrade time.
+
+        The cold-sync cache holds a strong ref to the PlaneDoc (and its
+        whole serve log); without eviction a server that churns through
+        transient doc names leaks each one forever.
+        """
+        self.broadcast_cursor.pop(name, None)
+        self._cold_sync_cache.pop(name, None)
+        if doc is not None:
+            for slot in doc.seqs.values():
+                self._tombstone_cache.pop(slot, None)
 
     # -- health -------------------------------------------------------------
 
@@ -207,23 +229,131 @@ class PlaneServing:
             items.sort(key=lambda item: item.id.clock)
         return by
 
+    def _slot_deleted_pairs(self, slot: int) -> "list[tuple[int, int]]":
+        """Sorted (client, clock) pairs of the slot's device tombstones.
+
+        Cached per (slot binding generation, flush epoch): tombstone
+        rows only change when a flush integrates ops or the slot is
+        cleared, so a catch-up storm hitting the same doc repeatedly —
+        or many docs across waves — pays the device fetch once per
+        epoch, not once per serve (~a full RTT per transfer on a
+        remote-attached chip). The miss path fuses the three row reads
+        (deleted mask, client ids, clocks) into ONE transfer.
+        """
+        plane = self.plane
+        key = (int(plane.slot_gen[slot]), plane.flush_epoch)
+        cached = self._tombstone_cache.get(slot)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        self._fetch_slot_rows([slot], plane.flush_epoch)
+        return self._tombstone_cache[slot][1]
+
+    def prefetch_tombstones(self, docs: "list[PlaneDoc]") -> None:
+        """Fill the tombstone cache for every slot of `docs` in ONE
+        fused device transfer.
+
+        A reconnect storm serves tens of docs in one drain; fetching
+        each slot's rows individually costs ~a full RTT per slot on a
+        remote-attached chip. One gathered (3, B, N) read costs one.
+        """
+        plane = self.plane
+        epoch = plane.flush_epoch
+        slots = sorted(
+            {
+                slot
+                for doc in docs
+                for slot in doc.seqs.values()
+                if (
+                    (cached := self._tombstone_cache.get(slot)) is None
+                    or cached[0] != (int(plane.slot_gen[slot]), epoch)
+                )
+            }
+        )
+        if not slots:
+            return
+        # fixed gather widths: exactly two compiled programs (small
+        # drains don't transfer a big batch; big storms chunk), instead
+        # of one XLA compile (seconds, remote) per distinct slot count
+        for pos_chunk in self._gather_chunks(slots):
+            self._fetch_slot_rows(pos_chunk, epoch)
+
+    def _gather_widths(self) -> "list[int]":
+        """Fixed width ladder, capped at the plane size (pow2): a small
+        drain transfers a small batch, a storm fuses into few big ones,
+        and the compile count stays at len(ladder)."""
+        cap = 1
+        while cap < min(self.plane.num_docs, 256):
+            cap *= 2
+        widths = [w for w in (16, 64) if w < cap]
+        widths.append(cap)
+        return widths
+
+    def _gather_chunks(self, slots: "list[int]") -> "list[list[int]]":
+        biggest = self._gather_widths()[-1]
+        chunks = []
+        pos = 0
+        while pos < len(slots):
+            chunks.append(slots[pos : pos + biggest])
+            pos += biggest
+        return chunks
+
+    def _fetch_slot_rows(self, chunk: "list[int]", epoch: int) -> None:
+        import jax.numpy as jnp
+
+        plane = self.plane
+        width = next(w for w in self._gather_widths() if w >= len(chunk))
+        with plane._step_lock:  # never gather donated buffers mid-flush
+            state = plane.state
+            idx = jnp.asarray(chunk + [chunk[0]] * (width - len(chunk)), jnp.int32)
+            fused = np.asarray(
+                jnp.stack(
+                    [
+                        state.deleted[idx].astype(jnp.int32),
+                        state.id_client[idx].view(jnp.int32),
+                        state.id_clock[idx],
+                    ]
+                )
+            )
+            gens = [int(plane.slot_gen[slot]) for slot in chunk]
+        for i, slot in enumerate(chunk):
+            sel = np.nonzero(fused[0, i])[0]
+            clients = fused[1, i][sel].view(np.uint32)
+            clocks = fused[2, i][sel]
+            pairs = sorted(zip(clients.tolist(), clocks.tolist()))
+            self._tombstone_cache[slot] = ((gens[i], epoch), pairs)
+
+    def warmup_gathers(self) -> None:
+        """Compile the tombstone-gather programs (one per fixed width)
+        so the first reconnect storm pays data transfer, not XLA
+        compile time. Run from the extension's listen-time warm task."""
+        import jax.numpy as jnp
+
+        plane = self.plane
+        with plane._step_lock:
+            state = plane.state
+            for width in self._gather_widths():
+                idx = jnp.zeros((width,), jnp.int32)
+                np.asarray(
+                    jnp.stack(
+                        [
+                            state.deleted[idx].astype(jnp.int32),
+                            state.id_client[idx].view(jnp.int32),
+                            state.id_clock[idx],
+                        ]
+                    )
+                )
+
     def _device_delete_set(self, doc: PlaneDoc) -> DeleteSet:
         """Tombstones as the DEVICE sees them, across every row of the
         doc, plus host-applied map-item tombstones."""
-        state = self.plane.state
         lengths = self._lengths()
         ds = DeleteSet()
         for slot in doc.seqs.values():
-            length = int(lengths[slot])
-            if length == 0:
+            if int(lengths[slot]) == 0:
                 continue
-            deleted = np.asarray(state.deleted[slot])[:length]
-            if not deleted.any():
+            pairs = self._slot_deleted_pairs(slot)
+            if not pairs:
                 continue
-            sel = np.nonzero(deleted)[0]
-            clients = np.asarray(state.id_client[slot])[sel]
-            clocks = np.asarray(state.id_clock[slot])[sel]
-            pairs = sorted(zip(clients.tolist(), clocks.tolist()))
             run_client, run_start, run_len = pairs[0][0], pairs[0][1], 1
             for client, clock in pairs[1:]:
                 if client == run_client and clock == run_start + run_len:
@@ -239,6 +369,15 @@ class PlaneServing:
 
     def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
         """SyncStep2 bytes for a doc given the per-client cutoff map."""
+        cold = len(sm) == len(doc.lowerer.known) and all(
+            clock == 0 for clock in sm.values()
+        )
+        key = (len(doc.serve_log), len(doc.map_tombstones))
+        if cold:
+            cached = self._cold_sync_cache.get(doc.name)
+            if cached is not None and cached[0] is doc and cached[1] == key:
+                self.plane.counters["sync_serves"] += 1
+                return cached[2]
         items_by_client = self._group_items(doc, doc.serve_log, sm)
         encoder = Encoder()
         encoder.write_var_uint(len(items_by_client))
@@ -246,7 +385,10 @@ class PlaneServing:
             _write_structs(encoder, items_by_client[client], client, sm[client])
         self._device_delete_set(doc).write(encoder)
         self.plane.counters["sync_serves"] += 1
-        return encoder.to_bytes()
+        payload = encoder.to_bytes()
+        if cold:
+            self._cold_sync_cache[doc.name] = (doc, key, payload)
+        return payload
 
     def encode_state_as_update(
         self, name: str, document, sv_bytes: Optional[bytes] = None
@@ -364,6 +506,13 @@ class PlaneServing:
                 rows.append((doc, local_sv, target_sv, columns, future))
             if not rows:
                 return
+            # one gathered device read covers every doc in the batch —
+            # the storm's delete-set reads must not pay per-slot RTTs,
+            # and the transfer runs OFF the loop like every device step
+            batch_docs = [row[0] for row in rows]
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.prefetch_tombstones(batch_docs)
+            )
             if len(rows) == 1:
                 # lone reconnect (the steady-state case): the host dict
                 # diff costs microseconds — save the kernel dispatch and
