@@ -578,11 +578,14 @@ def _utf16_len(s: str) -> int:
 
 def _utf16_units(s: str) -> list[int]:
     data = s.encode("utf-16-le", errors="replace")
-    return np.frombuffer(data, np.uint16).tolist()
+    return np.frombuffer(data, np.dtype("<u2")).tolist()
 
 
 def units_to_text(units) -> str:
     # vectorized: serve-path item encodes call this once per run (up to
     # thousands of units); the per-unit to_bytes/join version was the
-    # top cost of a warm catch-up serve
-    return np.asarray(units, np.uint16).tobytes().decode("utf-16-le", errors="replace")
+    # top cost of a warm catch-up serve. Explicit little-endian dtype:
+    # the bytes feed/come from utf-16-le regardless of host endianness.
+    return (
+        np.asarray(units, np.dtype("<u2")).tobytes().decode("utf-16-le", errors="replace")
+    )

@@ -700,6 +700,7 @@ class TpuMergeExtension(Extension):
         self.broadcast_interval_ms = broadcast_interval_ms
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._broadcast_handle: Optional[asyncio.TimerHandle] = None
+        self._last_broadcast_at = 0.0
         self.serve = serve
         self.serving = None
         self._docs: dict[str, object] = {}  # name -> server Document being served
@@ -808,7 +809,12 @@ class TpuMergeExtension(Extension):
                 await asyncio.shield(loading)
                 return
             except Exception:
-                pass
+                # an already-failed future raises without suspending;
+                # yield so create_document's finally (which pops
+                # loading_documents) runs before we re-check — without
+                # this the loop can spin forever without ever letting
+                # the event loop breathe
+                await asyncio.sleep(0)
 
     async def on_destroy(self, data: Payload) -> None:
         if self._flush_handle is not None:
@@ -992,11 +998,19 @@ class TpuMergeExtension(Extension):
     def _schedule_broadcast(self) -> None:
         if not self.serve or self._broadcast_handle is not None:
             return
+        loop = asyncio.get_event_loop()
 
         def run() -> None:
             self._broadcast_handle = None
+            self._last_broadcast_at = loop.time()
             self._broadcast_served()
 
-        self._broadcast_handle = asyncio.get_event_loop().call_later(
-            self.broadcast_interval_ms / 1000, run
+        # coalescing window only under sustained traffic: a lone edit
+        # after an idle gap broadcasts on the next loop tick (the
+        # window would be pure added latency), while back-to-back edits
+        # within the window share one frame per doc
+        window = self.broadcast_interval_ms / 1000
+        idle = loop.time() - self._last_broadcast_at
+        self._broadcast_handle = loop.call_later(
+            0 if idle >= window else window, run
         )

@@ -1,0 +1,132 @@
+"""Unload/reload lifecycle races against the plane registration.
+
+The unload teardown runs asynchronously (it serializes with executor-
+side flushes), so a client can re-load a document while the hooks are
+still in flight. These tests pin the three outcomes:
+
+- a rejoin racing the unload keeps the (reused) plane registration
+  serving — a late release must not silently detach the new
+  incarnation to the CPU path for the rest of its life;
+- when no rejoin happens, EVERYTHING drains: plane rows, queues,
+  logs, serving caches (the cold-sync cache holds a strong ref to the
+  PlaneDoc, so a missed eviction is an unbounded leak under doc-name
+  churn);
+- a rejoin whose load FAILS still drains (a failed load never enters
+  the document registry, so no further after_unload ever fires for
+  that name — the teardown must clean up on the failed load's behalf).
+
+Reference lifecycle being mirrored: unload on last disconnect +
+onLoadDocument failure closing connections
+(`packages/server/src/Hocuspocus.ts:206-235,373-377,489-505`).
+"""
+
+import asyncio
+
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from tests.utils import new_hocuspocus, new_provider, wait_synced
+
+
+async def _wait(cond, timeout: float = 10.0) -> None:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError
+        await asyncio.sleep(0.01)
+
+
+async def test_rejoin_racing_unload_keeps_plane_serving():
+    """Disconnect-all then immediately rejoin: the doc must still be
+    plane-served (sync_serves advances, registration intact)."""
+    ext = TpuMergeExtension(num_docs=8, capacity=2048, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(
+        extensions=[ext], unload_immediately=False, debounce=30, max_debounce=60
+    )
+    provider = new_provider(server, name="racer")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "survives the race")
+        await asyncio.sleep(0.2)
+        provider.destroy()
+        # rejoin as fast as possible while unload hooks are in flight
+        await _wait(lambda: "racer" not in server.documents)
+        rejoin = new_provider(server, name="racer")
+        await wait_synced(rejoin)
+        assert rejoin.document.get_text("t").to_string() == "survives the race"
+        # settle any late teardown, then prove the plane still serves
+        await asyncio.sleep(0.3)
+        assert ext.plane.is_supported("racer"), dict(ext.plane.counters)
+        assert "racer" in ext._docs
+        before = ext.plane.counters["sync_serves"]
+        third = new_provider(server, name="racer")
+        await wait_synced(third)
+        assert ext.plane.counters["sync_serves"] > before
+        third.destroy()
+        rejoin.destroy()
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_unload_drains_plane_rows_and_serving_caches():
+    ext = TpuMergeExtension(num_docs=8, capacity=2048, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(
+        extensions=[ext], unload_immediately=False, debounce=30, max_debounce=60
+    )
+    writer = new_provider(server, name="transient")
+    try:
+        await wait_synced(writer)
+        writer.document.get_text("t").insert(0, "short-lived")
+        await asyncio.sleep(0.2)
+        # cold joiner populates the cold-sync byte cache
+        joiner = new_provider(server, name="transient")
+        await wait_synced(joiner)
+        assert "transient" in ext.serving._cold_sync_cache
+        writer.destroy()
+        joiner.destroy()
+        await _wait(
+            lambda: not ext.plane.docs and not ext.serving._cold_sync_cache
+        )
+        assert len(ext.plane.free) == 8
+        assert not ext.plane.queues and not ext.plane.unit_logs
+        assert not ext.serving._tombstone_cache
+        assert "transient" not in ext.serving.broadcast_cursor
+    finally:
+        await server.destroy()
+
+
+async def test_failed_reload_during_unload_still_drains():
+    """Rejoin races the unload but its load hook FAILS: the teardown
+    must still run (no later after_unload will) — no leaked rows."""
+    ext = TpuMergeExtension(num_docs=8, capacity=2048, flush_interval_ms=1, serve=True)
+    fail = {"on": False}
+
+    async def on_load_document(data):
+        if fail["on"]:
+            raise RuntimeError("persistence down")
+
+    server = await new_hocuspocus(
+        extensions=[ext],
+        unload_immediately=False,
+        debounce=30,
+        max_debounce=60,
+        on_load_document=on_load_document,
+    )
+    provider = new_provider(server, name="doomed")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "x")
+        await asyncio.sleep(0.15)
+        fail["on"] = True
+        provider.destroy()
+        await _wait(lambda: "doomed" not in server.documents)
+        # the racing rejoin's load fails (connection just closes)
+        rejoin = new_provider(server, name="doomed")
+        await asyncio.sleep(0.5)
+        rejoin.destroy()
+        await _wait(lambda: not ext.plane.docs, 10)
+        assert len(ext.plane.free) == 8
+        assert not ext.serving._cold_sync_cache
+    finally:
+        fail["on"] = False
+        provider.destroy()
+        await server.destroy()
