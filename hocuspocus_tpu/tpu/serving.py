@@ -367,6 +367,84 @@ class PlaneServing:
         ds.sort_and_merge()
         return ds
 
+    def _encode_window_native(
+        self,
+        doc: PlaneDoc,
+        records: list[LogRec],
+        min_clock: Optional[dict[int, int]],
+    ) -> Optional[bytes]:
+        """Struct-section bytes via the native `encode_text_window`, or
+        None = use the Python path.
+
+        The semantic work of `_group_items` + `crdt/update._write_structs`
+        — cutoff trimming (the record filter below), group ordering,
+        the first-item offset with its origin rewrite and payload slice
+        — happens HERE; the C++ side is pure byte emission. Only the
+        shapes the plane serves hot qualify (string runs, deleted runs,
+        GC ranges, root parents); any rich content (formats, embeds,
+        maps, ID parents) returns None and the caller re-encodes via
+        Items.
+        """
+        from ..native import get_codec
+
+        codec = get_codec()
+        if codec is None or not hasattr(codec, "encode_text_window"):
+            return None
+        unit_logs = self.plane.unit_logs
+        by: dict[int, list[LogRec]] = {}
+        for rec in records:
+            op = rec.op
+            if op.kind != KIND_INSERT:
+                continue
+            if min_clock is not None:
+                cutoff = min_clock.get(op.client)
+                if cutoff is None or op.clock + op.run_len <= cutoff:
+                    continue
+            if op.content is not None or op.parent_sub is not None:
+                return None
+            if op.parent is not None and op.parent[0] != "root":
+                return None
+            by.setdefault(op.client, []).append(rec)
+        groups = []
+        for client in sorted(by, reverse=True):
+            recs = sorted(by[client], key=lambda r: r.op.clock)
+            cutoff = 0 if min_clock is None else min_clock[client]
+            # the filter above kept only records overlapping the cutoff,
+            # so recs[0] is the group's first emitted struct
+            write_clock = max(cutoff, recs[0].op.clock)
+            items = []
+            for j, rec in enumerate(recs):
+                op = rec.op
+                offset = max(write_clock - op.clock, 0) if j == 0 else 0
+                if op.gc:
+                    items.append((1, -1, 0, -1, 0, None, op.run_len - offset))
+                    continue
+                oc = -1 if op.left_client == NONE_CLIENT else op.left_client
+                ok = op.left_clock
+                rc = -1 if op.right_client == NONE_CLIENT else op.right_client
+                rk = op.right_clock
+                if offset > 0:
+                    # emitting a tail of the run: its origin is the unit
+                    # just before the cut (Item.write offset semantics)
+                    oc, ok = client, write_clock - 1
+                parent_name = None
+                if oc < 0 and rc < 0:
+                    if op.parent is None:
+                        return None
+                    parent_name = op.parent[1]
+                if op.deleted_content:
+                    items.append(
+                        (2, oc, ok, rc, rk, parent_name, op.run_len - offset)
+                    )
+                    continue
+                log = unit_logs[rec.slot]
+                payload = units_to_text(
+                    log[rec.unit_off + offset : rec.unit_off + op.run_len]
+                )
+                items.append((0, oc, ok, rc, rk, parent_name, payload))
+            groups.append((client, write_clock, items))
+        return codec.encode_text_window(groups)
+
     def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
         """SyncStep2 bytes for a doc given the per-client cutoff map."""
         cold = len(sm) == len(doc.lowerer.known) and all(
@@ -378,11 +456,15 @@ class PlaneServing:
             if cached is not None and cached[0] is doc and cached[1] == key:
                 self.plane.counters["sync_serves"] += 1
                 return cached[2]
-        items_by_client = self._group_items(doc, doc.serve_log, sm)
         encoder = Encoder()
-        encoder.write_var_uint(len(items_by_client))
-        for client in sorted(items_by_client, reverse=True):
-            _write_structs(encoder, items_by_client[client], client, sm[client])
+        body = self._encode_window_native(doc, doc.serve_log, sm)
+        if body is not None:
+            encoder.write_bytes(body)
+        else:
+            items_by_client = self._group_items(doc, doc.serve_log, sm)
+            encoder.write_var_uint(len(items_by_client))
+            for client in sorted(items_by_client, reverse=True):
+                _write_structs(encoder, items_by_client[client], client, sm[client])
         self._device_delete_set(doc).write(encoder)
         self.plane.counters["sync_serves"] += 1
         payload = encoder.to_bytes()
@@ -593,19 +675,26 @@ class PlaneServing:
         if not window:
             self.broadcast_cursor[name] = len(log)
             return None
-        by = self._group_items(doc, window)
         window_ds = DeleteSet()
+        has_inserts = False
         for rec in window:
             if rec.op.kind == KIND_DELETE:
                 window_ds.add(rec.op.client, rec.op.clock, rec.op.run_len)
-        if not by and not window_ds.clients:
+            elif rec.op.kind == KIND_INSERT:
+                has_inserts = True
+        if not has_inserts and not window_ds.clients:
             self.broadcast_cursor[name] = len(log)
             return None
         encoder = Encoder()
-        encoder.write_var_uint(len(by))
-        for client in sorted(by, reverse=True):
-            items = by[client]
-            _write_structs(encoder, items, client, items[0].id.clock)
+        body = self._encode_window_native(doc, window, None)
+        if body is not None:
+            encoder.write_bytes(body)
+        else:
+            by = self._group_items(doc, window)
+            encoder.write_var_uint(len(by))
+            for client in sorted(by, reverse=True):
+                items = by[client]
+                _write_structs(encoder, items, client, items[0].id.clock)
         window_ds.sort_and_merge()
         window_ds.write(encoder)
         self.broadcast_cursor[name] = len(log)

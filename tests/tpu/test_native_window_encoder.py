@@ -1,0 +1,170 @@
+"""Native window encoder ≡ Python Item encoder, byte for byte.
+
+`native/codec.cpp encode_text_window` emits the struct section for the
+shapes the plane serves hot (string runs, GC ranges, root parents);
+`serving._resolve_native_groups` does the semantic work. These tests
+pin byte-identity against the Python `_write_structs`/`Item.write`
+path across origins, cutoff offsets, multi-client groups and GC —
+plus the fallback decision for rich content.
+
+Encode mirror of the reference's lib0/yjs write layer
+(`packages/server/src/OutgoingMessage.ts` + yjs UpdateEncoderV1).
+"""
+
+import random
+
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.native import get_codec
+from hocuspocus_tpu.tpu.merge_plane import MergePlane
+from hocuspocus_tpu.tpu.serving import PlaneServing
+
+pytestmark = pytest.mark.skipif(
+    get_codec() is None or not hasattr(get_codec(), "encode_text_window"),
+    reason="native codec unavailable",
+)
+
+
+def _seeded_plane(num_docs=4, capacity=2048):
+    plane = MergePlane(num_docs=num_docs, capacity=capacity)
+    serving = PlaneServing(plane)
+    return plane, serving
+
+
+def _python_bytes(serving, doc, sm):
+    """Force the Python Item path for the same cutoff map."""
+    from hocuspocus_tpu.crdt.encoding import Encoder
+    from hocuspocus_tpu.crdt.update import _write_structs
+
+    items_by_client = serving._group_items(doc, doc.serve_log, sm)
+    encoder = Encoder()
+    encoder.write_var_uint(len(items_by_client))
+    for client in sorted(items_by_client, reverse=True):
+        _write_structs(encoder, items_by_client[client], client, sm[client])
+    serving._device_delete_set(doc).write(encoder)
+    return encoder.to_bytes()
+
+
+def _native_bytes(serving, doc, sm):
+    from hocuspocus_tpu.crdt.encoding import Encoder
+
+    body = serving._encode_window_native(doc, doc.serve_log, sm)
+    assert body is not None, "expected the native fast path to qualify"
+    encoder = Encoder()
+    encoder.write_bytes(body)
+    serving._device_delete_set(doc).write(encoder)
+    return encoder.to_bytes()
+
+
+def _full_sm(doc):
+    return {client: 0 for client in doc.lowerer.known}
+
+
+def test_multi_client_interleaved_edits_encode_identically():
+    source_a, source_b = Doc(), Doc()
+    source_a.client_id, source_b.client_id = 7, 1_000_000
+    text_a = source_a.get_text("body")
+    text_a.insert(0, "hello world, this is a longer run of text")
+    apply_update(source_b, encode_state_as_update(source_a))
+    source_b.get_text("body").insert(5, " INTERLEAVED")
+    source_b.get_text("body").delete(0, 2)
+    apply_update(source_a, encode_state_as_update(source_b))
+    text_a.insert(20, " more")
+
+    plane, serving = _seeded_plane()
+    plane.register("d")
+    plane.enqueue_update("d", encode_state_as_update(source_a))
+    plane.flush()
+    serving.refresh()
+    doc = plane.docs["d"]
+    sm = _full_sm(doc)
+    native = _native_bytes(serving, doc, sm)
+    python = _python_bytes(serving, doc, sm)
+    assert native == python
+    # and the bytes actually reproduce the document
+    probe = Doc()
+    apply_update(probe, native)
+    assert probe.get_text("body").to_string() == text_a.to_string()
+
+
+def test_cutoff_offsets_slice_runs_identically():
+    """Stale joiners whose cutoff lands MID-RUN exercise the offset
+    origin-rewrite + payload slice."""
+    source = Doc()
+    source.client_id = 42
+    text = source.get_text("t")
+    for i in range(8):
+        text.insert(len(text), f"chunk-{i:02d}-" + "x" * random.Random(i).randint(1, 9))
+
+    plane, serving = _seeded_plane()
+    plane.register("d")
+    plane.enqueue_update("d", encode_state_as_update(source))
+    plane.flush()
+    serving.refresh()
+    doc = plane.docs["d"]
+    top = doc.lowerer.known[42]
+    for cutoff in (0, 1, 5, top // 2, top - 1):
+        sm = {42: cutoff}
+        native = _native_bytes(serving, doc, sm)
+        python = _python_bytes(serving, doc, sm)
+        assert native == python, cutoff
+        # served tail applies cleanly on top of a doc synced to `cutoff`
+        probe = Doc()
+        apply_update(probe, native)
+
+
+def test_surrogate_pair_payloads_encode_identically():
+    source = Doc()
+    source.client_id = 9
+    text = source.get_text("t")
+    text.insert(0, "astral: \U0001f600\U0001f680 done")
+
+    plane, serving = _seeded_plane()
+    plane.register("d")
+    plane.enqueue_update("d", encode_state_as_update(source))
+    plane.flush()
+    serving.refresh()
+    doc = plane.docs["d"]
+    sm = _full_sm(doc)
+    assert _native_bytes(serving, doc, sm) == _python_bytes(serving, doc, sm)
+
+
+def test_rich_content_falls_back_to_python_path():
+    source = Doc()
+    source.client_id = 3
+    source.get_map("m").set("k", "v")  # map entry: host-side, not stringy
+
+    plane, serving = _seeded_plane()
+    plane.register("d")
+    plane.enqueue_update("d", encode_state_as_update(source))
+    plane.flush()
+    serving.refresh()
+    doc = plane.docs["d"]
+    assert serving._encode_window_native(doc, doc.serve_log, _full_sm(doc)) is None
+    # and the public encode still serves correct bytes via the fallback
+    payload = serving.encode_state_as_update("d", source, None)
+    probe = Doc()
+    apply_update(probe, payload)
+    assert probe.get_map("m").get("k") == "v"
+
+
+def test_broadcast_window_uses_native_bytes_and_converges():
+    source = Doc()
+    source.client_id = 11
+    plane, serving = _seeded_plane()
+    plane.register("d")
+    plane.enqueue_update("d", encode_state_as_update(source), presync=True)
+
+    edit = Doc()
+    edit.client_id = 11
+    text = edit.get_text("t")
+    text.insert(0, "broadcast me")
+    plane.enqueue_update("d", encode_state_as_update(edit))
+    plane.flush()
+    serving.refresh()
+    update = serving.build_broadcast("d")
+    assert update is not None
+    probe = Doc()
+    apply_update(probe, update)
+    assert probe.get_text("t").to_string() == "broadcast me"
