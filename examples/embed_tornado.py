@@ -1,0 +1,66 @@
+"""Embedding the collaboration core in a Tornado application.
+
+Same capability as the reference's alternative-host playgrounds
+(`playground/backend/src/express.ts` / `koa.ts` / `hono.ts`): any web
+framework that hands you a websocket drives the core through
+`hocuspocus.handle_connection`. Tornado's handler methods are
+callback-style; the generic `CallbackWebSocketTransport` bridges them.
+
+Run: python examples/embed_tornado.py
+"""
+
+import asyncio
+
+import tornado.web
+import tornado.websocket
+
+from hocuspocus_tpu.server import (
+    CallbackWebSocketTransport,
+    Hocuspocus,
+    RequestInfo,
+)
+
+hocuspocus = Hocuspocus()
+
+
+class CollabHandler(tornado.websocket.WebSocketHandler):
+    def open(self) -> None:
+        async def send(data: bytes) -> None:
+            await self.write_message(data, binary=True)
+
+        async def close(code: int, reason: str) -> None:
+            super(CollabHandler, self).close(code, reason)
+
+        self.transport = CallbackWebSocketTransport(send, close)
+        request_info = RequestInfo(
+            headers=dict(self.request.headers), url=self.request.uri or "/"
+        )
+        self.connection = hocuspocus.handle_connection(
+            self.transport, request_info, {"via": "tornado"}
+        )
+
+    async def on_message(self, message) -> None:
+        if isinstance(message, bytes):
+            await self.connection.handle_message(message)
+
+    def on_close(self) -> None:
+        self.transport.abort()
+        asyncio.ensure_future(
+            self.connection.handle_transport_close(self.close_code or 1000, "")
+        )
+
+
+class Index(tornado.web.RequestHandler):
+    def get(self) -> None:
+        self.write("my app with embedded collaboration at /collab")
+
+
+async def main() -> None:
+    app = tornado.web.Application([(r"/", Index), (r"/collab", CollabHandler)])
+    app.listen(8000, address="127.0.0.1")
+    print("listening on http://127.0.0.1:8000 (ws at /collab)")
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,53 @@
+"""Embedding the collaboration core under the `websockets` library.
+
+Same capability as the reference's alternative-host playgrounds
+(`playground/backend/src/express.ts` / `koa.ts` / `hono.ts` /
+`deno.ts`): the framework-agnostic core is driven through
+`hocuspocus.handle_connection(transport, request_info, context)` —
+any server that hands you a websocket works. The generic
+`CallbackWebSocketTransport` adapts the library's async send/close.
+
+Run: python examples/embed_websockets.py
+"""
+
+import asyncio
+
+import websockets
+
+from hocuspocus_tpu.server import (
+    CallbackWebSocketTransport,
+    Hocuspocus,
+    RequestInfo,
+)
+
+hocuspocus = Hocuspocus()
+
+
+async def collab(ws) -> None:
+    transport = CallbackWebSocketTransport(
+        send_async=ws.send,
+        close_async=lambda code, reason: ws.close(code=code, reason=reason),
+    )
+    request_info = RequestInfo(
+        headers=dict(ws.request.headers), url=ws.request.path
+    )
+    connection = hocuspocus.handle_connection(
+        transport, request_info, {"via": "websockets"}
+    )
+    try:
+        async for message in ws:
+            if isinstance(message, bytes):
+                await connection.handle_message(message)
+    finally:
+        transport.abort()
+        await connection.handle_transport_close(1000, "")
+
+
+async def main() -> None:
+    async with websockets.serve(collab, "127.0.0.1", 8000):
+        print("listening on ws://127.0.0.1:8000")
+        await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -6,6 +6,7 @@ from .document import Document
 from .hocuspocus import Hocuspocus, RequestInfo, REDIS_ORIGIN
 from .message_receiver import MessageReceiver
 from .server import Server
+from .transports import CallbackWebSocketTransport
 from .types import Configuration, ConnectionConfiguration, Extension, Payload
 
 __all__ = [
@@ -19,6 +20,7 @@ __all__ = [
     "REDIS_ORIGIN",
     "MessageReceiver",
     "Server",
+    "CallbackWebSocketTransport",
     "Configuration",
     "ConnectionConfiguration",
     "Extension",

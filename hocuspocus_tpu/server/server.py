@@ -18,52 +18,24 @@ from aiohttp import WSMsgType, web
 from . import logger
 from ..protocol.close_events import MESSAGE_TOO_BIG
 from .hocuspocus import Hocuspocus, RequestInfo
+from .transports import CallbackWebSocketTransport
 from .types import Configuration, Payload
 
 
-class AiohttpWebSocketTransport:
-    """Queue-backed writer over an aiohttp WebSocketResponse.
-
-    send() is synchronous (called from CRDT transaction callbacks); an
-    async writer task drains the queue preserving order.
-    """
+class AiohttpWebSocketTransport(CallbackWebSocketTransport):
+    """The generic queue-backed transport bound to an aiohttp
+    WebSocketResponse (one concurrency machinery, two hosts — see
+    transports.py)."""
 
     def __init__(self, ws: web.WebSocketResponse) -> None:
         self.ws = ws
-        self.queue: asyncio.Queue = asyncio.Queue()
-        self._closed = False
-        self._writer_task = asyncio.ensure_future(self._writer())
-
-    @property
-    def is_closed(self) -> bool:
-        return self._closed or self.ws.closed
-
-    def send(self, data: bytes) -> None:
-        if not self.is_closed:
-            self.queue.put_nowait(("data", data))
-
-    def close(self, code: int = 1000, reason: str = "") -> None:
-        if not self._closed:
-            self._closed = True
-            self.queue.put_nowait(("close", (code, reason)))
-
-    async def _writer(self) -> None:
-        while True:
-            kind, payload = await self.queue.get()
-            try:
-                if kind == "data":
-                    await self.ws.send_bytes(payload)
-                else:
-                    code, reason = payload
-                    await self.ws.close(code=code, message=reason.encode())
-                    return
-            except Exception:
-                self._closed = True
-                return
-
-    def abort(self) -> None:
-        self._closed = True
-        self._writer_task.cancel()
+        super().__init__(
+            send_async=ws.send_bytes,
+            close_async=lambda code, reason: ws.close(
+                code=code, message=reason.encode()
+            ),
+            is_closed_check=lambda: ws.closed,
+        )
 
 
 class Server:

@@ -1,0 +1,81 @@
+"""Framework-agnostic websocket transport for embedders.
+
+`Hocuspocus.handle_connection` drives any object with the transport
+interface (`is_closed`, `send(bytes)`, `close(code, reason)`,
+`abort()`). The built-in aiohttp host has its own implementation
+(`server.AiohttpWebSocketTransport`); this module provides a generic
+queue-backed one so ANY async web framework — tornado, the
+`websockets` library, something custom — can embed the collaboration
+core with two callables, mirroring how the reference embeds into
+express/koa/hono/deno hosts via `hocuspocus.handleConnection`
+(`playground/backend/src/express.ts` et al.).
+
+send() must be callable synchronously (CRDT transaction callbacks fire
+inside synchronous document mutation); the writer task drains the
+queue in order on the running event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional
+
+
+class CallbackWebSocketTransport:
+    """Queue-backed transport over caller-supplied async callables.
+
+    Parameters:
+    - send_async(data: bytes) -> awaitable: deliver one binary frame.
+    - close_async(code: int, reason: str) -> awaitable: close the
+      socket. Exceptions from either mark the transport closed.
+    - is_closed_check: optional callable returning the socket's own
+      closed state (polled in addition to this transport's flag).
+    """
+
+    def __init__(
+        self,
+        send_async: Callable[[bytes], Awaitable[None]],
+        close_async: Callable[[int, str], Awaitable[None]],
+        is_closed_check: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        self._send_async = send_async
+        self._close_async = close_async
+        self._is_closed_check = is_closed_check
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        self._writer_task = asyncio.ensure_future(self._writer())
+
+    @property
+    def is_closed(self) -> bool:
+        if self._closed:
+            return True
+        check = self._is_closed_check
+        return bool(check()) if check is not None else False
+
+    def send(self, data: bytes) -> None:
+        if not self.is_closed:
+            self.queue.put_nowait(("data", data))
+
+    def close(self, code: int = 1000, reason: str = "") -> None:
+        if not self._closed:
+            self._closed = True
+            self.queue.put_nowait(("close", (code, reason)))
+
+    async def _writer(self) -> None:
+        while True:
+            kind, payload = await self.queue.get()
+            try:
+                if kind == "data":
+                    await self._send_async(payload)
+                else:
+                    code, reason = payload
+                    await self._close_async(code, reason)
+                    return
+            except Exception:
+                self._closed = True
+                return
+
+    def abort(self) -> None:
+        """Tear down without a close frame (the socket is already gone)."""
+        self._closed = True
+        self._writer_task.cancel()

@@ -1,0 +1,111 @@
+"""Cross-framework embedding: the core serves real providers from
+foreign websocket hosts.
+
+The reference proves its `handleConnection` embedding story with
+express/koa/hono/deno playground backends; here the equivalent
+`Hocuspocus.handle_connection` + `CallbackWebSocketTransport` is
+driven end-to-end under the `websockets` library and Tornado — full
+auth/sync/edit round trips with the stock provider (an aiohttp
+client), so both directions of the wire cross framework boundaries.
+"""
+
+import asyncio
+
+from hocuspocus_tpu.provider import HocuspocusProvider
+from hocuspocus_tpu.server import (
+    CallbackWebSocketTransport,
+    Hocuspocus,
+    RequestInfo,
+)
+
+
+async def _edit_roundtrip(url: str) -> None:
+    a = HocuspocusProvider(name="embedded", url=url)
+    b = HocuspocusProvider(name="embedded", url=url)
+    try:
+        deadline = asyncio.get_event_loop().time() + 10
+        while not (a.synced and b.synced):
+            assert asyncio.get_event_loop().time() < deadline, "sync timeout"
+            await asyncio.sleep(0.01)
+        a.document.get_text("t").insert(0, "cross-framework")
+        deadline = asyncio.get_event_loop().time() + 10
+        while b.document.get_text("t").to_string() != "cross-framework":
+            assert asyncio.get_event_loop().time() < deadline, "edit timeout"
+            await asyncio.sleep(0.01)
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+async def test_embed_under_websockets_library():
+    import websockets
+
+    hocuspocus = Hocuspocus()
+
+    async def collab(ws) -> None:
+        transport = CallbackWebSocketTransport(
+            send_async=ws.send,
+            close_async=lambda code, reason: ws.close(code=code, reason=reason),
+        )
+        request_info = RequestInfo(
+            headers=dict(ws.request.headers), url=ws.request.path
+        )
+        connection = hocuspocus.handle_connection(
+            transport, request_info, {"via": "websockets"}
+        )
+        try:
+            async for message in ws:
+                if isinstance(message, bytes):
+                    await connection.handle_message(message)
+        finally:
+            transport.abort()
+            await connection.handle_transport_close(1000, "")
+
+    async with websockets.serve(collab, "127.0.0.1", 0) as server:
+        port = server.sockets[0].getsockname()[1]
+        await _edit_roundtrip(f"ws://127.0.0.1:{port}")
+    hocuspocus.close_connections()
+    await asyncio.sleep(0.1)  # let unload hooks settle
+
+
+async def test_embed_under_tornado():
+    import tornado.web
+    import tornado.websocket
+
+    hocuspocus = Hocuspocus()
+
+    class CollabHandler(tornado.websocket.WebSocketHandler):
+        def open(self) -> None:
+            async def send(data: bytes) -> None:
+                await self.write_message(data, binary=True)
+
+            async def close(code: int, reason: str) -> None:
+                tornado.websocket.WebSocketHandler.close(self, code, reason)
+
+            self.transport = CallbackWebSocketTransport(send, close)
+            request_info = RequestInfo(
+                headers=dict(self.request.headers), url=self.request.uri or "/"
+            )
+            self.connection = hocuspocus.handle_connection(
+                self.transport, request_info, {"via": "tornado"}
+            )
+
+        async def on_message(self, message) -> None:
+            if isinstance(message, bytes):
+                await self.connection.handle_message(message)
+
+        def on_close(self) -> None:
+            self.transport.abort()
+            asyncio.ensure_future(
+                self.connection.handle_transport_close(self.close_code or 1000, "")
+            )
+
+    app = tornado.web.Application([(r"/collab", CollabHandler)])
+    server = app.listen(0, address="127.0.0.1")
+    try:
+        port = next(iter(server._sockets.values())).getsockname()[1]
+        await _edit_roundtrip(f"ws://127.0.0.1:{port}/collab")
+    finally:
+        server.stop()
+        hocuspocus.close_connections()
+        await asyncio.sleep(0.1)  # let unload hooks settle

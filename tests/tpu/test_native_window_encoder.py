@@ -1,11 +1,12 @@
 """Native window encoder ≡ Python Item encoder, byte for byte.
 
 `native/codec.cpp encode_text_window` emits the struct section for the
-shapes the plane serves hot (string runs, GC ranges, root parents);
-`serving._resolve_native_groups` does the semantic work. These tests
-pin byte-identity against the Python `_write_structs`/`Item.write`
-path across origins, cutoff offsets, multi-client groups and GC —
-plus the fallback decision for rich content.
+shapes the plane serves hot (string runs, deleted runs, GC ranges,
+root parents); `serving._encode_window_native` does the semantic work.
+These tests pin byte-identity against the Python
+`_write_structs`/`Item.write` path across origins, cutoff offsets,
+multi-client groups, deleted runs and GC — plus the fallback decision
+for rich content.
 
 Encode mirror of the reference's lib0/yjs write layer
 (`packages/server/src/OutgoingMessage.ts` + yjs UpdateEncoderV1).
@@ -128,6 +129,75 @@ def test_surrogate_pair_payloads_encode_identically():
     doc = plane.docs["d"]
     sm = _full_sm(doc)
     assert _native_bytes(serving, doc, sm) == _python_bytes(serving, doc, sm)
+
+
+def test_deleted_runs_encode_identically_across_cutoffs():
+    """ContentDeleted runs (kind 2): snapshots of gc=True docs replace
+    deleted items' content with deleted runs; cutoffs landing mid-run
+    exercise the length-minus-offset emission."""
+    source = Doc()
+    source.client_id = 21
+    text = source.get_text("t")
+    text.insert(0, "keep-this-then-delete-a-chunk-of-it")
+    text.delete(10, 12)
+    text.insert(len(text), " tail")
+
+    plane, serving = _seeded_plane()
+    plane.register("d")
+    plane.enqueue_update("d", encode_state_as_update(source))
+    plane.flush()
+    serving.refresh()
+    doc = plane.docs["d"]
+    assert any(rec.op.deleted_content for rec in doc.serve_log), (
+        "expected ContentDeleted runs in the serve log"
+    )
+    top = doc.lowerer.known[21]
+    for cutoff in (0, 12, 15, top - 2):  # 12/15 land inside the deleted run
+        sm = {21: cutoff}
+        assert _native_bytes(serving, doc, sm) == _python_bytes(serving, doc, sm), cutoff
+    probe = Doc()
+    apply_update(probe, _native_bytes(serving, doc, {21: 0}))
+    assert probe.get_text("t").to_string() == source.get_text("t").to_string()
+
+
+def test_gc_runs_encode_identically_across_cutoffs():
+    """GC ranges (kind 1): a reload snapshot with a collected range and
+    a string item anchored into it (hand-encoded wire update — GC
+    structs only arise from collected subtrees, which otherwise ride
+    tree docs). Cutoffs landing mid-range exercise length-minus-offset."""
+    from hocuspocus_tpu.crdt.encoding import Encoder
+
+    enc = Encoder()
+    enc.write_var_uint(1)  # one client section
+    enc.write_var_uint(2)  # two structs
+    enc.write_var_uint(33)  # client
+    enc.write_var_uint(0)  # clock
+    enc.write_uint8(0)  # GC ref
+    enc.write_var_uint(8)  # collected range [0, 8)
+    enc.write_uint8(4 | 0x80)  # ContentString + origin
+    enc.write_var_uint(33)
+    enc.write_var_uint(7)  # anchored to the last collected unit
+    enc.write_var_string("hello")
+    enc.write_var_uint(0)  # empty delete set
+    update = enc.to_bytes()
+
+    plane, serving = _seeded_plane()
+    plane.register("d")
+    assert plane.enqueue_update("d", update) > 0
+    plane.flush()
+    serving.refresh()
+    doc = plane.docs["d"]
+    assert any(rec.op.gc for rec in doc.serve_log), (
+        "expected GC structs in the serve log"
+    )
+    for cutoff in (0, 3, 7, 9):  # 3/7 land inside the GC range
+        sm = {33: cutoff}
+        assert _native_bytes(serving, doc, sm) == _python_bytes(serving, doc, sm), cutoff
+    # the bytes decode cleanly (the synthetic item is root-parentless by
+    # construction, so no content assertion — byte identity above is
+    # the point of this test)
+    probe = Doc()
+    apply_update(probe, _native_bytes(serving, doc, {33: 0}))
 
 
 def test_rich_content_falls_back_to_python_path():
