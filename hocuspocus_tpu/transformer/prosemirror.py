@@ -66,6 +66,29 @@ def _json_to_xml_nodes(nodes: Iterable[dict]) -> list:
     return result
 
 
+def _coalesce_strings(children: list) -> list:
+    """Merge consecutive str content entries into runs.
+
+    `to_array()` on a text-bearing type yields one str PER UTF-16
+    position (ContentString.get_content semantics); emitting a text
+    node per character would blow up payloads ~30x and diverge from
+    the merged runs y-prosemirror produces.
+    """
+    out: list = []
+    run: list[str] = []
+    for child in children:
+        if isinstance(child, str):
+            run.append(child)
+        else:
+            if run:
+                out.append("".join(run))
+                run = []
+            out.append(child)
+    if run:
+        out.append("".join(run))
+    return out
+
+
 def _xml_node_to_json(node: Any) -> list[dict]:
     if isinstance(node, YXmlText):
         ops = []
@@ -79,12 +102,18 @@ def _xml_node_to_json(node: Any) -> list[dict]:
                 ]
             ops.append(entry)
         return ops
+    if isinstance(node, str):
+        # a plain-text root read through the XML view (e.g. the webhook
+        # transforming a Y.Text document): string runs become text
+        # nodes, as y-prosemirror yields for text content (callers
+        # coalesce per-character content entries into runs first)
+        return [{"type": "text", "text": node}] if node else []
     result: dict = {"type": node.node_name}
     attrs = node.get_attributes()
     if attrs:
         result["attrs"] = attrs
     content: list = []
-    for child in node.to_array():
+    for child in _coalesce_strings(node.to_array()):
         content.extend(_xml_node_to_json(child))
     if content:
         result["content"] = content
@@ -106,7 +135,7 @@ class Prosemirror:
 
     def _fragment_to_json(self, fragment: YXmlFragment) -> dict:
         content: list = []
-        for child in fragment.to_array():
+        for child in _coalesce_strings(fragment.to_array()):
             content.extend(_xml_node_to_json(child))
         return {"type": "doc", "content": content}
 

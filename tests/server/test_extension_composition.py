@@ -1,0 +1,112 @@
+"""Everything composes: the full production extension stack in ONE
+server — serve-mode TPU plane + incremental append-log persistence +
+metrics + logger + webhook + throttle — driven by real providers.
+
+Each extension is tested in isolation elsewhere; this pins their
+interaction: hook priorities (Metrics 1000 > TpuMerge 900 > others),
+the plane claiming broadcasts while Incremental stores deltas from the
+same onChange boundary, webhook payload import on load, and unload
+draining every layer. The reference composes extensions the same way
+(`packages/cli/src/index.js` assembles Logger+SQLite+Webhook on one
+server).
+"""
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from hocuspocus_tpu.extensions import Logger, Throttle, Webhook
+from hocuspocus_tpu.extensions.incremental import IncrementalSQLite
+from hocuspocus_tpu.observability import Metrics, MetricsRegistry
+from hocuspocus_tpu.provider import HocuspocusProvider
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_full_stack_composition():
+    # in-process webhook receiver
+    events = []
+
+    async def hook(request: web.Request) -> web.Response:
+        events.append(json.loads(await request.text()))
+        return web.Response(text="{}")
+
+    app = web.Application()
+    app.router.add_post("/hook", hook)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    hook_port = runner.addresses[0][1]
+
+    registry = MetricsRegistry()
+    ext_plane = TpuMergeExtension(
+        num_docs=16, capacity=2048, flush_interval_ms=1, serve=True
+    )
+    incremental = IncrementalSQLite(compact_after=4)
+    log_lines = []
+    stack = [
+        Metrics(registry=registry),
+        ext_plane,
+        incremental,
+        Logger(log=log_lines.append),
+        Webhook(
+            url=f"http://127.0.0.1:{hook_port}/hook",
+            secret="s3cr3t",
+            debounce=10,
+            events=["create", "change", "connect", "disconnect"],
+        ),
+        Throttle(throttle=100, considered_seconds=60),
+    ]
+    server = await new_hocuspocus(extensions=stack, debounce=30, max_debounce=60)
+    a = new_provider(server, name="composed")
+    b = new_provider(server, name="composed")
+    try:
+        await retryable_assertion(lambda: _assert(a.synced and b.synced))
+        text = a.document.get_text("t")
+        for i in range(6):
+            text.insert(len(text.to_string()), f"part{i};")
+        expected = "".join(f"part{i};" for i in range(6))
+        await retryable_assertion(
+            lambda: _assert(b.document.get_text("t").to_string() == expected)
+        )
+        # the plane served the doc (broadcasts went through the merged path)
+        assert "composed" in ext_plane._docs
+        assert ext_plane.plane.counters["plane_broadcasts"] >= 1
+        assert ext_plane.plane.counters["cpu_fallbacks"] == 0
+        # incremental persisted deltas (and possibly compacted)
+        await retryable_assertion(
+            lambda: _assert(incremental.log_length("composed") >= 1)
+        )
+        # metrics saw the traffic; plane health gauges are exported
+        sample = registry.expose()
+        assert "hocuspocus_document_changes_total" in sample
+        assert "hocuspocus_tpu_plane_broadcasts" in sample
+        # webhook observed lifecycle events
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "change" for e in events))
+        )
+        # logger saw hook traffic
+        assert any("New connection" in line or "changed" in line for line in log_lines)
+
+        # reload path: destroy both, let the doc unload, rejoin and the
+        # incremental log restores the content through the whole stack
+        a.destroy()
+        b.destroy()
+        await retryable_assertion(lambda: _assert("composed" not in server.documents))
+        c = new_provider(server, name="composed")
+        try:
+            await retryable_assertion(lambda: _assert(c.synced))
+            assert c.document.get_text("t").to_string() == expected
+        finally:
+            c.destroy()
+    finally:
+        for p in (a, b):
+            p.destroy()
+        await server.destroy()
+        await runner.cleanup()
