@@ -52,6 +52,20 @@ def build_parser() -> argparse.ArgumentParser:
         default=4096,
         help="merge plane arena capacity per row (units), default 4096",
     )
+    parser.add_argument(
+        "--tpu-flush-interval",
+        type=float,
+        default=5.0,
+        help="device flush cadence in ms (validation pipeline), default 5",
+    )
+    parser.add_argument(
+        "--tpu-broadcast-interval",
+        type=float,
+        default=2.0,
+        help="broadcast coalescing window in ms (edits within the window "
+        "share one frame per doc; idle edits broadcast immediately), "
+        "default 2",
+    )
     return parser
 
 
@@ -86,6 +100,8 @@ async def run(args: argparse.Namespace) -> None:
                 num_docs=args.tpu_docs,
                 capacity=args.tpu_capacity,
                 serve=args.tpu_serve,
+                flush_interval_ms=args.tpu_flush_interval,
+                broadcast_interval_ms=args.tpu_broadcast_interval,
             )
         )
 

@@ -62,7 +62,8 @@ async def test_cli_tpu_serve_mode():
     """--tpu-serve boots a serve-mode plane; two providers converge
     through plane broadcasts over the CLI-launched server."""
     async with _launch_cli(
-        "--tpu-serve", "--tpu-docs", "64", "--tpu-capacity", "512"
+        "--tpu-serve", "--tpu-docs", "64", "--tpu-capacity", "512",
+        "--tpu-flush-interval", "1", "--tpu-broadcast-interval", "1"
     ) as port:
         a = b = None
         try:
