@@ -361,6 +361,15 @@ def run_bench() -> None:
         except Exception as error:  # never lose the headline number to this
             server_p99_err = repr(error)[:300]
 
+    # catch-up storm serving rate (BASELINE config 5's plane replay):
+    # cold/stale SyncStep2s served from plane state + host logs
+    catchup = None
+    if os.environ.get("BENCH_CATCHUP", "1") != "0":
+        try:
+            catchup = _measure_catchup_serving()
+        except Exception as error:
+            catchup = {"error": repr(error)[:300]}
+
     merges_per_sec = total_ops / elapsed
     p99_ms = float(np.percentile(np.array(latencies) * 1000, 99))
     from hocuspocus_tpu.tpu.pallas_kernels import _pallas_broken_shapes, _pick_block
@@ -390,7 +399,80 @@ def run_bench() -> None:
         result["extra"]["server_p99_detail"] = server_p99_extra
     if server_p99_err is not None:
         result["extra"]["server_p99_error"] = server_p99_err
+    if catchup is not None:
+        result["extra"]["catchup"] = catchup
     print(json.dumps(result))
+
+
+def _measure_catchup_serving() -> dict:
+    """Plane-served catch-up replay rate (config5 part-2 shape, bounded).
+
+    10KB documents on a MergePlane; alternating cold/stale reconnects
+    served via PlaneServing.encode_state_as_update — gather programs
+    warmed first, exactly as a live server warms them at listen."""
+    from hocuspocus_tpu.crdt import (
+        Doc,
+        encode_state_as_update,
+        encode_state_vector,
+    )
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+    from hocuspocus_tpu.tpu.serving import PlaneServing
+
+    num_docs = int(os.environ.get("BENCH_CATCHUP_DOCS", 128))
+    serves = int(os.environ.get("BENCH_CATCHUP_SERVES", 1000))
+    budget_s = int(os.environ.get("BENCH_CATCHUP_TIMEOUT", 120))
+
+    source = Doc()
+    text = source.get_text("t")
+    for i in range(19):
+        text.insert(len(text), ("line %04d " % i) * 25)
+    mid_sv = encode_state_vector(source)
+    text.insert(len(text), "tail content after the client went offline " * 9)
+    snapshot = encode_state_as_update(source)
+
+    plane = MergePlane(num_docs=num_docs, capacity=8192)
+    for d in range(num_docs):
+        plane.register(f"cold-{d}")
+        plane.enqueue_update(f"cold-{d}", snapshot)
+    plane.flush()
+    serving = PlaneServing(plane)
+    serving.refresh()
+    serving.warmup_gathers()
+
+    start = time.perf_counter()
+    served_bytes = 0
+    serving.prefetch_tombstones(
+        [plane.docs[f"cold-{d}"] for d in range(num_docs)]
+    )
+    # alternate whole cold and stale WAVES over the doc fleet: every doc
+    # sees both request kinds, and repeated cold waves hit the per-doc
+    # payload cache exactly as a real reconnect storm's joiners do (the
+    # number measures the production storm path, caches included —
+    # cold_serves/stale_serves record the mix)
+    done = cold = fallbacks = 0
+    for i in range(serves):
+        is_cold = (i // num_docs) % 2 == 0
+        data = serving.encode_state_as_update(
+            f"cold-{i % num_docs}", source, None if is_cold else mid_sv
+        )
+        if data is None:  # doc degraded to the CPU path mid-run
+            fallbacks += 1
+            continue
+        served_bytes += len(data)
+        done += 1
+        cold += is_cold
+        if time.perf_counter() - start > budget_s:
+            break
+    elapsed = time.perf_counter() - start
+    return {
+        "catchups_per_sec": round(done / elapsed, 1) if done else 0.0,
+        "docs": num_docs,
+        "serves": done,
+        "cold_serves": cold,
+        "stale_serves": done - cold,
+        "fallbacks": fallbacks,
+        "served_mb": round(served_bytes / 1e6, 2),
+    }
 
 
 def _measure_server_p99() -> "tuple[float, dict]":
