@@ -137,6 +137,70 @@ async def test_broadcast_latency_independent_of_device_flush_time(monkeypatch):
         await server.destroy()
 
 
+async def test_read_only_connection_with_serve_mode():
+    """Read-only rejection composes with plane serving: the read-only
+    client's writes are refused (SyncStatus false, nothing applied or
+    broadcast), while it still RECEIVES plane broadcasts and syncs
+    from device state. Mirrors the reference's read-only path
+    (`MessageReceiver.ts:157-179`) on the serve plane."""
+
+    async def on_authenticate(data):
+        if data.token == "viewer":
+            data.connection_config.read_only = True
+
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext], on_authenticate=on_authenticate)
+    writer = new_provider(server, name="ro", token="editor")
+    viewer = new_provider(server, name="ro", token="viewer")
+    try:
+        await wait_synced(writer, viewer)
+        writer.document.get_text("t").insert(0, "from the writer")
+        await retryable_assertion(
+            lambda: _assert(viewer.document.get_text("t").to_string() == "from the writer")
+        )
+        # the viewer's write must not reach the writer or the server doc
+        viewer.document.get_text("t").insert(0, "REJECTED ")
+        await asyncio.sleep(0.3)
+        assert writer.document.get_text("t").to_string() == "from the writer"
+        assert server.documents["ro"].get_text("t").to_string() == "from the writer"
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        assert "ro" in ext._docs  # still plane-served
+    finally:
+        writer.destroy()
+        viewer.destroy()
+        await server.destroy()
+
+
+async def test_direct_connection_edits_ride_the_plane():
+    """Server-side edits (openDirectConnection.transact) on a
+    plane-served doc broadcast through the plane like client edits —
+    the reference's embedded-editing path (`DirectConnection.ts:24`)
+    composed with serve mode."""
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    provider = new_provider(server, name="direct")
+    direct = None
+    try:
+        await wait_synced(provider)
+        direct = await server.hocuspocus.open_direct_connection("direct")
+        await direct.transact(
+            lambda doc: doc.get_text("t").insert(0, "from the server")
+        )
+        await retryable_assertion(
+            lambda: _assert(
+                provider.document.get_text("t").to_string() == "from the server"
+            )
+        )
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        assert ext.plane.counters["plane_broadcasts"] >= 1
+        assert "direct" in ext._docs
+    finally:
+        if direct is not None:
+            await direct.disconnect()  # idempotent
+        provider.destroy()
+        await server.destroy()
+
+
 async def test_concurrent_edits_converge_through_plane():
     ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
     server = await new_hocuspocus(extensions=[ext])
