@@ -1,0 +1,151 @@
+"""Resource-exhaustion degradation rails in serve mode.
+
+The plane is a bounded accelerator, not the source of truth: when its
+rows run out (plane_full) or a document outgrows its arena row
+(capacity), the doc must degrade to the CPU path — counted, with a
+full-state fallback broadcast so receivers that only saw plane frames
+stay whole — while other docs stay plane-served. These are the safety
+rails the 100k-doc regime leans on (BASELINE.md north star; SURVEY.md
+§5.7 "documents is the scaling dimension").
+"""
+
+import asyncio
+
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_plane_full_degrades_newcomers_only():
+    """Rows exhausted: later docs fall back to CPU; earlier docs stay
+    plane-served and correct."""
+    ext = TpuMergeExtension(num_docs=2, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    providers = []
+    try:
+        # two docs claim the two rows
+        for d in range(2):
+            a = new_provider(server, name=f"full-{d}")
+            b = new_provider(server, name=f"full-{d}")
+            providers += [a, b]
+            await wait_synced(a, b)
+            a.document.get_text("t").insert(0, f"doc {d}")
+        # the third doc cannot get a row
+        c1 = new_provider(server, name="full-2")
+        c2 = new_provider(server, name="full-2")
+        providers += [c1, c2]
+        await wait_synced(c1, c2)
+        c1.document.get_text("t").insert(0, "cpu-served")
+        await retryable_assertion(
+            lambda: _assert(c2.document.get_text("t").to_string() == "cpu-served")
+        )
+        assert ext.plane.counters["docs_retired_plane_full"] >= 1
+        assert "full-2" not in ext._docs  # degraded to the CPU path
+        # earlier docs still ride the plane and still converge
+        assert "full-0" in ext._docs and "full-1" in ext._docs
+        providers[0].document.get_text("t").insert(0, "more ")
+        await retryable_assertion(
+            lambda: _assert(
+                providers[1].document.get_text("t").to_string() == "more doc 0"
+            )
+        )
+    finally:
+        for p in providers:
+            p.destroy()
+        await server.destroy()
+
+
+async def test_offline_edits_merge_through_plane_on_reconnect():
+    """The lossless-recovery story on the serve plane: a client editing
+    while disconnected reconnects (server restart on the same port,
+    fresh serve-mode plane), SyncStep1/2 exchange merges the offline
+    edits, and the plane serves the merged doc to everyone."""
+    from hocuspocus_tpu.server import Configuration, Server
+    from tests.utils import wait_for
+
+    ext1 = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext1])
+    port = server.port
+    provider = new_provider(server)
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "before restart")
+        await asyncio.sleep(0.2)
+        await server.destroy()
+        ext2 = TpuMergeExtension(
+            num_docs=8, capacity=1024, flush_interval_ms=1, serve=True
+        )
+        server2 = Server(Configuration(quiet=True, extensions=[ext2]))
+        await server2.listen(port=port)
+        provider.document.get_text("t").insert(0, "offline! ")
+        await wait_for(lambda: provider.synced, timeout=20)
+        await retryable_assertion(
+            lambda: _assert(
+                server2.documents["hocuspocus-test"].get_text("t").to_string()
+                == "offline! before restart"
+            ),
+            timeout=15,
+        )
+        # the merged doc is plane-served to a fresh joiner
+        assert "hocuspocus-test" in ext2._docs
+        joiner = new_provider(server2)
+        try:
+            await wait_synced(joiner)
+            assert (
+                joiner.document.get_text("t").to_string() == "offline! before restart"
+            )
+            assert ext2.plane.counters["sync_serves"] >= 1
+            assert ext2.plane.counters["cpu_fallbacks"] == 0
+        finally:
+            joiner.destroy()
+        await server2.destroy()
+    finally:
+        provider.destroy()
+
+
+async def test_capacity_overflow_degrades_without_data_loss():
+    """A doc outgrowing its arena row retires (capacity) mid-stream;
+    the full-state CPU fallback keeps every receiver whole and edits
+    keep flowing on the CPU path."""
+    ext = TpuMergeExtension(num_docs=4, capacity=96, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="grower")
+    b = new_provider(server, name="grower")
+    try:
+        await wait_synced(a, b)
+        text = a.document.get_text("t")
+        expected = ""
+        # grow well past the 96-unit row in small increments so the
+        # overflow happens mid-traffic, between plane broadcasts
+        for i in range(10):
+            chunk = f"chunk-{i:02d}-aaaaaaaaaaaa;"
+            text.insert(len(expected), chunk)
+            expected += chunk
+            await asyncio.sleep(0.02)
+
+        def converged():
+            assert b.document.get_text("t").to_string() == expected
+
+        await retryable_assertion(converged)
+        assert ext.plane.counters["docs_retired_capacity"] >= 1
+        assert ext.plane.counters["cpu_fallbacks"] >= 1
+        assert "grower" not in ext._docs
+        # steady state continues on the CPU path, both directions
+        b.document.get_text("t").insert(0, ">> ")
+        await retryable_assertion(
+            lambda: _assert(a.document.get_text("t").to_string() == ">> " + expected)
+        )
+        # late joiner gets the whole doc via the CPU sync path
+        c = new_provider(server, name="grower")
+        try:
+            await wait_synced(c)
+            assert c.document.get_text("t").to_string() == ">> " + expected
+        finally:
+            c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()

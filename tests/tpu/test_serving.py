@@ -165,6 +165,20 @@ async def test_read_only_connection_with_serve_mode():
         assert server.documents["ro"].get_text("t").to_string() == "from the writer"
         assert ext.plane.counters["cpu_fallbacks"] == 0
         assert "ro" in ext._docs  # still plane-served
+        # the rejection must not wedge the viewer's subscription: it
+        # still observes writer edits via plane broadcasts afterwards
+        # (the viewer's LOCAL doc legitimately keeps its own rejected
+        # edit — read-only is server-side refusal, not local undo)
+        writer.document.get_text("t").insert(0, "still flowing: ")
+        await retryable_assertion(
+            lambda: _assert(
+                "still flowing: " in viewer.document.get_text("t").to_string()
+            )
+        )
+        assert (
+            server.documents["ro"].get_text("t").to_string()
+            == "still flowing: from the writer"
+        )
     finally:
         writer.destroy()
         viewer.destroy()
