@@ -791,12 +791,7 @@ class TpuMergeExtension(Extension):
                 if loading is None:
                     if instance is not None and name in instance.documents:
                         return  # re-loaded while we waited: registration lives on
-                    document = self._docs.pop(name, None)
-                    if document is not None:
-                        document.sync_source = None
-                        document.broadcast_source = None
-                    if self.serving is not None:
-                        self.serving.forget(name, self.plane.docs.get(name))
+                    self._detach_serving(name, self._docs.pop(name, None))
                     self.plane.release(name)
                     return
             # A re-load is in flight. Wait for it OUTSIDE the lock: on
@@ -846,14 +841,20 @@ class TpuMergeExtension(Extension):
         self._schedule_broadcast()
         return True
 
+    def _detach_serving(self, name: str, document) -> None:
+        """Unhook a document from the plane's serving seams and drop its
+        serving caches (shared by CPU fallback and unload teardown)."""
+        if document is not None:
+            document.sync_source = None
+            document.broadcast_source = None
+        if self.serving is not None:
+            self.serving.forget(name, self.plane.docs.get(name))
+
     def _fallback_to_cpu(self, document) -> None:
         name = document.name
         if self._docs.pop(name, None) is None:
             return  # already degraded
-        document.sync_source = None
-        document.broadcast_source = None
-        if self.serving is not None:
-            self.serving.forget(name, self.plane.docs.get(name))
+        self._detach_serving(name, document)
         if name in self.plane.docs:
             self.plane.retire_doc(name, "fallback")
         self.plane.counters["cpu_fallbacks"] += 1

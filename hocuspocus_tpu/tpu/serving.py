@@ -297,23 +297,28 @@ class PlaneServing:
             pos += biggest
         return chunks
 
-    def _fetch_slot_rows(self, chunk: "list[int]", epoch: int) -> None:
+    def _gather_rows(self, slot_indices: "list[int]") -> np.ndarray:
+        """One fused (3, B, N) device read of [deleted, id_client,
+        id_clock] rows for the given slots. Caller holds the step lock."""
         import jax.numpy as jnp
 
+        state = self.plane.state
+        idx = jnp.asarray(slot_indices, jnp.int32)
+        return np.asarray(
+            jnp.stack(
+                [
+                    state.deleted[idx].astype(jnp.int32),
+                    state.id_client[idx].view(jnp.int32),
+                    state.id_clock[idx],
+                ]
+            )
+        )
+
+    def _fetch_slot_rows(self, chunk: "list[int]", epoch: int) -> None:
         plane = self.plane
         width = next(w for w in self._gather_widths() if w >= len(chunk))
         with plane._step_lock:  # never gather donated buffers mid-flush
-            state = plane.state
-            idx = jnp.asarray(chunk + [chunk[0]] * (width - len(chunk)), jnp.int32)
-            fused = np.asarray(
-                jnp.stack(
-                    [
-                        state.deleted[idx].astype(jnp.int32),
-                        state.id_client[idx].view(jnp.int32),
-                        state.id_clock[idx],
-                    ]
-                )
-            )
+            fused = self._gather_rows(chunk + [chunk[0]] * (width - len(chunk)))
             gens = [int(plane.slot_gen[slot]) for slot in chunk]
         for i, slot in enumerate(chunk):
             sel = np.nonzero(fused[0, i])[0]
@@ -326,22 +331,9 @@ class PlaneServing:
         """Compile the tombstone-gather programs (one per fixed width)
         so the first reconnect storm pays data transfer, not XLA
         compile time. Run from the extension's listen-time warm task."""
-        import jax.numpy as jnp
-
-        plane = self.plane
-        with plane._step_lock:
-            state = plane.state
+        with self.plane._step_lock:
             for width in self._gather_widths():
-                idx = jnp.zeros((width,), jnp.int32)
-                np.asarray(
-                    jnp.stack(
-                        [
-                            state.deleted[idx].astype(jnp.int32),
-                            state.id_client[idx].view(jnp.int32),
-                            state.id_clock[idx],
-                        ]
-                    )
-                )
+                self._gather_rows([0] * width)
 
     def _device_delete_set(self, doc: PlaneDoc) -> DeleteSet:
         """Tombstones as the DEVICE sees them, across every row of the
