@@ -61,6 +61,7 @@ class PlaneDoc:
     # delete ranges that target host-side map items (client, clock, len)
     map_tombstones: list[tuple] = field(default_factory=list)
     retired: bool = False
+    retire_reason: Optional[str] = None  # first reason wins (see retire_doc)
 
 
 class MergePlane:
@@ -173,6 +174,7 @@ class MergePlane:
             "docs_retired_capacity": 0,
             "docs_retired_fallback": 0,
             "docs_retired_plane_full": 0,
+            "docs_recycled": 0,
             "sync_serves": 0,
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
@@ -230,6 +232,7 @@ class MergePlane:
             return
         if not doc.retired:
             doc.retired = True
+            doc.retire_reason = reason
             # strict key access: every retire reason must be pre-declared
             # in __init__ so metrics exporters that bind to the counter
             # keys at configure time (observability/extension.py) can
@@ -749,6 +752,16 @@ class TpuMergeExtension(Extension):
         self._flush_tasks.add(task)
         task.add_done_callback(self._flush_tasks.discard)
 
+    def _attach_serving(self, name: str, document) -> None:
+        """Hook a document into the plane's serving seams (shared by
+        load-time onboarding and capacity recycling — the mirror of
+        _detach_serving)."""
+        from .serving import TpuSyncSource
+
+        document.sync_source = TpuSyncSource(self.serving, name, document)
+        document.broadcast_source = self
+        self._docs[name] = document
+
     async def after_load_document(self, data: Payload) -> None:
         from ..crdt import encode_state_as_update
 
@@ -758,12 +771,7 @@ class TpuMergeExtension(Extension):
         # receivers get pre-load state via sync, not broadcast
         self.plane.enqueue_update(name, snapshot, presync=True)
         if self.serve and self.plane.is_supported(name):
-            from .serving import TpuSyncSource
-
-            document = data.document
-            document.sync_source = TpuSyncSource(self.serving, name, document)
-            document.broadcast_source = self
-            self._docs[name] = document
+            self._attach_serving(name, data.document)
         self._schedule_flush()
 
     async def on_change(self, data: Payload) -> None:
@@ -835,11 +843,72 @@ class TpuMergeExtension(Extension):
         plane.enqueue_update(name, update)
         if not plane.is_supported(name):
             # this very update degraded the doc; it broadcasts via CPU
+            plane_doc = plane.docs.get(name)
+            reason = plane_doc.retire_reason if plane_doc is not None else None
             self._fallback_to_cpu(document)
+            if reason in ("capacity", "plane_full"):
+                # arena rows are append-only and tree docs hold one row
+                # per sequence (including deleted subtrees'), so a
+                # long-lived busy doc eventually exhausts its rows or
+                # the plane — re-onboard with fresh rows lowered from
+                # the live CPU snapshot. Collected SUBTREES (deleted
+                # paragraphs/elements — the common rich-text churn)
+                # vanish from the snapshot, so such docs reclaim most
+                # of their rows; docs whose tombstones are in-run text
+                # deletions keep their cumulative cost (same semantics
+                # as yjs struct stores) and the headroom guard leaves
+                # those on the CPU path.
+                task = asyncio.ensure_future(self._recycle_capacity_doc(document))
+                self._flush_tasks.add(task)
+                task.add_done_callback(self._flush_tasks.discard)
             return False
         self._schedule_flush()
         self._schedule_broadcast()
         return True
+
+    async def _recycle_capacity_doc(self, document) -> None:
+        """Give a capacity- or plane_full-retired doc fresh arena rows.
+
+        The triggering update already reached receivers via the CPU
+        fallback broadcast; this re-onboards the doc for FUTURE traffic
+        exactly like a reload does — release the exhausted rows (ALL of
+        them, including deleted subtrees'), re-register, lower the live
+        snapshot as presync. If the live state itself nearly fills a
+        row (no headroom) or still doesn't fit the plane, the doc stays
+        on the CPU path rather than thrash through recycles.
+        """
+        from ..crdt import encode_state_as_update
+
+        name = document.name
+        plane = self.plane
+        async with plane.flush_lock:
+            if document.get_connections_count() <= 0:
+                return  # unloading anyway
+            if name in self._docs:
+                return  # already re-onboarded
+            existing = plane.docs.get(name)
+            if existing is None or not existing.retired:
+                return  # registration changed under us; leave it be
+            plane.release(name)
+            plane.register(name)
+            plane.enqueue_update(name, encode_state_as_update(document), presync=True)
+            doc = plane.docs.get(name)
+            if doc is None or doc.lowerer.unsupported:
+                return  # live content unsupported/too big: stays on CPU
+            for slot in doc.seqs.values():
+                if plane.projected_len[slot] > plane.capacity * 3 // 4:
+                    plane.retire_doc(name, "capacity")
+                    return  # no row headroom: recycling would thrash
+            if len(plane.free) < 2:
+                # plane-level headroom: with no spare rows the next new
+                # sequence would plane_full again immediately — each
+                # thrash cycle costs a full-state broadcast plus a
+                # snapshot re-lower, strictly worse than the CPU path
+                plane.retire_doc(name, "plane_full")
+                return
+            plane.counters["docs_recycled"] += 1
+            self._attach_serving(name, document)
+        self._schedule_flush()
 
     def _detach_serving(self, name: str, document) -> None:
         """Unhook a document from the plane's serving seams and drop its

@@ -106,6 +106,57 @@ async def test_offline_edits_merge_through_plane_on_reconnect():
         provider.destroy()
 
 
+async def test_capacity_recycle_reclaims_rows_for_subtree_churn():
+    """A rich-text doc churning paragraphs (insert + delete whole
+    elements) exhausts its append-only rows, but the collected
+    subtrees vanish from the live snapshot — the doc recycles onto
+    fresh rows and STAYS plane-served instead of degrading forever."""
+    ext = TpuMergeExtension(num_docs=16, capacity=512, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="churny")
+    b = new_provider(server, name="churny")
+    try:
+        await wait_synced(a, b)
+        from hocuspocus_tpu.crdt import YXmlElement, YXmlText
+
+        frag = a.document.get_xml_fragment("x")
+        # each wave inserts a ~100-unit paragraph and deletes the
+        # oldest: cumulative insertions blow past 512 while the live
+        # doc stays ~2 paragraphs
+        for wave in range(12):
+            el = YXmlElement("paragraph")
+            frag.push([el])
+            t = YXmlText()
+            el.push([t])
+            t.insert(0, f"wave {wave:02d} " * 12)
+            if len(frag) > 2:
+                frag.delete(0, 1)
+            await asyncio.sleep(0.05)
+        await retryable_assertion(
+            lambda: _assert(ext.plane.counters["docs_recycled"] >= 1)
+        )
+        # the doc is BACK on the plane after recycling
+        await retryable_assertion(lambda: _assert("churny" in ext._docs))
+        # convergence continues through the plane
+        frag2 = b.document.get_xml_fragment("x")
+        await retryable_assertion(
+            lambda: _assert(len(frag2) == len(frag) and len(frag) >= 2)
+        )
+        # a late joiner syncs the live doc from the plane
+        serves_before = ext.plane.counters["sync_serves"]
+        c = new_provider(server, name="churny")
+        try:
+            await wait_synced(c)
+            assert len(c.document.get_xml_fragment("x")) == len(frag)
+            assert ext.plane.counters["sync_serves"] > serves_before
+        finally:
+            c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
 async def test_capacity_overflow_degrades_without_data_loss():
     """A doc outgrowing its arena row retires (capacity) mid-stream;
     the full-state CPU fallback keeps every receiver whole and edits
