@@ -224,9 +224,15 @@ class MergePlane:
             self._clear_slot(slot)
             self.free.append(slot)
 
-    def retire_doc(self, name: str, reason: str) -> None:
+    def retire_doc(self, name: str, reason: str, count: bool = True) -> None:
         """Permanently degrade a doc to the CPU path (rows stay allocated
-        until unload so the name keeps resolving to 'unsupported')."""
+        until unload so the name keeps resolving to 'unsupported').
+
+        count=False marks the doc retired without incrementing the
+        degradation counter — used when a failed RECYCLE re-retires the
+        fresh registration of an incident that was already counted, so
+        the counters keep meaning 'degradation incidents', not retire
+        calls."""
         doc = self.docs.get(name)
         if doc is None:
             return
@@ -237,7 +243,8 @@ class MergePlane:
             # in __init__ so metrics exporters that bind to the counter
             # keys at configure time (observability/extension.py) can
             # never miss a degradation class added later
-            self.counters[f"docs_retired_{reason}"] += 1
+            if count:
+                self.counters[f"docs_retired_{reason}"] += 1
         doc.lowerer.unsupported = True
         doc.serve_log = []
         doc.map_tombstones = []
@@ -717,6 +724,14 @@ class TpuMergeExtension(Extension):
             self.serving = PlaneServing(self.plane)
             self.serving.flush_failure_handler = self._degrade_all_served
 
+    def _spawn_tracked(self, coro) -> None:
+        """Run a background task with a strong reference: the event loop
+        only weakly references tasks, and a GC'd task silently stops the
+        serve pipeline (or strands a lock acquisition mid-await)."""
+        task = asyncio.ensure_future(coro)
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_tasks.discard)
+
     # -- hooks ---------------------------------------------------------------
 
     async def on_listen(self, data: Payload) -> None:
@@ -748,9 +763,7 @@ class TpuMergeExtension(Extension):
 
                     _logger_mod.log_error("gather warmup failed (continuing)")
 
-        task = asyncio.ensure_future(warm())
-        self._flush_tasks.add(task)
-        task.add_done_callback(self._flush_tasks.discard)
+        self._spawn_tracked(warm())
 
     def _attach_serving(self, name: str, document) -> None:
         """Hook a document into the plane's serving seams (shared by
@@ -858,9 +871,7 @@ class TpuMergeExtension(Extension):
                 # deletions keep their cumulative cost (same semantics
                 # as yjs struct stores) and the headroom guard leaves
                 # those on the CPU path.
-                task = asyncio.ensure_future(self._recycle_capacity_doc(document))
-                self._flush_tasks.add(task)
-                task.add_done_callback(self._flush_tasks.discard)
+                self._spawn_tracked(self._recycle_capacity_doc(document))
             return False
         self._schedule_flush()
         self._schedule_broadcast()
@@ -889,25 +900,40 @@ class TpuMergeExtension(Extension):
             existing = plane.docs.get(name)
             if existing is None or not existing.retired:
                 return  # registration changed under us; leave it be
-            plane.release(name)
-            plane.register(name)
-            plane.enqueue_update(name, encode_state_as_update(document), presync=True)
-            doc = plane.docs.get(name)
-            if doc is None or doc.lowerer.unsupported:
-                return  # live content unsupported/too big: stays on CPU
-            for slot in doc.seqs.values():
-                if plane.projected_len[slot] > plane.capacity * 3 // 4:
-                    plane.retire_doc(name, "capacity")
-                    return  # no row headroom: recycling would thrash
-            if len(plane.free) < 2:
-                # plane-level headroom: with no spare rows the next new
-                # sequence would plane_full again immediately — each
-                # thrash cycle costs a full-state broadcast plus a
-                # snapshot re-lower, strictly worse than the CPU path
-                plane.retire_doc(name, "plane_full")
+            try:
+                plane.release(name)
+                plane.register(name)
+                plane.enqueue_update(
+                    name, encode_state_as_update(document), presync=True
+                )
+                doc = plane.docs.get(name)
+                if doc is None or doc.lowerer.unsupported:
+                    return  # live content unsupported/too big: stays on CPU
+                # guard retires below use count=False: this incident was
+                # already counted when the original registration retired
+                for slot in doc.seqs.values():
+                    if plane.projected_len[slot] > plane.capacity * 3 // 4:
+                        plane.retire_doc(name, "capacity", count=False)
+                        return  # no row headroom: recycling would thrash
+                if len(plane.free) < 2:
+                    # plane-level headroom: with no spare rows the next
+                    # new sequence would plane_full again immediately —
+                    # each thrash cycle costs a full-state broadcast
+                    # plus a snapshot re-lower, strictly worse than the
+                    # CPU path
+                    plane.retire_doc(name, "plane_full", count=False)
+                    return
+                plane.counters["docs_recycled"] += 1
+                self._attach_serving(name, document)
+            except Exception:
+                # a half-recycled registration (released + re-registered
+                # but never attached) would silently swallow ops: mark
+                # it retired so the doc lives plainly on the CPU path
+                from ..server import logger as _logger_mod
+
+                _logger_mod.log_error(f"recycle failed for {name!r}; staying on CPU")
+                plane.retire_doc(name, "fallback", count=False)
                 return
-            plane.counters["docs_recycled"] += 1
-            self._attach_serving(name, document)
         self._schedule_flush()
 
     def _detach_serving(self, name: str, document) -> None:
@@ -1057,9 +1083,7 @@ class TpuMergeExtension(Extension):
 
         def run() -> None:
             self._flush_handle = None
-            task = asyncio.ensure_future(self._flush_now())
-            self._flush_tasks.add(task)
-            task.add_done_callback(self._flush_tasks.discard)
+            self._spawn_tracked(self._flush_now())
 
         self._flush_handle = asyncio.get_event_loop().call_later(
             self.flush_interval_ms / 1000, run
