@@ -185,10 +185,14 @@ class Item:
                 self.parent_sub = self.right.parent_sub
         elif isinstance(self.parent, ID):
             parent_item = store.get_item(self.parent)
-            if isinstance(parent_item, GC):
-                self.parent = None
-            else:
-                self.parent = parent_item.content.type  # type: ignore[union-attr]
+            # the parent item may be a GC struct, or a deleted item
+            # whose content was collected to ContentDeleted: yjs reads
+            # `.type` off it and gets `undefined` (JS member access on
+            # a content without the field), integrating the child
+            # parentless — mirror that instead of raising
+            content = getattr(parent_item, "content", None)
+            parent_type = getattr(content, "type", None)
+            self.parent = parent_type
         elif isinstance(self.parent, str):
             # root type reference by name
             self.parent = transaction.doc.get(self.parent)

@@ -336,8 +336,16 @@ class UndoManager(Observable):
                 parent_item = store.get_item_clean_start(transaction, parent_item.redone)
 
         parent_type = (
-            item.parent if parent_item is None else parent_item.content.type
+            item.parent
+            if parent_item is None
+            # collected parents have ContentDeleted: `.type` is gone
+            else getattr(parent_item.content, "type", None)
         )
+        if parent_type is None:
+            # the parent's redone chain ended at a collected item:
+            # there is no live type to redo into — refuse the redo
+            # (the downstream list/map walks would dereference it)
+            return None
 
         if item.parent_sub is None:
             # list position: walk left/right neighbors through redone

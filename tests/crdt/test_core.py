@@ -500,3 +500,61 @@ async def test_subdoc_containing_doc_served_via_cpu_path():
         a.destroy()
         b.destroy()
         await server.destroy()
+
+
+def test_insert_into_concurrently_deleted_collected_parent():
+    """An item whose wire parent is an ID pointing at an item whose
+    content was collected to ContentDeleted must integrate parentless
+    (yjs reads `.type` off ContentDeleted as `undefined`), NOT raise.
+
+    The live shape: editor B types into element E while editor A
+    concurrently deletes E; the server applies A's delete (E's content
+    gc'd to a deleted run) before B's insert arrives. Previously this
+    raised AttributeError and the server closed B's connection —
+    silent divergence for B.
+    """
+    from hocuspocus_tpu.crdt import (
+        Doc,
+        YXmlElement,
+        YXmlText,
+        apply_update,
+        diff_update,
+        encode_state_as_update,
+        encode_state_vector,
+    )
+
+    a = Doc()
+    frag = a.get_xml_fragment("x")
+    el = YXmlElement("paragraph")
+    frag.push([el])
+    base = encode_state_as_update(a)
+
+    b = Doc()
+    apply_update(b, base)
+
+    # B types into the (still-empty) element: the text item's wire
+    # parent is E's item ID (no origins)
+    b_el = b.get_xml_fragment("x").to_array()[0]
+    text = YXmlText()
+    b_el.push([text])
+    text.insert(0, "typed into a doomed element")
+    u_b = diff_update(encode_state_as_update(b), encode_state_vector(a))
+
+    # A concurrently deletes E (subtree collected)
+    frag.delete(0, 1)
+    u_a = diff_update(encode_state_as_update(a), encode_state_vector(b))
+
+    # server view: delete first, then B's insert
+    server = Doc()
+    apply_update(server, base)
+    apply_update(server, u_a)
+    apply_update(server, u_b)  # must not raise
+
+    # all replicas converge on the element being gone
+    apply_update(b, u_a)
+    apply_update(a, u_b)
+    assert (
+        server.get_xml_fragment("x").to_string()
+        == a.get_xml_fragment("x").to_string()
+        == b.get_xml_fragment("x").to_string()
+    )

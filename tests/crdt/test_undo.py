@@ -183,3 +183,50 @@ def test_undo_events():
     um.redo()
     assert "undo" in added and "redo" in added
     assert popped == ["undo", "redo"]
+
+
+def test_undo_redo_after_parent_collected_is_graceful():
+    """Undo/redo whose target parent was concurrently deleted and
+    collected must refuse gracefully (yjs-style) — never raise — and
+    leave all replicas consistent."""
+    from hocuspocus_tpu.crdt import (
+        Doc,
+        YXmlElement,
+        YXmlText,
+        apply_update,
+        diff_update,
+        encode_state_as_update,
+        encode_state_vector,
+    )
+
+    a = Doc()
+    frag_a = a.get_xml_fragment("x")
+    el = YXmlElement("paragraph")
+    frag_a.push([el])
+    text = YXmlText()
+    el.push([text])
+    text.insert(0, "tracked content")
+    base = encode_state_as_update(a)
+
+    b = Doc()
+    apply_update(b, base)
+    b_el = b.get_xml_fragment("x").to_array()[0]
+    b_text = b_el.to_array()[0]
+    undo = UndoManager(b_text, capture_timeout=0)
+    b_text.delete(0, 7)  # tracked delete (undo => re-insert into el)
+    u_b = diff_update(encode_state_as_update(b), encode_state_vector(a))
+
+    # A concurrently deletes the whole element; cross-sync
+    frag_a.delete(0, 1)
+    u_a = diff_update(encode_state_as_update(a), encode_state_vector(b))
+    apply_update(a, u_b)
+    apply_update(b, u_a)
+
+    # undoing the tracked delete targets a collected parent: must not
+    # raise, whatever the outcome (refused or parentless no-op)
+    undo.undo()
+    undo.redo()
+
+    assert (
+        a.get_xml_fragment("x").to_string() == b.get_xml_fragment("x").to_string()
+    )
