@@ -315,3 +315,67 @@ def test_plane_fuzz_reload_from_gc_snapshot(seed):
             rebuilt = Doc()
             apply_update(rebuilt, served)
             assert _doc_fingerprint(rebuilt) == _doc_fingerprint(cpu), (seed, step)
+
+
+@pytest.mark.parametrize("seed", [8, 21])
+async def test_plane_fuzz_recycle_churn_with_concurrent_editors(seed):
+    """Randomized paragraph churn from two live editors over a small
+    serve-mode plane: recycles, plane_full retires and CPU fallbacks
+    interleave with live traffic, and every replica must converge.
+
+    Seed 8 of this harness found the collected-parent integration crash
+    (an item whose wire parent was concurrently deleted and collected
+    raised instead of integrating parentless, silently diverging the
+    sender's peer — see tests/crdt/test_core.py regression).
+    """
+    import asyncio
+    import random
+
+    from hocuspocus_tpu.crdt import YXmlElement, YXmlText
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+    from tests.utils import new_hocuspocus, new_provider, wait_synced
+
+    rng = random.Random(seed)
+    ext = TpuMergeExtension(
+        num_docs=rng.choice([16, 24]),
+        capacity=rng.choice([256, 512]),
+        flush_interval_ms=1,
+        serve=True,
+    )
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="rf")
+    b = new_provider(server, name="rf")
+    try:
+        await wait_synced(a, b)
+        for wave in range(rng.randint(8, 16)):
+            for who, p in (("a", a), ("b", b)):
+                frag = p.document.get_xml_fragment("x")
+                if rng.random() < 0.9:
+                    el = YXmlElement("paragraph")
+                    frag.push([el])
+                    text = YXmlText()
+                    el.push([text])
+                    text.insert(0, f"{who}{wave} " * rng.randint(2, 12))
+                while len(frag) > rng.randint(2, 4):
+                    frag.delete(0, 1)
+            await asyncio.sleep(rng.choice([0.0, 0.01, 0.03]))
+
+        from tests.utils import retryable_assertion
+
+        def converged():
+            fa = a.document.get_xml_fragment("x").to_string()
+            fb = b.document.get_xml_fragment("x").to_string()
+            fs = server.documents["rf"].get_xml_fragment("x").to_string()
+            assert fa == fb == fs, (
+                seed,
+                {k: v for k, v in ext.plane.counters.items() if v},
+                len(fa),
+                len(fb),
+                len(fs),
+            )
+
+        await retryable_assertion(converged, timeout=30)
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
