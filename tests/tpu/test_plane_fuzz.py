@@ -379,3 +379,67 @@ async def test_plane_fuzz_recycle_churn_with_concurrent_editors(seed):
         a.destroy()
         b.destroy()
         await server.destroy()
+
+
+@pytest.mark.parametrize("seed", [3, 17])
+async def test_plane_fuzz_concurrent_mixed_map_array_text_live_server(seed):
+    """Two live editors racing LWW map writes/deletes, array inserts
+    and text edits on ONE doc through the serve-mode server: all three
+    replicas (both editors + server doc) converge on every root type.
+    Complements the single-editor mixed-content fuzz (above) with the
+    concurrent case, config-4's content shape."""
+    import asyncio
+    import random
+
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+    from tests.utils import (
+        new_hocuspocus,
+        new_provider,
+        retryable_assertion,
+        wait_synced,
+    )
+
+    rng = random.Random(seed)
+    ext = TpuMergeExtension(num_docs=16, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="mixed")
+    b = new_provider(server, name="mixed")
+    try:
+        await wait_synced(a, b)
+        keys = [f"k{i}" for i in range(6)]
+        for step in range(rng.randint(20, 40)):
+            for who, p in (("a", a), ("b", b)):
+                r = rng.random()
+                m = p.document.get_map("mm")
+                arr = p.document.get_array("aa")
+                t = p.document.get_text("tt")
+                if r < 0.35:
+                    m.set(rng.choice(keys), f"{who}{step}-{rng.randint(0, 99)}")
+                elif r < 0.45 and len(m.keys()) > 0:
+                    m.delete(rng.choice(list(m.keys())))
+                elif r < 0.7:
+                    arr.insert(rng.randint(0, len(arr)), [f"{who}{step}"])
+                elif r < 0.8 and len(arr) > 0:
+                    arr.delete(rng.randrange(len(arr)), 1)
+                elif r < 0.95:
+                    t.insert(rng.randint(0, len(t)), f"{who}{step} ")
+                elif len(t) > 4:
+                    t.delete(0, 3)
+            if rng.random() < 0.4:
+                await asyncio.sleep(rng.choice([0.0, 0.005, 0.02]))
+
+        def converged():
+            sdoc = server.documents["mixed"]
+            for x in (a.document, b.document):
+                assert dict(x.get_map("mm").to_json()) == dict(
+                    sdoc.get_map("mm").to_json()
+                )
+                assert x.get_array("aa").to_json() == sdoc.get_array("aa").to_json()
+                assert x.get_text("tt").to_string() == sdoc.get_text("tt").to_string()
+
+        await retryable_assertion(converged, timeout=30)
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
