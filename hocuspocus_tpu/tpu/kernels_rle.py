@@ -1,0 +1,342 @@
+"""Run-length batched text-CRDT integration (prototype, JAX).
+
+The unit-granular arena (`kernels.py`) spends one slot per UTF-16 unit
+forever — tombstoned text keeps its slots, so a long-lived busy doc
+exhausts cumulative capacity no matter its live size (the documented
+limit in docs/tpu/merge-plane.md). This module is the run-length
+answer: one arena entry per RUN of consecutively-typed units. Typing
+bursts cost one entry; deletes tombstone whole entries; entry growth is
+O(ops + splits), not O(units), so tombstone cost is O(fragmentation).
+
+Same architecture as the unit kernel — APPEND-ONLY entries + dense
+UNIT-rank ordering, elementwise compares/selects + masked reductions,
+no gathers — with two structural insights:
+
+- Within a run, unit i's left origin is unit i-1 (that is what makes
+  it a run), so only run HEADS can block a YATA conflict scan; the one
+  exception is the unit at rank left_rank+1 inside a run, which ties
+  on client id. The scan stays a couple of masked reductions.
+- Unit ranks are DENSE (0..total_units), so "how many window units are
+  skipped" needs no counting reduction: the insertion rank is simply
+  `min(first_block_rank, right_rank)`.
+
+Inserting or deleting into the middle of a run SPLITS it; both cases
+reduce to two primitives (`_split_at_rank`, `_split_at_clock`) that
+append the run's tail as a fresh entry (≤2 appends per op, bounded).
+
+Status: CPU-validated prototype, NOT yet wired into the merge plane
+(serving keeps the unit arena; see tests/tpu/test_kernels_rle.py for
+the equivalence suite against it). The Pallas/VMEM-resident variant
+and plane wiring are the productionization step, which needs chip
+time to validate.
+
+Reference semantics mirrored: yjs Item.integrate via
+`/root/reference/packages/server/src/MessageReceiver.ts` readUpdate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import KIND_DELETE, KIND_INSERT, KIND_NOOP, NONE_CLIENT, OpBatch
+
+_INF = 0x7FFFFFFF
+
+
+class RleState(NamedTuple):
+    """Run-length arena for a batch of documents. Leading axis = doc."""
+
+    run_client: jax.Array  # (D, R) uint32 — author of the run
+    run_clock: jax.Array  # (D, R) int32 — clock of the first unit
+    run_len: jax.Array  # (D, R) int32 — units in this entry
+    run_rank: jax.Array  # (D, R) int32 — UNIT rank of the first unit
+    run_orank: jax.Array  # (D, R) int32 — origin UNIT rank of the first unit
+    run_deleted: jax.Array  # (D, R) bool
+    num_runs: jax.Array  # (D,) int32 — occupied entries
+    total_units: jax.Array  # (D,) int32 — rank-space size (live + tombstones)
+    overflow: jax.Array  # (D,) bool
+
+
+def make_empty_rle_state(num_docs: int, entries: int) -> RleState:
+    shape = (num_docs, entries)
+    return RleState(
+        run_client=jnp.full(shape, NONE_CLIENT, jnp.uint32),
+        run_clock=jnp.zeros(shape, jnp.int32),
+        run_len=jnp.zeros(shape, jnp.int32),
+        run_rank=jnp.full(shape, _INF, jnp.int32),
+        run_orank=jnp.full(shape, -1, jnp.int32),
+        run_deleted=jnp.zeros(shape, bool),
+        num_runs=jnp.zeros((num_docs,), jnp.int32),
+        total_units=jnp.zeros((num_docs,), jnp.int32),
+        overflow=jnp.zeros((num_docs,), bool),
+    )
+
+
+def _append_entry(state: RleState, lane, do, client, clock, length, rank, orank, deleted):
+    """Write one entry at `lane` when `do` (single doc, elementwise)."""
+    r = state.run_client.shape[0]
+    idx = jnp.arange(r, dtype=jnp.int32)
+    at = do & (idx == lane)
+    return state._replace(
+        run_client=jnp.where(at, client, state.run_client),
+        run_clock=jnp.where(at, clock, state.run_clock),
+        run_len=jnp.where(at, length, state.run_len),
+        run_rank=jnp.where(at, rank, state.run_rank),
+        run_orank=jnp.where(at, orank, state.run_orank),
+        run_deleted=jnp.where(at, deleted, state.run_deleted),
+        num_runs=state.num_runs + do.astype(jnp.int32),
+    )
+
+
+def _split_at_rank(state: RleState, rank, do):
+    """Split the entry strictly containing unit-rank `rank` (if any).
+
+    The head keeps its lane (len shortened); the tail appends at
+    num_runs with orank = rank-1 (within-run chaining). No entry
+    contains `rank` strictly when it is a run boundary — no-op then.
+    """
+    idx = jnp.arange(state.run_client.shape[0], dtype=jnp.int32)
+    occupied = idx < state.num_runs
+    inside = (
+        do
+        & occupied
+        & (state.run_rank < rank)
+        & (rank < state.run_rank + state.run_len)
+    )
+    any_split = jnp.any(inside)
+    # at most ONE entry strictly contains a given rank, so masked SUMS
+    # extract its fields exactly (masked max would misread uint32
+    # client ids with the high bit set through an int32 view)
+    off = jnp.sum(jnp.where(inside, rank - state.run_rank, 0))
+    t_client = jnp.sum(
+        jnp.where(inside, state.run_client, jnp.uint32(0)), dtype=jnp.uint32
+    )
+    t_clock = jnp.sum(jnp.where(inside, state.run_clock + off, 0))
+    t_len = jnp.sum(jnp.where(inside, state.run_len - off, 0))
+    t_deleted = jnp.any(inside & state.run_deleted)
+    shortened = jnp.where(inside, off, state.run_len)
+    state = state._replace(run_len=shortened)
+    return _append_entry(
+        state, state.num_runs, any_split, t_client, t_clock, t_len, rank, rank - 1,
+        t_deleted,
+    )
+
+
+def _split_at_clock(state: RleState, client, clock, do):
+    """Split the entry of `client` strictly containing `clock` (if any)."""
+    idx = jnp.arange(state.run_client.shape[0], dtype=jnp.int32)
+    occupied = idx < state.num_runs
+    inside = (
+        do
+        & occupied
+        & (state.run_client == client)
+        & (state.run_clock < clock)
+        & (clock < state.run_clock + state.run_len)
+    )
+    any_split = jnp.any(inside)
+    off = jnp.sum(jnp.where(inside, clock - state.run_clock, 0))
+    t_rank = jnp.sum(jnp.where(inside, state.run_rank + off, 0))
+    t_len = jnp.sum(jnp.where(inside, state.run_len - off, 0))
+    t_deleted = jnp.any(inside & state.run_deleted)
+    shortened = jnp.where(inside, off, state.run_len)
+    state = state._replace(run_len=shortened)
+    return _append_entry(
+        state, state.num_runs, any_split, client, clock, t_len, t_rank, t_rank - 1,
+        t_deleted,
+    )
+
+
+def _integrate_one_rle(state: RleState, op: OpBatch) -> RleState:
+    """Integrate a single op into a single document (unbatched)."""
+    r = state.run_client.shape[0]
+    idx = jnp.arange(r, dtype=jnp.int32)
+    occupied = idx < state.num_runs
+
+    # -- resolve origin ids to UNIT ranks (range membership) ---------------
+    in_left = (
+        occupied
+        & (state.run_client == op.left_client)
+        & (op.left_clock >= state.run_clock)
+        & (op.left_clock < state.run_clock + state.run_len)
+    )
+    has_left = op.left_client != jnp.uint32(NONE_CLIENT)
+    left_found = jnp.any(in_left)
+    left_rank = jnp.where(
+        has_left,
+        jnp.max(jnp.where(in_left, state.run_rank + (op.left_clock - state.run_clock), -1)),
+        -1,
+    )
+    in_right = (
+        occupied
+        & (state.run_client == op.right_client)
+        & (op.right_clock >= state.run_clock)
+        & (op.right_clock < state.run_clock + state.run_len)
+    )
+    has_right = op.right_client != jnp.uint32(NONE_CLIENT)
+    right_found = jnp.any(in_right)
+    right_rank = jnp.where(
+        has_right,
+        jnp.max(
+            jnp.where(in_right, state.run_rank + (op.right_clock - state.run_clock), -1)
+        ),
+        state.total_units,
+    )
+
+    # -- YATA conflict scan over run heads ---------------------------------
+    # Only two unit shapes can BLOCK (see module docstring): an
+    # in-window run head whose origin precedes the window, and the
+    # non-head unit at rank left_rank+1 (its origin IS left), both
+    # losing the client-id tie against op.client.
+    client_ge = ~(state.run_client < op.client)
+    head_in_window = occupied & (state.run_rank > left_rank) & (state.run_rank < right_rank)
+    head_blocked = head_in_window & (
+        (state.run_orank < left_rank)
+        | ((state.run_orank == left_rank) & client_ge)
+    )
+    succ = left_rank + 1  # the unit right after left, when inside a run
+    succ_nonhead = (
+        occupied
+        & (state.run_rank < succ)
+        & (succ < state.run_rank + state.run_len)
+        & (succ < right_rank)
+    )
+    succ_blocked = succ_nonhead & client_ge
+    first_block = jnp.minimum(
+        jnp.min(jnp.where(head_blocked, state.run_rank, _INF)),
+        jnp.min(jnp.where(succ_blocked, succ, _INF)),
+    )
+    # dense rank space: skipped window units need no counting reduction
+    ins_rank = jnp.minimum(first_block, right_rank)
+
+    run = op.run_len
+    fits = state.num_runs + 2 <= r
+    deps_ok = (~has_left | left_found) & (~has_right | right_found)
+    do_insert = (op.kind == KIND_INSERT) & fits & deps_ok
+
+    # -- insert: split the straddled run, bump ranks, append ---------------
+    state = _split_at_rank(state, ins_rank, do_insert)
+    occupied2 = jnp.arange(r, dtype=jnp.int32) < state.num_runs
+    bump_rank = do_insert & occupied2 & (state.run_rank >= ins_rank)
+    bump_orank = do_insert & occupied2 & (state.run_orank >= ins_rank)
+    state = state._replace(
+        run_rank=jnp.where(bump_rank, state.run_rank + run, state.run_rank),
+        run_orank=jnp.where(bump_orank, state.run_orank + run, state.run_orank),
+    )
+    state = _append_entry(
+        state,
+        state.num_runs,
+        do_insert,
+        op.client,
+        op.clock,
+        run,
+        ins_rank,
+        left_rank,
+        False,
+    )
+    state = state._replace(
+        total_units=state.total_units + jnp.where(do_insert, run, 0),
+        overflow=state.overflow | ((op.kind == KIND_INSERT) & ~fits),
+    )
+
+    # -- delete: split at both boundaries, tombstone covered entries -------
+    # capture the capacity verdict BEFORE the splits mutate num_runs
+    # (like the insert path's `fits`): a delete that fit must not flag
+    # sticky overflow just because its own splits consumed the margin
+    del_fits = state.num_runs + 2 <= r
+    do_delete = (op.kind == KIND_DELETE) & del_fits
+    del_end = op.clock + op.run_len
+    state = _split_at_clock(state, op.client, op.clock, do_delete)
+    state = _split_at_clock(state, op.client, del_end, do_delete)
+    occupied3 = jnp.arange(r, dtype=jnp.int32) < state.num_runs
+    covered = (
+        do_delete
+        & occupied3
+        & (state.run_client == op.client)
+        & (state.run_clock >= op.clock)
+        & (state.run_clock + state.run_len <= del_end)
+    )
+    state = state._replace(
+        run_deleted=state.run_deleted | covered,
+        overflow=state.overflow | ((op.kind == KIND_DELETE) & ~del_fits),
+    )
+    return state
+
+
+_integrate_batch_rle = jax.vmap(_integrate_one_rle)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def integrate_ops_rle(state: RleState, ops: OpBatch) -> RleState:
+    """Integrate one op per document (noop slots pass through)."""
+    return _integrate_batch_rle(state, ops)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def integrate_op_slots_rle(state: RleState, ops: OpBatch):
+    """Integrate (K, D)-shaped op slots via lax.scan, like the unit
+    kernel's integrate_op_slots."""
+
+    def step(carry, slot_ops):
+        return _integrate_batch_rle(carry, slot_ops), None
+
+    state, _ = jax.lax.scan(step, state, ops)
+    count = jnp.sum(ops.kind != KIND_NOOP)
+    count, _ = jax.lax.optimization_barrier((count, state.total_units))
+    return state, count
+
+
+# -- host-side extraction ----------------------------------------------------
+
+
+def expand_to_units(state: RleState, doc: int):
+    """Document order as parallel unit arrays (client, clock, deleted),
+    sorted by rank — the comparison form used by the equivalence tests
+    and any host consumer."""
+    import numpy as np
+
+    n = int(np.asarray(state.num_runs)[doc])
+    client = np.asarray(state.run_client)[doc][:n]
+    clock = np.asarray(state.run_clock)[doc][:n]
+    length = np.asarray(state.run_len)[doc][:n]
+    rank = np.asarray(state.run_rank)[doc][:n]
+    deleted = np.asarray(state.run_deleted)[doc][:n]
+    keep = length > 0  # split heads shortened to zero never re-emit
+    client, clock, length, rank, deleted = (
+        client[keep], clock[keep], length[keep], rank[keep], deleted[keep],
+    )
+    order = np.argsort(rank)
+    out_client = np.concatenate(
+        [np.full(length[i], client[i], np.uint32) for i in order]
+    ) if len(order) else np.zeros(0, np.uint32)
+    out_clock = np.concatenate(
+        [clock[i] + np.arange(length[i], dtype=np.int32) for i in order]
+    ) if len(order) else np.zeros(0, np.int32)
+    out_deleted = np.concatenate(
+        [np.full(length[i], deleted[i], bool) for i in order]
+    ) if len(order) else np.zeros(0, bool)
+    return out_client, out_clock, out_deleted
+
+
+def delete_ranges(state: RleState, doc: int):
+    """Tombstones as sorted (client, clock, length) ranges — direct from
+    deleted entries (the unit arena needs a per-unit pair scan here)."""
+    import numpy as np
+
+    n = int(np.asarray(state.num_runs)[doc])
+    client = np.asarray(state.run_client)[doc][:n]
+    clock = np.asarray(state.run_clock)[doc][:n]
+    length = np.asarray(state.run_len)[doc][:n]
+    deleted = np.asarray(state.run_deleted)[doc][:n]
+    sel = deleted & (length > 0)
+    ranges = sorted(zip(client[sel].tolist(), clock[sel].tolist(), length[sel].tolist()))
+    merged: list[tuple] = []
+    for c, k, l in ranges:
+        if merged and merged[-1][0] == c and merged[-1][1] + merged[-1][2] == k:
+            merged[-1] = (c, merged[-1][1], merged[-1][2] + l)
+        else:
+            merged.append((c, k, l))
+    return merged

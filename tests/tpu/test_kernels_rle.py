@@ -1,0 +1,275 @@
+"""Run-length arena ≡ unit arena, on identical op streams.
+
+The RLE kernel (tpu/kernels_rle.py) must produce the same DOCUMENT —
+unit ids in rank order plus the same tombstone set — as the unit
+kernel for every stream the unit kernel accepts, while consuming
+O(runs) entries instead of O(units) slots. Streams come from three
+sources: the bench generator's random-position insert/delete shape,
+adversarial concurrent-sibling batches (YATA ties), and real yjs
+updates lowered from CPU docs.
+"""
+
+import numpy as np
+import pytest
+
+from hocuspocus_tpu.tpu.kernels import (
+    KIND_DELETE,
+    KIND_INSERT,
+    NONE_CLIENT,
+    OpBatch,
+    integrate_op_slots,
+    make_empty_state,
+)
+from hocuspocus_tpu.tpu.kernels_rle import (
+    delete_ranges,
+    expand_to_units,
+    integrate_op_slots_rle,
+    make_empty_rle_state,
+)
+
+
+def _unit_doc(state, doc):
+    """(client, clock, deleted) arrays in rank order from the unit arena."""
+    length = int(np.asarray(state.length)[doc])
+    client = np.asarray(state.id_client)[doc][:length]
+    clock = np.asarray(state.id_clock)[doc][:length]
+    rank = np.asarray(state.rank)[doc][:length]
+    deleted = np.asarray(state.deleted)[doc][:length]
+    order = np.argsort(rank)
+    return client[order], clock[order], deleted[order]
+
+
+def _ops_from_list(ops_list, num_docs=1):
+    """(K, D) OpBatch from per-doc lists of op tuples."""
+    k = max(len(col) for col in ops_list)
+    fields = {
+        "kind": np.zeros((k, num_docs), np.int32),
+        "client": np.zeros((k, num_docs), np.uint32),
+        "clock": np.zeros((k, num_docs), np.int32),
+        "run_len": np.zeros((k, num_docs), np.int32),
+        "left_client": np.full((k, num_docs), NONE_CLIENT, np.uint32),
+        "left_clock": np.zeros((k, num_docs), np.int32),
+        "right_client": np.full((k, num_docs), NONE_CLIENT, np.uint32),
+        "right_clock": np.zeros((k, num_docs), np.int32),
+    }
+    for d, col in enumerate(ops_list):
+        for i, op in enumerate(col):
+            for name, value in op.items():
+                fields[name][i, d] = value
+    return OpBatch(**fields)
+
+
+def _run_both(ops, num_docs, capacity=512, entries=256):
+    unit = make_empty_state(num_docs, capacity)
+    rle = make_empty_rle_state(num_docs, entries)
+    unit, cu = integrate_op_slots(unit, ops)
+    rle, cr = integrate_op_slots_rle(rle, ops)
+    assert int(cu) == int(cr)
+    assert not bool(np.asarray(unit.overflow).any())
+    assert not bool(np.asarray(rle.overflow).any())
+    return unit, rle
+
+
+def _assert_docs_equal(unit, rle, num_docs):
+    for d in range(num_docs):
+        uc, uk, ud = _unit_doc(unit, d)
+        rc, rk, rd = expand_to_units(rle, d)
+        assert np.array_equal(uc, rc), d
+        assert np.array_equal(uk, rk), d
+        assert np.array_equal(ud, rd), d
+
+
+def test_typing_run_costs_one_entry():
+    """A 100-unit typed burst: 100 unit slots vs ONE rle entry."""
+    ops = _ops_from_list(
+        [[dict(kind=KIND_INSERT, client=7, clock=0, run_len=100)]]
+    )
+    unit, rle = _run_both(ops, 1)
+    _assert_docs_equal(unit, rle, 1)
+    assert int(np.asarray(unit.length)[0]) == 100
+    assert int(np.asarray(rle.num_runs)[0]) == 1
+
+
+def test_mid_run_insert_splits():
+    """Insert anchored mid-run splits it: 3 entries, same document."""
+    ops = _ops_from_list(
+        [
+            [
+                dict(kind=KIND_INSERT, client=7, clock=0, run_len=10),
+                # client 3 < 7 loses the YATA tie against the unit at
+                # left_rank+1, so it blocks there and the run SPLITS
+                dict(
+                    kind=KIND_INSERT, client=3, clock=0, run_len=4,
+                    left_client=7, left_clock=4,
+                ),
+            ]
+        ]
+    )
+    unit, rle = _run_both(ops, 1)
+    _assert_docs_equal(unit, rle, 1)
+    assert int(np.asarray(rle.num_runs)[0]) == 3
+
+
+def test_concurrent_siblings_order_by_client_id():
+    """YATA tie: two inserts with the same left origin — ascending
+    client id order, and an insert INTO the winner's run."""
+    ops = _ops_from_list(
+        [
+            [
+                dict(kind=KIND_INSERT, client=500, clock=0, run_len=6),
+                dict(
+                    kind=KIND_INSERT, client=100, clock=0, run_len=3,
+                    left_client=500, left_clock=2,
+                ),
+                dict(
+                    kind=KIND_INSERT, client=900, clock=0, run_len=2,
+                    left_client=500, left_clock=2,
+                ),
+                dict(
+                    kind=KIND_INSERT, client=700, clock=50, run_len=2,
+                    left_client=100, left_clock=1,
+                ),
+            ]
+        ]
+    )
+    unit, rle = _run_both(ops, 1)
+    _assert_docs_equal(unit, rle, 1)
+
+
+def test_high_bit_client_ids():
+    """uint32 client ids above 2^31 (real yjs ids are random uint32)."""
+    big, huge = 0x9000_0001, 0xF000_0000
+    ops = _ops_from_list(
+        [
+            [
+                dict(kind=KIND_INSERT, client=big, clock=0, run_len=5),
+                dict(
+                    kind=KIND_INSERT, client=huge, clock=0, run_len=3,
+                    left_client=big, left_clock=1,
+                ),
+                dict(kind=KIND_DELETE, client=big, clock=1, run_len=2),
+            ]
+        ]
+    )
+    unit, rle = _run_both(ops, 1)
+    _assert_docs_equal(unit, rle, 1)
+
+
+def test_delete_splits_and_ranges():
+    """Partial deletes split runs; delete_ranges reports exact merged
+    id-ranges without a per-unit scan."""
+    ops = _ops_from_list(
+        [
+            [
+                dict(kind=KIND_INSERT, client=7, clock=0, run_len=20),
+                dict(kind=KIND_DELETE, client=7, clock=5, run_len=4),
+                dict(kind=KIND_DELETE, client=7, clock=9, run_len=2),  # adjacent
+                dict(kind=KIND_DELETE, client=7, clock=15, run_len=3),
+            ]
+        ]
+    )
+    unit, rle = _run_both(ops, 1)
+    _assert_docs_equal(unit, rle, 1)
+    assert delete_ranges(rle, 0) == [(7, 5, 6), (7, 15, 3)]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_streams_match_unit_kernel(seed):
+    """The bench generator's shape: multi-doc random-position inserts
+    and id-range deletes, sequential clocks per doc-author."""
+    rng = np.random.default_rng(seed)
+    num_docs, slots = 8, 48
+    cols = []
+    for _ in range(num_docs):
+        next_clock = 0
+        col = []
+        for _ in range(slots):
+            if next_clock > 8 and rng.random() < 0.25:
+                start = int(rng.integers(0, next_clock - 4))
+                col.append(
+                    dict(
+                        kind=KIND_DELETE, client=7, clock=start,
+                        run_len=int(rng.integers(1, 4)),
+                    )
+                )
+            else:
+                run = int(rng.integers(1, 6))
+                op = dict(kind=KIND_INSERT, client=7, clock=next_clock, run_len=run)
+                if next_clock > 0:
+                    origin = int(rng.integers(0, next_clock))
+                    op.update(left_client=7, left_clock=origin)
+                col.append(op)
+                next_clock += run
+        cols.append(col)
+    ops = _ops_from_list(cols, num_docs)
+    unit, rle = _run_both(ops, num_docs, capacity=512, entries=256)
+    _assert_docs_equal(unit, rle, num_docs)
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_real_lowered_docs_match_unit_kernel(seed):
+    """Real yjs update streams (two CPU replicas cross-merging) lowered
+    by the production DocLowerer, fed to both kernels."""
+    import random
+
+    from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+    from hocuspocus_tpu.tpu.lowering import DocLowerer
+
+    rng = random.Random(seed)
+    a, b = Doc(), Doc()
+    docs = [a, b]
+    updates: list[bytes] = []
+    for doc in docs:
+        doc.on("update", lambda u, *r: updates.append(u))
+    for step in range(40):
+        doc = docs[rng.randrange(2)]
+        text = doc.get_text("t")
+        if len(text) > 4 and rng.random() < 0.3:
+            pos = rng.randrange(len(text) - 2)
+            text.delete(pos, rng.randint(1, 2))
+        else:
+            text.insert(rng.randint(0, len(text)), rng.choice("abcdef") * rng.randint(1, 5))
+        if rng.random() < 0.4:
+            apply_update(a, encode_state_as_update(b))
+            apply_update(b, encode_state_as_update(a))
+    apply_update(a, encode_state_as_update(b))
+
+    lowerer = DocLowerer()
+    seq_ops, map_ops, tombs = lowerer.lower_update(encode_state_as_update(a))
+    assert not lowerer.unsupported and not map_ops and not tombs
+    (ops_list,) = seq_ops.values()
+    col = [
+        dict(
+            kind=op.kind,
+            client=op.client,
+            clock=op.clock,
+            run_len=op.run_len,
+            left_client=op.left_client,
+            left_clock=op.left_clock,
+            right_client=op.right_client,
+            right_clock=op.right_clock,
+        )
+        for op in ops_list
+    ]
+    ops = _ops_from_list([col])
+    unit, rle = _run_both(ops, 1, capacity=1024, entries=512)
+    _assert_docs_equal(unit, rle, 1)
+
+
+def test_delete_splits_do_not_flag_overflow_at_tight_capacity():
+    """A delete whose own boundary splits consume the last free entries
+    must succeed WITHOUT sticky overflow (the capacity verdict is taken
+    before the splits mutate num_runs)."""
+    ops = _ops_from_list(
+        [
+            [
+                dict(kind=KIND_INSERT, client=7, clock=0, run_len=20),
+                dict(kind=KIND_DELETE, client=7, clock=5, run_len=4),
+            ]
+        ]
+    )
+    rle = make_empty_rle_state(1, 4)
+    rle, _ = integrate_op_slots_rle(rle, ops)
+    assert not bool(np.asarray(rle.overflow)[0])
+    assert int(np.asarray(rle.num_runs)[0]) == 3
+    assert delete_ranges(rle, 0) == [(7, 5, 4)]
