@@ -273,3 +273,68 @@ def test_delete_splits_do_not_flag_overflow_at_tight_capacity():
     assert not bool(np.asarray(rle.overflow)[0])
     assert int(np.asarray(rle.num_runs)[0]) == 3
     assert delete_ranges(rle, 0) == [(7, 5, 4)]
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_incremental_batches_match_unit_kernel(seed):
+    """Serving feeds ops incrementally across flush batches, not as one
+    snapshot: lower each replica update as it arrives (one production
+    DocLowerer, causal buffering included) and integrate batch by
+    batch, comparing the two arenas after EVERY batch."""
+    import random
+
+    from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+    from hocuspocus_tpu.crdt.update import encode_state_vector
+    from hocuspocus_tpu.tpu.lowering import DocLowerer
+
+    rng = random.Random(seed)
+    a, b = Doc(), Doc()
+    lowerer = DocLowerer()
+    unit = make_empty_state(1, 2048)
+    rle = make_empty_rle_state(1, 1024)
+    pending: list[dict] = []
+
+    def ship(doc, other):
+        nonlocal unit, rle, pending
+        update = encode_state_as_update(doc, encode_state_vector(other))
+        seq_ops, m, t = lowerer.lower_update(update)
+        assert not lowerer.unsupported and not m and not t
+        for ops_list in seq_ops.values():
+            for op in ops_list:
+                pending.append(
+                    dict(
+                        kind=op.kind, client=op.client, clock=op.clock,
+                        run_len=op.run_len, left_client=op.left_client,
+                        left_clock=op.left_clock, right_client=op.right_client,
+                        right_clock=op.right_clock,
+                    )
+                )
+
+    for step in range(30):
+        doc = a if rng.random() < 0.5 else b
+        text = doc.get_text("t")
+        if len(text) > 5 and rng.random() < 0.3:
+            text.delete(rng.randrange(len(text) - 2), rng.randint(1, 2))
+        else:
+            text.insert(
+                rng.randint(0, len(text)), rng.choice("xyzw") * rng.randint(1, 6)
+            )
+        # cross-merge sometimes so each replica builds on the other
+        if rng.random() < 0.5:
+            apply_update(a, encode_state_as_update(b))
+            apply_update(b, encode_state_as_update(a))
+        # ship this replica's new ops to the "server" arenas
+        ship(doc, Doc())  # full diff vs empty = everything; lowerer dedups
+        if pending and rng.random() < 0.6:
+            ops = _ops_from_list([pending])
+            pending = []
+            unit, _ = integrate_op_slots(unit, ops)
+            rle, _ = integrate_op_slots_rle(rle, ops)
+            _assert_docs_equal(unit, rle, 1)
+    if pending:
+        ops = _ops_from_list([pending])
+        unit, _ = integrate_op_slots(unit, ops)
+        rle, _ = integrate_op_slots_rle(rle, ops)
+    _assert_docs_equal(unit, rle, 1)
+    assert not bool(np.asarray(unit.overflow)[0])
+    assert not bool(np.asarray(rle.overflow)[0])
