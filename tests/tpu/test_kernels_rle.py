@@ -338,3 +338,50 @@ def test_incremental_batches_match_unit_kernel(seed):
     _assert_docs_equal(unit, rle, 1)
     assert not bool(np.asarray(unit.overflow)[0])
     assert not bool(np.asarray(rle.overflow)[0])
+
+
+def test_rle_kernel_shards_over_doc_mesh():
+    """The RLE integrate runs unchanged under NamedSharding over the
+    doc axis (the virtual 8-device CPU mesh used by every sharding
+    test) and matches the unsharded result — mesh-readiness for the
+    round-4 Pallas/plane wiring."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        import pytest
+
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    mesh = Mesh(np.array(devices[:8]), ("doc",))
+    num_docs = 16
+    cols = []
+    for d in range(num_docs):
+        cols.append(
+            [
+                dict(kind=KIND_INSERT, client=7, clock=0, run_len=8 + d),
+                dict(
+                    kind=KIND_INSERT, client=3, clock=0, run_len=4,
+                    left_client=7, left_clock=2,
+                ),
+                dict(kind=KIND_DELETE, client=7, clock=1, run_len=3),
+            ]
+        )
+    ops = _ops_from_list(cols, num_docs)
+    plain = make_empty_rle_state(num_docs, 64)
+    plain, _ = integrate_op_slots_rle(plain, ops)
+
+    row = NamedSharding(mesh, P("doc"))
+    vec = NamedSharding(mesh, P(None, "doc"))
+    # every state field leads with the doc axis, 1-D and 2-D alike
+    sharded = jax.tree.map(
+        lambda a: jax.device_put(np.asarray(a), row),
+        make_empty_rle_state(num_docs, 64),
+    )
+    sharded_ops = jax.tree.map(lambda a: jax.device_put(np.asarray(a), vec), ops)
+    sharded, _ = integrate_op_slots_rle(sharded, sharded_ops)
+    for d in range(num_docs):
+        pc, pk, pd = expand_to_units(plain, d)
+        sc, sk, sd = expand_to_units(sharded, d)
+        assert np.array_equal(pc, sc) and np.array_equal(pk, sk)
+        assert np.array_equal(pd, sd)
