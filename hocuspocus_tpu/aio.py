@@ -1,0 +1,20 @@
+"""Small asyncio helpers shared across the runtime."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def spawn_tracked(registry: set, coro) -> "asyncio.Task":
+    """Fire-and-forget with a strong reference.
+
+    The event loop only weakly references tasks: an unreferenced
+    fire-and-forget task can be garbage-collected mid-flight and
+    silently never complete (dropping a frame, stalling a pipeline, or
+    stranding a lock acquisition). The caller-owned `registry` set
+    holds the strong ref until the task settles.
+    """
+    task = asyncio.ensure_future(coro)
+    registry.add(task)
+    task.add_done_callback(registry.discard)
+    return task

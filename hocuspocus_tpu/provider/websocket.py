@@ -64,6 +64,14 @@ class HocuspocusProviderWebsocket(Observable):
         self._checker_task: Optional[asyncio.Task] = None
         self._connected_event = asyncio.Event()
         self._destroyed = False
+        # outbound pump: ONE writer task drains this queue in order.
+        # Per-send ensure_future tasks would be weakly referenced (the
+        # loop can GC an unreferenced task mid-flight — a silent frame
+        # drop) and could interleave under write backpressure.
+        self._out_queue: asyncio.Queue = asyncio.Queue()
+        self._pump_task: Optional[asyncio.Task] = None
+        # strong refs for fire-and-forget helper tasks (on_open, closes)
+        self._bg_tasks: set = set()
 
         for name, fn in callbacks.items():
             if name.startswith("on_") and callable(fn):
@@ -88,7 +96,7 @@ class HocuspocusProviderWebsocket(Observable):
         self.should_connect = False
         self.message_queue = []
         if self.ws is not None and not self.ws.closed:
-            asyncio.ensure_future(self.ws.close())
+            self._spawn(self.ws.close())
 
     def destroy(self) -> None:
         if self._destroyed:
@@ -99,8 +107,10 @@ class HocuspocusProviderWebsocket(Observable):
         for task in (self._run_task, self._checker_task):
             if task is not None:
                 task.cancel()
+        if self._pump_task is not None:
+            self._pump_task.cancel()
         if self._session is not None:
-            asyncio.ensure_future(self._session.close())
+            self._spawn(self._session.close())
         self._observers = {}
 
     # -- provider attachment ----------------------------------------------
@@ -110,7 +120,7 @@ class HocuspocusProviderWebsocket(Observable):
         if self.status == WebSocketStatus.Disconnected and self.should_connect:
             self.connect()
         if self.status == WebSocketStatus.Connected:
-            asyncio.ensure_future(provider.on_open())
+            self._spawn(provider.on_open())
 
     def detach(self, provider) -> None:
         if provider.name in self.provider_map:
@@ -123,16 +133,32 @@ class HocuspocusProviderWebsocket(Observable):
 
     def send(self, data: bytes) -> None:
         if self.ws is not None and not self.ws.closed and self.status == WebSocketStatus.Connected:
-            asyncio.ensure_future(self._send_now(data))
+            self._out_queue.put_nowait(data)
         else:
             self.message_queue.append(data)
 
-    async def _send_now(self, data: bytes) -> None:
-        try:
-            if self.ws is not None and not self.ws.closed:
-                await self.ws.send_bytes(data)
-        except Exception:
-            pass
+    def _spawn(self, coro) -> None:
+        from ..aio import spawn_tracked
+
+        spawn_tracked(self._bg_tasks, coro)
+
+    async def _pump(self, ws) -> None:
+        """Drain the outbound queue to one socket, preserving order.
+        A send failure re-queues nothing — the reconnect SyncStep1/2
+        exchange makes recovery lossless (reference provider behavior
+        on reopen) — but it MUST tear the socket down: otherwise the
+        read side can stay open with no outbound consumer, status
+        stuck Connected, every later frame silently swallowed."""
+        while True:
+            data = await self._out_queue.get()
+            try:
+                await ws.send_bytes(data)
+            except Exception:
+                try:
+                    await ws.close()
+                except Exception:
+                    pass
+                return
 
     async def _run(self) -> None:
         attempt = 0
@@ -156,17 +182,19 @@ class HocuspocusProviderWebsocket(Observable):
             attempt = 0
             self.ws = ws
             self.last_message_received = 0.0
+            self._out_queue = asyncio.Queue()  # no frames from a dead socket
+            self._pump_task = asyncio.ensure_future(self._pump(ws))
             self._set_status(WebSocketStatus.Connected)
             self._connected_event.set()
             self.emit("open", {})
             self.emit("connect")
             # notify providers so they authenticate + start sync
             for provider in list(self.provider_map.values()):
-                asyncio.ensure_future(provider.on_open())
-            # flush queued messages
+                self._spawn(provider.on_open())
+            # flush messages queued while disconnected
             queue, self.message_queue = self.message_queue, []
             for data in queue:
-                await self._send_now(data)
+                self._out_queue.put_nowait(data)
             close_event = {"code": 1000, "reason": ""}
             try:
                 async for msg in ws:
@@ -178,6 +206,9 @@ class HocuspocusProviderWebsocket(Observable):
                 pass
             close_event = {"code": ws.close_code or 1000, "reason": ""}
             self.ws = None
+            if self._pump_task is not None:
+                self._pump_task.cancel()
+                self._pump_task = None
             self._connected_event.clear()
             self._set_status(WebSocketStatus.Disconnected)
             self.emit("close", {"event": close_event})

@@ -725,12 +725,9 @@ class TpuMergeExtension(Extension):
             self.serving.flush_failure_handler = self._degrade_all_served
 
     def _spawn_tracked(self, coro) -> None:
-        """Run a background task with a strong reference: the event loop
-        only weakly references tasks, and a GC'd task silently stops the
-        serve pipeline (or strands a lock acquisition mid-await)."""
-        task = asyncio.ensure_future(coro)
-        self._flush_tasks.add(task)
-        task.add_done_callback(self._flush_tasks.discard)
+        from ..aio import spawn_tracked
+
+        spawn_tracked(self._flush_tasks, coro)
 
     # -- hooks ---------------------------------------------------------------
 
