@@ -17,6 +17,7 @@ from typing import Any, Callable, Optional
 
 from ..net.resp import ClusterSubscriber, RedisClient, RedisClusterClient, RedisSubscriber
 from ..protocol.message import IncomingMessage, OutgoingMessage
+from ..aio import spawn_tracked
 from ..server import REDIS_ORIGIN, logger
 from ..server.message_receiver import MessageReceiver
 from ..server.types import Extension, Payload
@@ -93,6 +94,18 @@ class Redis(Extension):
         else:
             self.sub = RedisSubscriber(host, port, on_message=self._handle_incoming_message)
         self.instance = None
+        # plane-served docs: last anti-entropy SyncStep1 publish per
+        # doc, plus trailing timers so a QUIESCENT doc still gets one
+        # final exchange after its last suppressed change (a dropped
+        # window frame must heal even with no further edits)
+        self._last_anti_entropy: dict[str, float] = {}
+        self._anti_entropy_handles: dict[str, object] = {}
+        self.plane_anti_entropy_seconds = 2.0
+        # strong refs for fire-and-forget apply/publish tasks: the loop
+        # only weakly references tasks, and under fan-out load a GC'd
+        # unreferenced task silently drops the apply or the reply
+        # publish (see hocuspocus_tpu/aio.py)
+        self._tasks: set = set()
         self.locks: dict[str, _HeldLock] = {}  # lock key -> held state
         self._pending_disconnects: dict[str, asyncio.TimerHandle] = {}
         self._pending_after_store: dict[str, asyncio.TimerHandle] = {}
@@ -188,7 +201,7 @@ class Redis(Extension):
                 if still_held and self.locks.get(resource) is held:
                     self._schedule_lock_extend(resource, held)
 
-            asyncio.ensure_future(run())
+            spawn_tracked(self._tasks, run())
 
         held.extend_handle = asyncio.get_event_loop().call_later(
             self.lock_timeout / 2000, extend
@@ -260,18 +273,62 @@ class Redis(Extension):
             return
 
         def reply(response: bytes) -> None:
-            asyncio.ensure_future(
+            spawn_tracked(
+                self._tasks,
                 self.pub.publish(
                     self.get_key(document.name), self.encode_message(response)
-                )
+                ),
             )
 
         receiver = MessageReceiver(message, self.redis_transaction_origin)
-        asyncio.ensure_future(receiver.apply(document, None, reply))
+        spawn_tracked(self._tasks, receiver.apply(document, None, reply))
+
+    async def on_plane_broadcast(self, data: Payload) -> None:
+        """Cross-instance fan-out of a serve-mode plane window: publish
+        the merged update frame itself — peers apply it directly. One
+        coalesced message per doc-window instead of the per-op
+        SyncStep1/Step2 round trips (which remain, rate-limited, as
+        anti-entropy below and as the join protocol)."""
+        from ..protocol.frames import build_update_frame
+
+        await self.pub.publish(
+            self.get_key(data.document_name),
+            self.encode_message(build_update_frame(data.document_name, data.update)),
+        )
 
     async def on_change(self, data: Payload) -> None:
-        if data.transaction_origin != self.redis_transaction_origin:
-            await self.publish_first_sync_step(data.document_name, data.document)
+        if data.transaction_origin == self.redis_transaction_origin:
+            return
+        document = data.document
+        if getattr(document, "broadcast_source", None) is not None:
+            # plane-served: steady propagation rides the window frames
+            # (on_plane_broadcast); keep a LOW-RATE SyncStep1 exchange
+            # per doc as anti-entropy so a dropped pub/sub message heals
+            # instead of desyncing the peer forever
+            name = data.document_name
+            now = asyncio.get_event_loop().time()
+            last = self._last_anti_entropy.get(name, 0.0)
+            if now - last < self.plane_anti_entropy_seconds:
+                # TRAILING edge: the final change before quiescence must
+                # still trigger one exchange after the window closes
+                if name not in self._anti_entropy_handles:
+                    def fire(n=name):
+                        self._anti_entropy_handles.pop(n, None)
+                        doc_now = (
+                            self.instance.documents.get(n) if self.instance else None
+                        )
+                        if doc_now is not None:
+                            self._last_anti_entropy[n] = asyncio.get_event_loop().time()
+                            spawn_tracked(
+                                self._tasks, self.publish_first_sync_step(n, doc_now)
+                            )
+
+                    self._anti_entropy_handles[name] = asyncio.get_event_loop().call_later(
+                        self.plane_anti_entropy_seconds, fire
+                    )
+                return
+            self._last_anti_entropy[name] = now
+        await self.publish_first_sync_step(data.document_name, data.document)
 
     async def on_disconnect(self, data: Payload) -> None:
         document_name = data.document_name
@@ -281,12 +338,16 @@ class Redis(Extension):
 
         def disconnect() -> None:
             self._pending_disconnects.pop(document_name, None)
+            self._last_anti_entropy.pop(document_name, None)
+            handle = self._anti_entropy_handles.pop(document_name, None)
+            if handle is not None:
+                handle.cancel()
             document = self.instance.documents.get(document_name) if self.instance else None
             if document is not None and document.get_connections_count() > 0:
                 return
-            asyncio.ensure_future(self.sub.unsubscribe(self.get_key(document_name)))
+            spawn_tracked(self._tasks, self.sub.unsubscribe(self.get_key(document_name)))
             if document is not None:
-                asyncio.ensure_future(self.instance.unload_document(document))
+                spawn_tracked(self._tasks, self.instance.unload_document(document))
 
         # Delay to allow last-minute syncs to arrive on the subscription.
         self._pending_disconnects[document_name] = asyncio.get_event_loop().call_later(
@@ -302,6 +363,9 @@ class Redis(Extension):
     async def on_destroy(self, data: Payload) -> None:
         for handle in list(self._pending_disconnects.values()):
             handle.cancel()
+        for handle in list(self._anti_entropy_handles.values()):
+            handle.cancel()
+        self._anti_entropy_handles.clear()
         for handle in list(self._pending_after_store.values()):
             handle.cancel()
         for held in list(self.locks.values()):

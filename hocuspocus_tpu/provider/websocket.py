@@ -16,6 +16,8 @@ from typing import Any, Optional
 
 import aiohttp
 
+from ..aio import spawn_tracked
+
 from ..crdt.doc import Observable
 from ..crdt.encoding import Decoder
 
@@ -138,8 +140,6 @@ class HocuspocusProviderWebsocket(Observable):
             self.message_queue.append(data)
 
     def _spawn(self, coro) -> None:
-        from ..aio import spawn_tracked
-
         spawn_tracked(self._bg_tasks, coro)
 
     async def _pump(self, ws) -> None:
@@ -209,6 +209,12 @@ class HocuspocusProviderWebsocket(Observable):
             if self._pump_task is not None:
                 self._pump_task.cancel()
                 self._pump_task = None
+            # frames queued but never written survive into the
+            # disconnected buffer: sync frames are idempotent and
+            # stateless/awareness frames are NOT recovered by the
+            # reopen sync exchange, so dropping them would lose them
+            while not self._out_queue.empty():
+                self.message_queue.append(self._out_queue.get_nowait())
             self._connected_event.clear()
             self._set_status(WebSocketStatus.Disconnected)
             self.emit("close", {"event": close_event})

@@ -24,6 +24,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..aio import spawn_tracked
 from ..server.types import Extension, Payload
 from .kernels import (
     DocState,
@@ -48,6 +49,10 @@ class LogRec:
     op: DenseOp
     slot: Optional[int] = None
     unit_off: int = 0
+    # op arrived from a peer instance (redis origin): excluded from the
+    # cross-instance window republish — every peer already received it
+    # from the original publisher (echo amplification would be O(N^2))
+    remote: bool = False
 
 
 @dataclass
@@ -273,7 +278,9 @@ class MergePlane:
 
     # -- queueing ----------------------------------------------------------
 
-    def enqueue_update(self, name: str, update: bytes, presync: bool = False) -> int:
+    def enqueue_update(
+        self, name: str, update: bytes, presync: bool = False, remote: bool = False
+    ) -> int:
         """Lower + queue one update; returns the number of ops accepted."""
         doc = self.register(name)
         if doc.lowerer.unsupported:
@@ -313,13 +320,15 @@ class MergePlane:
             # against dispatched tallies, not these logs.
             log = self.unit_logs[slot]
             for op in ops:
-                doc.serve_log.append(LogRec(op=op, slot=slot, unit_off=len(log)))
+                doc.serve_log.append(
+                    LogRec(op=op, slot=slot, unit_off=len(log), remote=remote)
+                )
                 if op.kind == KIND_INSERT:
                     log.extend(op.chars)
             count += len(ops)
         for op in map_ops:
             op.presync = presync
-            doc.serve_log.append(LogRec(op=op, slot=None))
+            doc.serve_log.append(LogRec(op=op, slot=None, remote=remote))
             count += 1
         for client, clock, length in map_tombs:
             doc.map_tombstones.append((client, clock, length))
@@ -330,6 +339,7 @@ class MergePlane:
                         presync=presync,
                     ),
                     slot=None,
+                    remote=remote,
                 )
             )
             # a map-tombstone-only update still produces a serve-log
@@ -714,6 +724,7 @@ class TpuMergeExtension(Extension):
         self.serve = serve
         self.serving = None
         self._docs: dict[str, object] = {}  # name -> server Document being served
+        self._instance = None  # hocuspocus instance (hook dispatch)
         # strong refs to in-flight flush tasks: the event loop only
         # weakly references tasks, and a GC'd flush task silently stops
         # the serve pipeline (or strands the flush lock mid-acquire)
@@ -725,8 +736,6 @@ class TpuMergeExtension(Extension):
             self.serving.flush_failure_handler = self._degrade_all_served
 
     def _spawn_tracked(self, coro) -> None:
-        from ..aio import spawn_tracked
-
         spawn_tracked(self._flush_tasks, coro)
 
     # -- hooks ---------------------------------------------------------------
@@ -775,6 +784,7 @@ class TpuMergeExtension(Extension):
     async def after_load_document(self, data: Payload) -> None:
         from ..crdt import encode_state_as_update
 
+        self._instance = data.instance
         name = data.document_name
         self.plane.register(name)
         snapshot = encode_state_as_update(data.document)
@@ -834,15 +844,20 @@ class TpuMergeExtension(Extension):
             self._flush_handle.cancel()
         if self._broadcast_handle is not None:
             self._broadcast_handle.cancel()
-        # flush the broadcast tail, then fully drain the device queues:
-        # no timer will fire after teardown to pick up either
-        self._broadcast_served()
+        # flush the broadcast tail (LOCAL only: higher-priority
+        # extensions like Redis destroy first, so their pub/sub is
+        # already closed — peers heal via the join protocol and
+        # anti-entropy), then fully drain the device queues: no timer
+        # fires after teardown to pick up either
+        self._broadcast_served(cross_instance=False)
         await self._flush_now(max_batches=None)
 
     # -- serving: update capture (called by Document._handle_update) ---------
 
     def try_capture(self, document, update: bytes, origin) -> bool:
         """Claim an update for plane-batched broadcast. False = CPU fan-out."""
+        from ..server.hocuspocus import REDIS_ORIGIN
+
         name = document.name
         if not self.serve or name not in self._docs:
             return False
@@ -850,7 +865,7 @@ class TpuMergeExtension(Extension):
         if not plane.is_supported(name):
             self._fallback_to_cpu(document)
             return False
-        plane.enqueue_update(name, update)
+        plane.enqueue_update(name, update, remote=origin == REDIS_ORIGIN)
         if not plane.is_supported(name):
             # this very update degraded the doc; it broadcasts via CPU
             plane_doc = plane.docs.get(name)
@@ -972,7 +987,7 @@ class TpuMergeExtension(Extension):
             except Exception:
                 _logger_mod.log_error(f"CPU fallback failed for {document.name!r}")
 
-    def _broadcast_served(self) -> None:
+    def _broadcast_served(self, cross_instance: bool = True) -> None:
         """One broadcast pass: every doc with new serve-log records gets
         one merged frame. Pure host work (serve logs + cached health
         rows) — never waits on the device flush; a desync the validator
@@ -995,9 +1010,30 @@ class TpuMergeExtension(Extension):
                 if self.serving.doc_healthy(name) is None:
                     self._fallback_to_cpu(document)
                     continue
-                update = self.serving.build_broadcast(name)
-                if update is not None:
+                pair = self.serving.build_broadcast_pair(name)
+                if pair is not None:
+                    update, cross_update = pair
                     document.broadcast_update_frame(update)
+                    if (
+                        cross_instance
+                        and cross_update is not None
+                        and self._instance is not None
+                    ):
+                        # cross-instance fan-out rides the merged window
+                        # frame (extensions like Redis publish it) minus
+                        # remote-origin ops, replacing per-op SyncStep1
+                        # chatter with one coalesced message per window
+                        self._spawn_tracked(
+                            self._instance.hooks(
+                                "on_plane_broadcast",
+                                Payload(
+                                    instance=self._instance,
+                                    document_name=name,
+                                    document=document,
+                                    update=cross_update,
+                                ),
+                            )
+                        )
             except Exception:
                 from ..server import logger as _logger_mod
 

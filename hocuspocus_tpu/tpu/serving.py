@@ -657,16 +657,11 @@ class PlaneServing:
         advances on a successfully encoded payload (or a genuinely
         empty window), so a bail-out never strands ops.
         """
-        plane = self.plane
-        doc = plane.docs.get(name)
-        if doc is None:
-            return None
-        log = doc.serve_log
-        cursor = min(self.broadcast_cursor.get(name, 0), len(log))
-        window = [rec for rec in log[cursor:] if not rec.op.presync]
-        if not window:
-            self.broadcast_cursor[name] = len(log)
-            return None
+        pair = self.build_broadcast_pair(name)
+        return None if pair is None else pair[0]
+
+    def _encode_window(self, doc: PlaneDoc, window: list[LogRec]) -> Optional[bytes]:
+        """Update bytes for a record window, or None for an empty one."""
         window_ds = DeleteSet()
         has_inserts = False
         for rec in window:
@@ -675,7 +670,6 @@ class PlaneServing:
             elif rec.op.kind == KIND_INSERT:
                 has_inserts = True
         if not has_inserts and not window_ds.clients:
-            self.broadcast_cursor[name] = len(log)
             return None
         encoder = Encoder()
         body = self._encode_window_native(doc, window, None)
@@ -689,9 +683,44 @@ class PlaneServing:
                 _write_structs(encoder, items, client, items[0].id.clock)
         window_ds.sort_and_merge()
         window_ds.write(encoder)
+        return encoder.to_bytes()
+
+    def build_broadcast_pair(
+        self, name: str
+    ) -> "Optional[tuple[bytes, Optional[bytes]]]":
+        """(full_window_update, cross_instance_update or None).
+
+        The full frame goes to local connections. The cross-instance
+        frame excludes REMOTE-origin records (ops that arrived from a
+        peer instance) — every peer already has them from the original
+        publisher, and republishing would amplify traffic O(N^2) in
+        instance count. It is None when the window holds no local ops.
+        When the window is all-local the same bytes serve both.
+        """
+        plane = self.plane
+        doc = plane.docs.get(name)
+        if doc is None:
+            return None
+        log = doc.serve_log
+        cursor = min(self.broadcast_cursor.get(name, 0), len(log))
+        window = [rec for rec in log[cursor:] if not rec.op.presync]
+        if not window:
+            self.broadcast_cursor[name] = len(log)
+            return None
+        full = self._encode_window(doc, window)
+        if full is None:
+            self.broadcast_cursor[name] = len(log)
+            return None
+        local_window = [rec for rec in window if not rec.remote]
+        if len(local_window) == len(window):
+            local = full
+        elif not local_window:
+            local = None
+        else:
+            local = self._encode_window(doc, local_window)
         self.broadcast_cursor[name] = len(log)
         plane.counters["plane_broadcasts"] += 1
-        return encoder.to_bytes()
+        return full, local
 
 
 class TpuSyncSource:

@@ -275,11 +275,30 @@ async def test_tpu_serve_mode_with_redis_fanout_production_topology():
         _assert(ext_a.plane.counters["plane_broadcasts"] >= 1)
         _assert(ext_b.plane.counters["plane_broadcasts"] >= 1)
 
+        # sustained traffic propagates via the coalesced WINDOW frames,
+        # not per-op SyncStep1 round trips: many ops cross with only
+        # anti-entropy-level sync chatter (rate-limited to ~1 per
+        # plane_anti_entropy_seconds per doc, not per op)
+        text_a = provider_a.document.get_text("t")
+        for i in range(30):
+            text_a.insert(0, f"w{i};")
+            await asyncio.sleep(0.01)
+        await retryable_assertion(
+            lambda: _assert(
+                provider_b.document.get_text("t").to_string()
+                == provider_a.document.get_text("t").to_string()
+            )
+        )
+        _assert(ext_b.plane.counters["sync_serves"] <= 10)
+
         # a late joiner on B syncs the merged state from B's plane
         serves_before = ext_b.plane.counters["sync_serves"]
         provider_c = new_provider(server_b, name="prod-doc")
         await wait_synced(provider_c)
-        _assert(provider_c.document.get_text("t").to_string() == "cross-instance")
+        _assert(
+            provider_c.document.get_text("t").to_string()
+            == provider_a.document.get_text("t").to_string()
+        )
         _assert(provider_c.document.get_map("meta").get("owner") == "b")
         _assert(ext_b.plane.counters["sync_serves"] > serves_before)
         provider_c.destroy()
