@@ -2,7 +2,10 @@
 
 Equivalent of reference `playground/backend/src/redis.ts`, with the
 in-process mini-redis so the example is self-contained — point `host`/
-`port` at a real Redis in production.
+`port` at a real Redis in production. Each instance runs a serve-mode
+TPU merge plane (the production topology): local fan-out AND the
+cross-instance Redis traffic ride the plane's coalesced window frames
+(see docs/guides/scalability.md).
 
 Run: python examples/redis_multi.py
 """
@@ -12,6 +15,7 @@ import asyncio
 from hocuspocus_tpu import Configuration, Server
 from hocuspocus_tpu.extensions import Redis
 from hocuspocus_tpu.net.mini_redis import MiniRedis
+from hocuspocus_tpu.tpu import TpuMergeExtension
 
 
 async def main() -> None:
@@ -19,13 +23,19 @@ async def main() -> None:
     server_a = Server(
         Configuration(
             name="instance-a",
-            extensions=[Redis(port=redis.port, identifier="instance-a")],
+            extensions=[
+                Redis(port=redis.port, identifier="instance-a"),
+                TpuMergeExtension(num_docs=1024, capacity=4096, serve=True),
+            ],
         )
     )
     server_b = Server(
         Configuration(
             name="instance-b",
-            extensions=[Redis(port=redis.port, identifier="instance-b")],
+            extensions=[
+                Redis(port=redis.port, identifier="instance-b"),
+                TpuMergeExtension(num_docs=1024, capacity=4096, serve=True),
+            ],
         )
     )
     await server_a.listen(port=8001)
