@@ -401,6 +401,11 @@ def run_bench() -> None:
         result["extra"]["server_p99_error"] = server_p99_err
     if catchup is not None:
         result["extra"]["catchup"] = catchup
+    if jax.default_backend() != "tpu":
+        result["extra"]["note"] = (
+            "CPU fallback (TPU tunnel unavailable at capture time); "
+            "verified on-chip capture: benchmarks/results/bench_tpu_2026-07-30.json"
+        )
     print(json.dumps(result))
 
 
