@@ -63,7 +63,20 @@ class Encoder:
             num >>= 7
 
     def write_var_string(self, s: str) -> None:
-        data = s.encode("utf-8")
+        try:
+            data = s.encode("utf-8")
+        except UnicodeEncodeError:
+            # lib0 writeString goes through JS TextEncoder, which merges
+            # adjacent surrogate halves into the astral char and replaces
+            # LONE halves with U+FFFD — it never throws. Python strs can
+            # carry lone surrogates (a client inserting "\ud83d"); mirror
+            # TextEncoder exactly instead of crashing the encode: the
+            # UTF-16 round trip merges valid pairs and replaces strays.
+            data = (
+                s.encode("utf-16-le", "surrogatepass")
+                .decode("utf-16-le", "replace")
+                .encode("utf-8")
+            )
         self.write_var_uint(len(data))
         self.buf += data
 

@@ -328,6 +328,11 @@ class Redis(Extension):
                     )
                 return
             self._last_anti_entropy[name] = now
+            # a pending trailing-edge timer would fire a second SyncStep1
+            # right after this fresh one, busting the rate limit
+            handle = self._anti_entropy_handles.pop(name, None)
+            if handle is not None:
+                handle.cancel()
         await self.publish_first_sync_step(data.document_name, data.document)
 
     async def on_disconnect(self, data: Payload) -> None:

@@ -217,6 +217,13 @@ class MergePlane:
         if doc is None:
             return
         self.dirty.discard(name)
+        # Serialization: release() only runs from unload paths that hold
+        # the extension's flush_lock (see TpuMergeExtension._flush_now
+        # docstring), so no executor-side flush is in flight here —
+        # _clear_slot may rebuild self.state without racing a device
+        # step that donated its buffers. Do NOT take _step_lock on the
+        # event loop: it can be held across a device step or a warmup
+        # compile (tens of seconds cold), freezing every websocket.
         for slot in doc.seqs.values():
             self.slot_owner.pop(slot, None)
             self.queues.pop(slot, None)
@@ -254,6 +261,20 @@ class MergePlane:
         doc.serve_log = []
         doc.map_tombstones = []
         self.dirty.discard(name)
+        # LOCK-FREE by documented invariant (not oversight): retires run
+        # on the event loop (enqueue degrades, broadcast-timer fallback)
+        # while an executor-side _build_batch may be slicing these same
+        # queues under _step_lock. Taking that lock here would block the
+        # loop for a device step or warmup compile. Safe without it:
+        # (a) _build_batch's take/del is linearizable against clear()
+        #     (it deletes exactly len(take) front items it captured);
+        # (b) ops captured into `take` before the clear still dispatch,
+        #     but land in rows whose generation is bumped below —
+        #     slot_gen/slot_live masking excludes them from every health
+        #     compare, and the rows stay inert until release() clears
+        #     them under the extension flush_lock;
+        # (c) unit_logs is REBOUND (not mutated): an in-flight serve
+        #     holding the old list keeps a consistent snapshot.
         for slot in doc.seqs.values():
             self.queues[slot].clear()
             self.unit_logs[slot] = []
@@ -494,7 +515,14 @@ class MergePlane:
             if not queue:
                 continue
             take = queue[:k]
-            del queue[:k]
+            # del by len(take), not k: the loop thread may EXTEND this
+            # queue between the slice and the del (both atomic alone
+            # under the GIL, not together). Appends only touch the back,
+            # so the front len(take) items are exactly the taken ones —
+            # `del queue[:k]` with k > len(take) would silently discard
+            # ops appended in that window (logged in serve_log but never
+            # dispatched: permanent host/device divergence).
+            del queue[: len(take)]
             dispatched = 0
             for i, op in enumerate(take):
                 rows.append(i)

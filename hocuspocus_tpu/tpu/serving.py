@@ -437,11 +437,63 @@ class PlaneServing:
             groups.append((client, write_clock, items))
         return codec.encode_text_window(groups)
 
+    def _widen_surrogate_cutoffs(
+        self, records: list[LogRec], sm: dict[int, int]
+    ) -> None:
+        """A stale-sync cutoff landing mid-surrogate-pair would slice a
+        text run so its first transmitted unit is a lone low surrogate —
+        units_to_text (errors='replace') bakes U+FFFD into the wire
+        bytes while the CPU document still holds the real pair. Widen
+        such cutoffs by one unit: the re-sent high surrogate is already
+        known to the client and struct integration skips the known
+        prefix (offset semantics), so the serve stays byte-faithful
+        without leaving the device path.
+
+        The pair's two units may live in DIFFERENT serve-log records
+        (a remote update re-encoded as two structs split mid-pair), so
+        the unit AT the cutoff and the unit BEFORE it are resolved
+        independently across all of the client's records. A high
+        surrogate can never be the second half of a pair, so one step
+        suffices (no cascade)."""
+        unit_logs = self.plane.unit_logs
+        at_unit: dict[int, int] = {}
+        prev_unit: dict[int, int] = {}
+        for rec in records:
+            op = rec.op
+            if op.kind != KIND_INSERT or op.gc or op.deleted_content:
+                continue
+            if op.content is not None or op.parent_sub is not None or rec.slot is None:
+                continue
+            cutoff = sm.get(op.client)
+            if cutoff is None or cutoff <= 0:
+                continue
+            log = unit_logs.get(rec.slot)
+            if log is None:
+                continue
+            if op.clock <= cutoff < op.clock + op.run_len:
+                pos = rec.unit_off + (cutoff - op.clock)
+                if pos < len(log) and isinstance(log[pos], int):
+                    at_unit[op.client] = log[pos]
+            if op.clock <= cutoff - 1 < op.clock + op.run_len:
+                pos = rec.unit_off + (cutoff - 1 - op.clock)
+                if pos < len(log) and isinstance(log[pos], int):
+                    prev_unit[op.client] = log[pos]
+        for client, unit in at_unit.items():
+            prev = prev_unit.get(client)
+            if (
+                0xDC00 <= unit <= 0xDFFF
+                and prev is not None
+                and 0xD800 <= prev <= 0xDBFF
+            ):
+                sm[client] = sm[client] - 1
+
     def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
         """SyncStep2 bytes for a doc given the per-client cutoff map."""
         cold = len(sm) == len(doc.lowerer.known) and all(
             clock == 0 for clock in sm.values()
         )
+        if not cold:
+            self._widen_surrogate_cutoffs(doc.serve_log, sm)
         key = (len(doc.serve_log), len(doc.map_tombstones))
         if cold:
             cached = self._cold_sync_cache.get(doc.name)

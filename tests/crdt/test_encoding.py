@@ -69,6 +69,21 @@ def test_var_string_golden():
     assert enc(Encoder.write_var_string, "é") == bytes([2, 0xC3, 0xA9])
 
 
+def test_var_string_lone_surrogates_mirror_textencoder():
+    """lib0 writeString = JS TextEncoder: lone surrogate halves become
+    U+FFFD, ADJACENT halves merge into the astral char, and the encode
+    never throws (Python strs can carry lone surrogates; a crash here
+    would let one hostile insert kill the server's encode path)."""
+    fffd = "�".encode("utf-8")
+    assert enc(Encoder.write_var_string, "a\ud83d") == bytes([4, 97]) + fffd
+    assert enc(Encoder.write_var_string, "\ude00b") == bytes([4]) + fffd + b"b"
+    # a pair carried as two separate code points merges, like a JS string
+    assert (
+        enc(Encoder.write_var_string, "\ud83d\ude00")
+        == bytes([4]) + "\U0001f600".encode("utf-8")
+    )
+
+
 def test_peek_var_string():
     e = Encoder()
     e.write_var_string("doc")

@@ -359,3 +359,43 @@ async def test_subscriber_resubscribes_after_redis_restart():
     finally:
         sub.close()
         await redis.stop()
+
+
+async def test_anti_entropy_trailing_timer_cancelled_by_immediate_publish():
+    """An immediate (past-window) anti-entropy publish must cancel any
+    pending trailing-edge timer for the doc — otherwise the stale timer
+    fires a SECOND SyncStep1 right after the fresh one, exceeding the
+    ~1-per-window rate limit the serve-mode fan-out promises."""
+    from types import SimpleNamespace
+
+    ext = Redis(create_client=lambda: None, create_subscriber=lambda cb: None)
+    ext.plane_anti_entropy_seconds = 1.0
+    published = []
+
+    async def fake_publish(name, doc):
+        published.append(asyncio.get_event_loop().time())
+
+    ext.publish_first_sync_step = fake_publish
+    document = SimpleNamespace(broadcast_source=object(), name="d")
+    ext.instance = SimpleNamespace(documents={"d": document})
+    payload = SimpleNamespace(
+        transaction_origin=None, document=document, document_name="d"
+    )
+
+    # The third on_change must land after the window closes (t0+1.0) but
+    # before the trailing timer fires (second call + window). Both
+    # margins are bounded by the FIRST sleep (the timer's head start),
+    # so it is the one kept large: third call at ~t0+1.1 sits 0.4s from
+    # the t0+1.5 timer — asyncio.sleep never undershoots, and a loaded
+    # runner would need >0.4s of overshoot to flake this.
+    await ext.on_change(payload)  # t0: immediate publish
+    assert len(published) == 1
+    await asyncio.sleep(0.5)
+    await ext.on_change(payload)  # within window: timer due t0+1.5
+    assert "d" in ext._anti_entropy_handles
+    await asyncio.sleep(0.6)  # past the window (t0+1.1)
+    await ext.on_change(payload)  # immediate publish; must cancel the timer
+    assert len(published) == 2
+    assert "d" not in ext._anti_entropy_handles
+    await asyncio.sleep(0.6)  # old timer's fire time passes
+    assert len(published) == 2, "stale trailing timer double-published"
