@@ -1,0 +1,36 @@
+#!/bin/bash
+# Background TPU-tunnel watcher for bench capture (VERDICT r3 item 1:
+# "capture on-chip numbers the moment the tunnel is alive — run it early
+# and repeatedly during the round, not at the end").
+#
+# Loops: probe jax.devices() with a short timeout; on a live TPU, run the
+# full bench and save a timestamped artifact under benchmarks/results/.
+# Keeps probing after a success so later (faster) code gets re-captured.
+cd /root/repo
+LOG=benchmarks/results/tpu_watch.log
+echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; x=jnp.ones((256,256),jnp.bfloat16); (x@x).block_until_ready()" 2>>"$LOG"; then
+    STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+    echo "[watch] TPU ALIVE at $STAMP — running bench" >> "$LOG"
+    touch benchmarks/results/TPU_ALIVE
+    if timeout 2400 python bench.py > "benchmarks/results/bench_tpu_watch_${STAMP}.json" 2>>"$LOG"; then
+      echo "[watch] bench captured: bench_tpu_watch_${STAMP}.json" >> "$LOG"
+      # only keep captures that really landed on-chip THIS run — a
+      # stale-capture fallback re-emits an old on-chip artifact and
+      # must never be promoted (provenance laundering)
+      if grep -q '"backend": "tpu"' "benchmarks/results/bench_tpu_watch_${STAMP}.json" \
+         && ! grep -q '"stale_capture": true' "benchmarks/results/bench_tpu_watch_${STAMP}.json"; then
+        cp "benchmarks/results/bench_tpu_watch_${STAMP}.json" benchmarks/results/bench_tpu_latest.json
+        echo "[watch] promoted to bench_tpu_latest.json" >> "$LOG"
+      fi
+    else
+      echo "[watch] bench run failed/timed out" >> "$LOG"
+    fi
+    sleep 600
+  else
+    echo "[watch] probe dead $(date -u +%FT%TZ)" >> "$LOG"
+    rm -f benchmarks/results/TPU_ALIVE
+    sleep 180
+  fi
+done
