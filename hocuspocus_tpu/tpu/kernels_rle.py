@@ -24,11 +24,14 @@ Inserting or deleting into the middle of a run SPLITS it; both cases
 reduce to two primitives (`_split_at_rank`, `_split_at_clock`) that
 append the run's tail as a fresh entry (≤2 appends per op, bounded).
 
-Status: CPU-validated prototype, NOT yet wired into the merge plane
-(serving keeps the unit arena; see tests/tpu/test_kernels_rle.py for
-the equivalence suite against it). The Pallas/VMEM-resident variant
-and plane wiring are the productionization step, which needs chip
-time to validate.
+Status: production. Wired into the plane via `MergePlane(arena="rle")`
+(capacity = ENTRIES; serving resolves payloads through the host
+serve-log index), with the Pallas/VMEM-resident variant in
+`pallas_kernels_rle.py` and mesh sharding in `sharding.py`.
+Equivalence suites: tests/tpu/test_kernels_rle.py (vs the unit
+kernel), test_pallas_kernels_rle.py (Pallas vs scan),
+test_plane_fuzz.py + test_rle_plane.py (vs the CPU engine through the
+live serve path; churn survival).
 
 Reference semantics mirrored: yjs Item.integrate via
 `/root/reference/packages/server/src/MessageReceiver.ts` readUpdate.
@@ -59,6 +62,14 @@ class RleState(NamedTuple):
     num_runs: jax.Array  # (D,) int32 — occupied entries
     total_units: jax.Array  # (D,) int32 — rank-space size (live + tombstones)
     overflow: jax.Array  # (D,) bool
+
+    @property
+    def length(self) -> jax.Array:
+        """Alias: cumulative INSERTED units — the same accounting the
+        unit arena's `length` reports, so the plane's health readback
+        (_sync_health: validated dispatch tallies vs device length) is
+        arena-agnostic. Not a pytree field (properties are not)."""
+        return self.total_units
 
 
 def make_empty_rle_state(num_docs: int, entries: int) -> RleState:

@@ -27,7 +27,6 @@ import numpy as np
 from ..aio import spawn_tracked
 from ..server.types import Extension, Payload
 from .kernels import (
-    DocState,
     KIND_DELETE,
     KIND_INSERT,
     NONE_CLIENT,
@@ -89,7 +88,19 @@ class MergePlane:
         capacity: int = 4096,
         max_slots_per_flush: int = 16,
         mesh=None,
+        arena: str = "unit",
     ) -> None:
+        """arena: "unit" (one arena slot per UTF-16 unit; capacity =
+        units) or "rle" (one entry per run of consecutively-typed
+        units; capacity = ENTRIES). The RLE arena's cost grows with op
+        count + fragmentation instead of cumulative unit count, so
+        long-lived busy docs survive churn that exhausts the unit
+        arena — the device-side replacement for yjs GC semantics
+        (reference `packages/server/src/types.ts:152-155` yDocOptions.gc).
+        """
+        if arena not in ("unit", "rle"):
+            raise ValueError(f"unknown arena {arena!r}")
+        self.arena = arena
         self.num_docs = num_docs
         self.capacity = capacity
         self.max_slots_per_flush = max_slots_per_flush
@@ -109,7 +120,13 @@ class MergePlane:
         self._sharded_step = None
         self._op_shardings = None
         if mesh is not None:
-            from .sharding import make_sharded_state, make_sharded_step, ops_sharding
+            from .sharding import (
+                make_sharded_rle_state,
+                make_sharded_rle_step,
+                make_sharded_state,
+                make_sharded_step,
+                ops_sharding,
+            )
 
             doc_axis = mesh.shape["doc"]
             unit_axis = mesh.shape["unit"]
@@ -119,11 +136,15 @@ class MergePlane:
                     f"axis ({doc_axis}) and capacity ({capacity}) a multiple of "
                     f"the unit axis ({unit_axis})"
                 )
-            self.state = make_sharded_state(mesh, num_docs, capacity)
-            self._sharded_step = make_sharded_step(mesh)
+            if arena == "rle":
+                self.state = make_sharded_rle_state(mesh, num_docs, capacity)
+                self._sharded_step = make_sharded_rle_step(mesh)
+            else:
+                self.state = make_sharded_state(mesh, num_docs, capacity)
+                self._sharded_step = make_sharded_step(mesh)
             self._op_shardings = ops_sharding(mesh)
         else:
-            self.state: DocState = make_empty_state(num_docs, capacity)
+            self.state = self._make_empty(num_docs, capacity)
         self.docs: dict[str, PlaneDoc] = {}
         self.free: list[int] = list(range(num_docs - 1, -1, -1))
         self.slot_owner: dict[int, str] = {}  # slot -> doc name
@@ -184,6 +205,26 @@ class MergePlane:
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
         }
+
+    # -- arena dispatch ----------------------------------------------------
+
+    def _make_empty(self, num_docs: int, capacity: int):
+        if self.arena == "rle":
+            from .kernels_rle import make_empty_rle_state
+
+            return make_empty_rle_state(num_docs, capacity)
+        return make_empty_state(num_docs, capacity)
+
+    def _step_fn(self):
+        if self._sharded_step is not None:
+            return self._sharded_step
+        if self.arena == "rle":
+            from .pallas_kernels_rle import integrate_op_slots_rle_fast
+
+            return integrate_op_slots_rle_fast
+        from .pallas_kernels import integrate_op_slots_fast
+
+        return integrate_op_slots_fast
 
     # -- registry ----------------------------------------------------------
 
@@ -282,8 +323,9 @@ class MergePlane:
             self.slot_gen[slot] += 1
 
     def _clear_slot(self, slot: int) -> None:
-        empty = make_empty_state(1, self.capacity)
-        self.state = DocState(
+        empty = self._make_empty(1, self.capacity)
+        # type(self.state): DocState or RleState, same field-wise rebuild
+        self.state = type(self.state)(
             *(
                 field.at[slot].set(empty_field[0])
                 for field, empty_field in zip(self.state, empty)
@@ -320,10 +362,19 @@ class MergePlane:
             # guarantees causal readiness, so inserts succeed until the
             # arena overflows — at which point the doc is CPU-only
             # forever; stop queueing (and logging payloads) instead of
-            # leaking
-            projected = self.projected_len[slot] + sum(
-                op.run_len for op in ops if op.kind == KIND_INSERT
-            )
+            # leaking. Unit arena: exact (capacity = units, cost =
+            # run_len per insert). RLE arena: neutral 1/op estimate —
+            # run-aligned churn deletes cost 0 device entries and
+            # mid-run splits cost up to 2, so the host bound only stops
+            # unbounded queueing on a doomed doc; the DEVICE overflow
+            # flag is the real authority (caught one flush later, and
+            # routed through the same recycle seam as capacity).
+            if self.arena == "rle":
+                projected = self.projected_len[slot] + len(ops)
+            else:
+                projected = self.projected_len[slot] + sum(
+                    op.run_len for op in ops if op.kind == KIND_INSERT
+                )
             if projected > self.capacity:
                 self.retire_doc(name, "capacity")
                 return 0
@@ -401,9 +452,7 @@ class MergePlane:
         (callers can interleave lock acquisition per shape); default
         compiles all of them.
         """
-        from .pallas_kernels import integrate_op_slots_fast
-
-        step = self._sharded_step or integrate_op_slots_fast
+        step = self._step_fn()
         shapes = [k] if k is not None else self.warmup_shapes()
         with self._step_lock:
             for shape in shapes:
@@ -437,8 +486,6 @@ class MergePlane:
     def _flush_locked(self, max_batches: Optional[int] = None) -> int:
         from ..observability.tracing import get_tracer
 
-        from .pallas_kernels import integrate_op_slots_fast
-
         tracer = get_tracer()
         total = 0
         batches = 0
@@ -460,7 +507,7 @@ class MergePlane:
             # single completion barrier (content readback — buffer
             # *readiness* of aliased Pallas outputs is not trustworthy,
             # see bench.py sync())
-            step = self._sharded_step or integrate_op_slots_fast
+            step = self._step_fn()
             if tracer.enabled:
                 with tracer.device_span("merge_plane.integrate", slots=k) as span:
                     self.state, _count = step(self.state, ops)
@@ -660,20 +707,28 @@ class MergePlane:
                 return None
             slot = doc.seqs[roots[0]]
             log = self.unit_logs[slot]
-            live = np.asarray(extract_live_mask(self.state))[slot]
-            occupied = np.nonzero(live)[0]
-            ranks_all = np.asarray(self.state.rank)[slot][occupied]
-            order = np.argsort(ranks_all)
-            sel = occupied[order]
-            ranks = ranks_all[order]
-            clients = np.asarray(self.state.id_client)[slot][sel]
-            clocks = np.asarray(self.state.id_clock)[slot][sel]
-            entries = [log[i] for i in sel]
+            if self.arena == "rle":
+                expanded = self._rle_live_units(doc, slot, log)
+                if expanded is None:
+                    return None
+                clients, clocks, ranks, entries = expanded
+            else:
+                live = np.asarray(extract_live_mask(self.state))[slot]
+                occupied = np.nonzero(live)[0]
+                ranks_all = np.asarray(self.state.rank)[slot][occupied]
+                order = np.argsort(ranks_all)
+                sel = occupied[order]
+                ranks = ranks_all[order]
+                clients = np.asarray(self.state.id_client)[slot][sel]
+                clocks = np.asarray(self.state.id_clock)[slot][sel]
+                entries = [log[i] for i in sel]
         out: list[int] = []
         i = 0
         count = len(entries)
         while i < count:
             entry = entries[i]
+            if entry is None:
+                return None  # RLE: payload not locatable in the unit log
             if not isinstance(entry, int):
                 if isinstance(entry, ContentFormat):
                     i += 1  # zero-width formatting boundary
@@ -700,6 +755,70 @@ class MergePlane:
                 out.append(c)
             i += 1
         return units_to_text(out)
+
+    def unit_off_index(self, doc: PlaneDoc, slot: int) -> "dict[int, list]":
+        """client -> clock-sorted [(clock, unit_off, run_len)] intervals
+        for the slot's insert records: maps an arbitrary (client, clock)
+        id to its payload position in the slot's unit log. The RLE
+        arena stores runs, not per-unit arrival indices, so payload
+        lookup goes through the host serve log (which is written at
+        enqueue time in dispatch order)."""
+        index: dict[int, list] = {}
+        for rec in doc.serve_log:
+            op = rec.op
+            if rec.slot != slot or op.kind != KIND_INSERT:
+                continue
+            # every sequence insert logs exactly run_len entries (units,
+            # zero markers for ContentDeleted, repeated Content objects
+            # for rich units — lowering._emit_seq), so intervals tile
+            # the log densely; gc records are host-only (slot None)
+            index.setdefault(op.client, []).append(
+                (op.clock, rec.unit_off, op.run_len)
+            )
+        for intervals in index.values():
+            intervals.sort()
+        return index
+
+    def _rle_live_units(self, doc: PlaneDoc, slot: int, log: list):
+        """Expand the slot's live RLE entries, rank-ordered, to parallel
+        per-unit arrays (clients, clocks, ranks, entries) matching the
+        unit-arena extraction — payloads resolved via unit_off_index.
+        An entry of None means the unit's payload wasn't found (rich
+        content in the log, or a divergence): text() returns None."""
+        from bisect import bisect_right
+
+        num = int(np.asarray(self.state.num_runs)[slot])
+        rcl = np.asarray(self.state.run_client)[slot][:num]
+        rck = np.asarray(self.state.run_clock)[slot][:num]
+        rln = np.asarray(self.state.run_len)[slot][:num]
+        rrk = np.asarray(self.state.run_rank)[slot][:num]
+        rdl = np.asarray(self.state.run_deleted)[slot][:num]
+        keep = (rln > 0) & ~rdl
+        order = np.argsort(rrk[keep])
+        index = self.unit_off_index(doc, slot)
+        clients: list[int] = []
+        clocks: list[int] = []
+        ranks: list[int] = []
+        entries: list = []
+        kcl, kck, kln, krk = rcl[keep], rck[keep], rln[keep], rrk[keep]
+        for i in order:
+            client, clock0, length, rank0 = (
+                int(kcl[i]), int(kck[i]), int(kln[i]), int(krk[i]),
+            )
+            intervals = index.get(client)
+            pos = bisect_right(intervals, (clock0, 0x7FFFFFFF, 0)) - 1 if intervals else -1
+            if pos < 0:
+                return None
+            iv_clock, iv_off, iv_len = intervals[pos]
+            if not (iv_clock <= clock0 and clock0 + length <= iv_clock + iv_len):
+                return None
+            base = iv_off + (clock0 - iv_clock)
+            for u in range(length):
+                clients.append(client)
+                clocks.append(clock0 + u)
+                ranks.append(rank0 + u)
+                entries.append(log[base + u] if base + u < len(log) else None)
+        return clients, clocks, ranks, entries
 
 
 class TpuMergeExtension(Extension):
@@ -732,13 +851,16 @@ class TpuMergeExtension(Extension):
         serve: bool = False,
         mesh=None,
         broadcast_interval_ms: float = 2.0,
+        arena: str = "unit",
     ) -> None:
         if plane is not None and mesh is not None:
             raise ValueError(
                 "pass mesh= to the MergePlane you construct, not alongside plane= "
                 "(an explicit plane keeps its own device layout)"
             )
-        self.plane = plane or MergePlane(num_docs=num_docs, capacity=capacity, mesh=mesh)
+        self.plane = plane or MergePlane(
+            num_docs=num_docs, capacity=capacity, mesh=mesh, arena=arena
+        )
         self.flush_interval_ms = flush_interval_ms
         # broadcasts build from the HOST serve logs and run on their own
         # (shorter) coalescing window, decoupled from the device flush:
@@ -757,6 +879,12 @@ class TpuMergeExtension(Extension):
         # weakly references tasks, and a GC'd flush task silently stops
         # the serve pipeline (or strands the flush lock mid-acquire)
         self._flush_tasks: set = set()
+        # docs whose recycle attempt found no headroom for their live
+        # state: further attempts are suppressed until unload (each
+        # attempt costs a snapshot re-lower under the flush lock, and a
+        # queued attempt re-registering the doc must see this verdict —
+        # extension-level, since release+register replaces PlaneDocs)
+        self._recycle_declined: set[str] = set()
         if serve:
             from .serving import PlaneServing
 
@@ -825,6 +953,15 @@ class TpuMergeExtension(Extension):
     async def on_change(self, data: Payload) -> None:
         if self.serve and data.document_name in self._docs:
             return  # already captured synchronously in try_capture
+        if self.serve:
+            # fresh traffic on a doc that degraded off the plane (e.g.
+            # a device OVERFLOW retire from the health sweep — a seam
+            # try_capture never sees, since capture stops at fallback):
+            # busy docs are worth re-onboarding from their live snapshot
+            plane_doc = self.plane.docs.get(data.document_name)
+            if plane_doc is not None and plane_doc.retired:
+                self._maybe_recycle(data.document, plane_doc.retire_reason)
+                return
         self.plane.enqueue_update(data.document_name, data.update)
         self._schedule_flush()
 
@@ -849,6 +986,9 @@ class TpuMergeExtension(Extension):
                         return  # re-loaded while we waited: registration lives on
                     self._detach_serving(name, self._docs.pop(name, None))
                     self.plane.release(name)
+                    # a future incarnation starts with a fresh recycle
+                    # budget (its live state may be much smaller)
+                    self._recycle_declined.discard(name)
                     return
             # A re-load is in flight. Wait for it OUTSIDE the lock: on
             # success its own eventual unload fires this hook again; on
@@ -891,7 +1031,14 @@ class TpuMergeExtension(Extension):
             return False
         plane = self.plane
         if not plane.is_supported(name):
+            # already degraded (e.g. a device OVERFLOW retire from the
+            # post-flush health sweep, where no recycle seam runs) —
+            # this fresh traffic is the signal the doc is still busy
+            # and worth re-onboarding
+            plane_doc = plane.docs.get(name)
+            reason = plane_doc.retire_reason if plane_doc is not None else None
             self._fallback_to_cpu(document)
+            self._maybe_recycle(document, reason)
             return False
         plane.enqueue_update(name, update, remote=origin == REDIS_ORIGIN)
         if not plane.is_supported(name):
@@ -899,26 +1046,36 @@ class TpuMergeExtension(Extension):
             plane_doc = plane.docs.get(name)
             reason = plane_doc.retire_reason if plane_doc is not None else None
             self._fallback_to_cpu(document)
-            if reason in ("capacity", "plane_full"):
-                # arena rows are append-only and tree docs hold one row
-                # per sequence (including deleted subtrees'), so a
-                # long-lived busy doc eventually exhausts its rows or
-                # the plane — re-onboard with fresh rows lowered from
-                # the live CPU snapshot. Collected SUBTREES (deleted
-                # paragraphs/elements — the common rich-text churn)
-                # vanish from the snapshot, so such docs reclaim most
-                # of their rows; docs whose tombstones are in-run text
-                # deletions keep their cumulative cost (same semantics
-                # as yjs struct stores) and the headroom guard leaves
-                # those on the CPU path.
-                self._spawn_tracked(self._recycle_capacity_doc(document))
+            self._maybe_recycle(document, reason)
             return False
         self._schedule_flush()
         self._schedule_broadcast()
         return True
 
+    def _maybe_recycle(self, document, reason: "Optional[str]") -> None:
+        """Schedule a recycle for row-exhaustion retires.
+
+        Arena rows are append-only and tree docs hold one row per
+        sequence (including deleted subtrees'), so a long-lived busy
+        doc eventually exhausts its rows (host-projected: "capacity";
+        device-detected mid-flush, e.g. RLE split costs the host bound
+        can't see: "overflow") or the plane ("plane_full") — re-onboard
+        with fresh rows lowered from the live CPU snapshot. Collected
+        SUBTREES vanish from the snapshot, so such docs reclaim most of
+        their rows; on the RLE arena a re-lowered snapshot is compact
+        again (ContentDeleted runs cost one entry each). Docs whose
+        live state itself has no headroom are left on the CPU path by
+        the recycle guards. Content retires ("unsupported") and desyncs
+        never recycle — the condition is permanent or needs a human.
+        """
+        if reason not in ("capacity", "plane_full", "overflow"):
+            return
+        if document.name in self._recycle_declined:
+            return
+        self._spawn_tracked(self._recycle_capacity_doc(document))
+
     async def _recycle_capacity_doc(self, document) -> None:
-        """Give a capacity- or plane_full-retired doc fresh arena rows.
+        """Give a row-exhaustion-retired doc fresh arena rows.
 
         The triggering update already reached receivers via the CPU
         fallback broadcast; this re-onboards the doc for FUTURE traffic
@@ -937,6 +1094,8 @@ class TpuMergeExtension(Extension):
                 return  # unloading anyway
             if name in self._docs:
                 return  # already re-onboarded
+            if name in self._recycle_declined:
+                return  # a queued attempt ran after the verdict landed
             existing = plane.docs.get(name)
             if existing is None or not existing.retired:
                 return  # registration changed under us; leave it be
@@ -948,12 +1107,14 @@ class TpuMergeExtension(Extension):
                 )
                 doc = plane.docs.get(name)
                 if doc is None or doc.lowerer.unsupported:
+                    self._recycle_declined.add(name)
                     return  # live content unsupported/too big: stays on CPU
                 # guard retires below use count=False: this incident was
                 # already counted when the original registration retired
                 for slot in doc.seqs.values():
                     if plane.projected_len[slot] > plane.capacity * 3 // 4:
                         plane.retire_doc(name, "capacity", count=False)
+                        self._recycle_declined.add(name)
                         return  # no row headroom: recycling would thrash
                 if len(plane.free) < 2:
                     # plane-level headroom: with no spare rows the next
@@ -962,6 +1123,7 @@ class TpuMergeExtension(Extension):
                     # plus a snapshot re-lower, strictly worse than the
                     # CPU path
                     plane.retire_doc(name, "plane_full", count=False)
+                    self._recycle_declined.add(name)
                     return
                 plane.counters["docs_recycled"] += 1
                 self._attach_serving(name, document)

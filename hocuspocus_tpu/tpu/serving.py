@@ -93,8 +93,8 @@ class PlaneServing:
         self._overflow_cache: Optional[np.ndarray] = None
         self._validated_cache: Optional[np.ndarray] = None
         self._gen_cache: Optional[np.ndarray] = None
-        # slot -> ((slot_gen, flush_epoch), sorted deleted (client,
-        # clock) pairs): see _slot_deleted_pairs
+        # slot -> ((slot_gen, flush_epoch), sorted merged deleted
+        # (client, clock, length) ranges): see _slot_deleted_ranges
         self._tombstone_cache: dict[int, tuple] = {}
         # doc name -> (PlaneDoc identity, (log_len, tomb_len), bytes):
         # every cold joiner of a doc receives the SAME SyncStep2 (sync
@@ -229,16 +229,18 @@ class PlaneServing:
             items.sort(key=lambda item: item.id.clock)
         return by
 
-    def _slot_deleted_pairs(self, slot: int) -> "list[tuple[int, int]]":
-        """Sorted (client, clock) pairs of the slot's device tombstones.
+    def _slot_deleted_ranges(self, slot: int) -> "list[tuple[int, int, int]]":
+        """Sorted, merged (client, clock, length) ranges of the slot's
+        device tombstones.
 
         Cached per (slot binding generation, flush epoch): tombstone
         rows only change when a flush integrates ops or the slot is
         cleared, so a catch-up storm hitting the same doc repeatedly —
         or many docs across waves — pays the device fetch once per
         epoch, not once per serve (~a full RTT per transfer on a
-        remote-attached chip). The miss path fuses the three row reads
-        (deleted mask, client ids, clocks) into ONE transfer.
+        remote-attached chip). The miss path fuses the row reads
+        (deleted mask, ids — and lengths on the RLE arena) into ONE
+        transfer.
         """
         plane = self.plane
         key = (int(plane.slot_gen[slot]), plane.flush_epoch)
@@ -298,12 +300,26 @@ class PlaneServing:
         return chunks
 
     def _gather_rows(self, slot_indices: "list[int]") -> np.ndarray:
-        """One fused (3, B, N) device read of [deleted, id_client,
-        id_clock] rows for the given slots. Caller holds the step lock."""
+        """One fused device read of the tombstone-relevant rows for the
+        given slots. Caller holds the step lock. Unit arena: (3, B, N)
+        [deleted, id_client, id_clock]. RLE arena: (4, B, R) [deleted,
+        run_client, run_clock, run_len] — ranges come straight from
+        deleted entries, no per-unit pair scan."""
         import jax.numpy as jnp
 
         state = self.plane.state
         idx = jnp.asarray(slot_indices, jnp.int32)
+        if self.plane.arena == "rle":
+            return np.asarray(
+                jnp.stack(
+                    [
+                        state.run_deleted[idx].astype(jnp.int32),
+                        state.run_client[idx].view(jnp.int32),
+                        state.run_clock[idx],
+                        state.run_len[idx],
+                    ]
+                )
+            )
         return np.asarray(
             jnp.stack(
                 [
@@ -320,12 +336,31 @@ class PlaneServing:
         with plane._step_lock:  # never gather donated buffers mid-flush
             fused = self._gather_rows(chunk + [chunk[0]] * (width - len(chunk)))
             gens = [int(plane.slot_gen[slot]) for slot in chunk]
+        rle = plane.arena == "rle"
         for i, slot in enumerate(chunk):
             sel = np.nonzero(fused[0, i])[0]
             clients = fused[1, i][sel].view(np.uint32)
             clocks = fused[2, i][sel]
-            pairs = sorted(zip(clients.tolist(), clocks.tolist()))
-            self._tombstone_cache[slot] = ((gens[i], epoch), pairs)
+            if rle:
+                lens = fused[3, i][sel]
+                raw = sorted(
+                    (c, k, l)
+                    for c, k, l in zip(
+                        clients.tolist(), clocks.tolist(), lens.tolist()
+                    )
+                    if l > 0
+                )
+            else:
+                raw = [(c, k, 1) for c, k in sorted(zip(clients.tolist(), clocks.tolist()))]
+            # merge id-adjacent ranges once at fetch time so every serve
+            # consumes ready ranges
+            ranges: list[tuple[int, int, int]] = []
+            for c, k, l in raw:
+                if ranges and ranges[-1][0] == c and ranges[-1][1] + ranges[-1][2] == k:
+                    ranges[-1] = (c, ranges[-1][1], ranges[-1][2] + l)
+                else:
+                    ranges.append((c, k, l))
+            self._tombstone_cache[slot] = ((gens[i], epoch), ranges)
 
     def warmup_gathers(self) -> None:
         """Compile the tombstone-gather programs (one per fixed width)
@@ -343,17 +378,8 @@ class PlaneServing:
         for slot in doc.seqs.values():
             if int(lengths[slot]) == 0:
                 continue
-            pairs = self._slot_deleted_pairs(slot)
-            if not pairs:
-                continue
-            run_client, run_start, run_len = pairs[0][0], pairs[0][1], 1
-            for client, clock in pairs[1:]:
-                if client == run_client and clock == run_start + run_len:
-                    run_len += 1
-                else:
-                    ds.add(run_client, run_start, run_len)
-                    run_client, run_start, run_len = client, clock, 1
-            ds.add(run_client, run_start, run_len)
+            for client, clock, length in self._slot_deleted_ranges(slot):
+                ds.add(client, clock, length)
         for client, clock, length in doc.map_tombstones:
             ds.add(client, clock, length)
         ds.sort_and_merge()

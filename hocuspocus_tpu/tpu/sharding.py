@@ -123,3 +123,82 @@ def make_sharded_state(mesh: Mesh, num_docs: int, capacity: int) -> DocState:
     return DocState(
         *(jax.device_put(field, sharding) for field, sharding in zip(state, shardings))
     )
+
+
+# -- run-length arena ---------------------------------------------------------
+
+
+def rle_state_sharding(mesh: Mesh):
+    """NamedShardings for each RleState field: entry axis rides the
+    mesh's 'unit' axis (the sequence-parallel dimension), doc axis is
+    data-parallel — same layout discipline as the unit arena."""
+    from .kernels_rle import RleState
+
+    arena = NamedSharding(mesh, P("doc", "unit"))
+    per_doc = NamedSharding(mesh, P("doc"))
+    return RleState(
+        run_client=arena,
+        run_clock=arena,
+        run_len=arena,
+        run_rank=arena,
+        run_orank=arena,
+        run_deleted=arena,
+        num_runs=per_doc,
+        total_units=per_doc,
+        overflow=per_doc,
+    )
+
+
+def make_sharded_rle_state(mesh: Mesh, num_docs: int, entries: int):
+    from .kernels_rle import make_empty_rle_state
+
+    state = make_empty_rle_state(num_docs, entries)
+    shardings = rle_state_sharding(mesh)
+    return type(state)(
+        *(jax.device_put(field, sharding) for field, sharding in zip(state, shardings))
+    )
+
+
+def make_sharded_rle_step(mesh: Mesh, use_pallas: Optional[bool] = None, interpret: bool = False):
+    """Jitted multi-chip RLE integrate step; same two lowering
+    strategies as make_sharded_step (XLA scan with shardings, or
+    shard_map(Pallas) over a doc-only mesh)."""
+    from .kernels_rle import RleState, integrate_op_slots_rle
+
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and mesh.shape["unit"] == 1
+    if use_pallas and mesh.shape["unit"] != 1:
+        raise ValueError("the Pallas sharded RLE step requires a doc-only mesh")
+
+    if not use_pallas:
+        st_shard = rle_state_sharding(mesh)
+        op_shard = ops_sharding(mesh)
+        count_sharding = NamedSharding(mesh, P())
+        return jax.jit(
+            integrate_op_slots_rle.__wrapped__,
+            in_shardings=(st_shard, op_shard),
+            out_shardings=(st_shard, count_sharding),
+            donate_argnums=(0,),
+        )
+
+    from .pallas_kernels_rle import integrate_op_slots_rle_pallas
+
+    arena = P("doc", None)
+    per_doc = P("doc")
+    st_spec = RleState(*([arena] * 6 + [per_doc] * 3))
+    ops_spec = OpBatch(*([P(None, "doc")] * 8))
+
+    def local_step(state, ops):
+        new_state, count = integrate_op_slots_rle_pallas(state, ops, interpret=interpret)
+        return new_state, jax.lax.psum(count, "doc")
+
+    return jax.jit(
+        jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(st_spec, ops_spec),
+            out_specs=(st_spec, P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )

@@ -129,14 +129,15 @@ def _doc_fingerprint(doc: Doc):
     )
 
 
+@pytest.mark.parametrize("arena", ["unit", "rle"])
 @pytest.mark.parametrize("seed", [1, 7, 23])
-def test_plane_fuzz_mixed_content_serves_cpu_equal(seed):
+def test_plane_fuzz_mixed_content_serves_cpu_equal(seed, arena):
     rng = np.random.default_rng(seed)
     cpu = Doc()
     updates = []
     cpu.on("update", lambda update, *rest: updates.append(update))
 
-    plane = MergePlane(num_docs=64, capacity=2048)
+    plane = MergePlane(num_docs=64, capacity=2048, arena=arena)
     serving = PlaneServing(plane)
     plane.register("fuzz")
 
@@ -212,8 +213,9 @@ def test_surrogate_split_wart_matches_reference_semantics():
     assert rebuilt.get_text("t").to_string() == "x𝕕"
 
 
+@pytest.mark.parametrize("arena", ["unit", "rle"])
 @pytest.mark.parametrize("seed", [3, 11])
-def test_plane_fuzz_concurrent_editors_converge(seed):
+def test_plane_fuzz_concurrent_editors_converge(seed, arena):
     """Two editors mutate independent replicas; updates cross-apply in
     randomized order (buffering out-of-causal-order arrivals), and the
     plane — fed the same interleaved stream the server would see — must
@@ -226,7 +228,7 @@ def test_plane_fuzz_concurrent_editors_converge(seed):
     a.on("update", lambda update, *rest: out_a.append(update))
     b.on("update", lambda update, *rest: out_b.append(update))
 
-    plane = MergePlane(num_docs=64, capacity=4096)
+    plane = MergePlane(num_docs=64, capacity=4096, arena=arena)
     serving = PlaneServing(plane)
     plane.register("conc")
 

@@ -1,0 +1,204 @@
+"""Run-length arena as the SERVING substrate (MergePlane(arena="rle")).
+
+The unit arena spends one device slot per UTF-16 unit forever, so a
+long-lived busy doc exhausts cumulative capacity no matter its live
+size — the round-3 verdict's documented limit. The RLE arena's cost is
+O(ops + fragmentation), which is what lets churny docs STAY
+device-served: the device-side replacement for yjs GC semantics
+(reference `packages/server/src/types.ts:152-155` yDocOptions.gc).
+"""
+
+import asyncio
+
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+async def _churn(provider, cycles: int, burst: int = 16) -> None:
+    """Insert a burst at the end, then delete it — live size stays tiny
+    while cumulative unit count grows without bound."""
+    text = provider.document.get_text("body")
+    for i in range(cycles):
+        base = len(text)
+        text.insert(base, "x" * burst)
+        text.delete(base, burst)
+        if i % 4 == 3:
+            await asyncio.sleep(0.01)  # let flush cycles interleave
+
+
+async def test_churn_retires_unit_arena_but_not_rle():
+    """Same 30-cycle churn on both arenas at matched capacity=256:
+    the unit arena takes a capacity incident (480 cumulative units),
+    the RLE arena serves the whole run without a single degradation
+    (~30 run entries + tombstones).  VERDICT r3 item 3's acceptance
+    test."""
+    results = {}
+    for arena in ("unit", "rle"):
+        ext = TpuMergeExtension(
+            num_docs=8, capacity=256, flush_interval_ms=1, serve=True, arena=arena
+        )
+        server = await new_hocuspocus(extensions=[ext])
+        try:
+            provider = new_provider(server, name="churny")
+            await wait_synced(provider)
+            await _churn(provider, cycles=30)
+            await retryable_assertion(
+                lambda: _assert(ext.plane.pending_ops() == 0)
+            )
+            results[arena] = {
+                "retired_capacity": ext.plane.counters["docs_retired_capacity"],
+                "overflow": ext.plane.counters["docs_retired_overflow"],
+                "cpu_fallbacks": ext.plane.counters["cpu_fallbacks"],
+                "still_served": "churny" in ext._docs,
+            }
+            provider.destroy()
+        finally:
+            await server.destroy()
+    assert results["unit"]["retired_capacity"] > 0, results
+    assert results["rle"]["retired_capacity"] == 0, results
+    assert results["rle"]["overflow"] == 0, results
+    assert results["rle"]["cpu_fallbacks"] == 0, results
+    assert results["rle"]["still_served"], results
+
+
+async def test_rle_serve_mode_live_server_e2e():
+    """RLE plane through the real server: concurrent editors converge,
+    a late joiner cold-syncs from device state, churn keeps serving."""
+    from hocuspocus_tpu.extensions import SQLite
+
+    ext = TpuMergeExtension(
+        num_docs=8, capacity=512, flush_interval_ms=1, serve=True, arena="rle"
+    )
+    server = await new_hocuspocus(
+        extensions=[SQLite(), ext], debounce=50, max_debounce=100
+    )
+    try:
+        a = new_provider(server, name="rle-doc")
+        b = new_provider(server, name="rle-doc")
+        await wait_synced(a, b)
+        a.document.get_text("body").insert(0, "from-a \U0001f600 ")
+        b.document.get_map("meta").set("owner", "b")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("body").to_string() == "from-a \U0001f600 "
+                and a.document.get_map("meta").get("owner") == "b"
+            )
+        )
+        # churn, then a late joiner cold-syncs the merged state
+        await _churn(a, cycles=12, burst=8)
+        a.document.get_text("body").insert(0, "tail ")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("body").to_string()
+                == a.document.get_text("body").to_string()
+            )
+        )
+        c = new_provider(server, name="rle-doc")
+        await wait_synced(c)
+        assert (
+            c.document.get_text("body").to_string()
+            == a.document.get_text("body").to_string()
+        )
+        assert c.document.get_map("meta").get("owner") == "b"
+        assert "rle-doc" in ext._docs, "degraded off the RLE plane"
+        assert ext.plane.counters["cpu_fallbacks"] == 0, ext.plane.counters
+        assert ext.plane.counters["plane_broadcasts"] > 0
+        assert ext.plane.counters["sync_serves"] > 0
+        final = a.document.get_text("body").to_string()
+        for p in (a, b, c):
+            p.destroy()
+        # unload releases the RLE rows (regression: _clear_slot must
+        # rebuild RleState, not DocState) and a reload serves again
+        await retryable_assertion(lambda: _assert(not ext.plane.docs))
+        d = new_provider(server, name="rle-doc")
+        await wait_synced(d)
+        assert d.document.get_text("body").to_string() == final
+        d.destroy()
+    finally:
+        await server.destroy()
+
+
+async def test_rle_row_exhaustion_recycles_back_onto_plane():
+    """An RLE doc can exhaust entries either via the host projection
+    ("capacity") or via split costs only the DEVICE sees ("overflow" —
+    `fits = num_runs + 2 <= r`, caught by the health sweep where no
+    capture seam runs). Both must route through the recycle seam: the
+    doc re-onboards from its live snapshot instead of degrading to CPU
+    forever, and a declined recycle must NOT thrash (one snapshot
+    re-lower per verdict, not one per update)."""
+    ext = TpuMergeExtension(
+        num_docs=8, capacity=24, flush_interval_ms=1, serve=True, arena="rle"
+    )
+    server = await new_hocuspocus(extensions=[ext])
+    try:
+        p = new_provider(server, name="splitty")
+        await wait_synced(p)
+        text = p.document.get_text("body")
+        text.insert(0, "keep me. ")
+        # burst-churn until the 24-entry arena exhausts by either
+        # detector (host "capacity" projection or device "overflow"):
+        # each cycle leaves a tombstoned run behind, but the LIVE
+        # snapshot stays tiny (deleted bursts GC to host-side ranges),
+        # so this is exactly the doc class recycling must rescue
+        exhausted = lambda: (
+            ext.plane.counters["docs_retired_overflow"]
+            + ext.plane.counters["docs_retired_capacity"]
+        )
+        i = 0
+        while exhausted() == 0 and i < 60:
+            base = len(text)
+            text.insert(base, "burst!" + str(i))
+            text.delete(base, len("burst!" + str(i)))
+            i += 1
+            await asyncio.sleep(0.005)
+        assert exhausted() >= 1, ext.plane.counters
+        # nudge SPARSELY while waiting: the recycle queues behind
+        # listen-time warmup compiles (~6s on CPU) and piled flush
+        # cycles, and every nudge grows the live snapshot — a tight
+        # insert loop would outgrow the 24-entry arena before the
+        # attempt ever takes the lock, turning a legitimate recycle
+        # into a legitimate decline
+        for _ in range(40):
+            if ext.plane.counters["docs_recycled"]:
+                break
+            text.insert(0, "z")
+            await asyncio.sleep(2.0)
+        assert ext.plane.counters["docs_recycled"] >= 1, ext.plane.counters
+        await retryable_assertion(lambda: _assert("splitty" in ext._docs))
+        # the recycled registration still converges to a fresh peer
+        q = new_provider(server, name="splitty")
+        await wait_synced(q)
+        assert q.document.get_text("body").to_string() == text.to_string()
+        p.destroy()
+        q.destroy()
+    finally:
+        await server.destroy()
+
+
+async def test_overflow_reason_routes_to_recycle_and_decline_sticks():
+    """Pin the routing table deterministically: an 'overflow' retire
+    schedules a recycle; 'unsupported' and 'desync' never do; a
+    declined doc is not retried (thrash guard) until unload clears it."""
+    from types import SimpleNamespace
+
+    ext = TpuMergeExtension(num_docs=4, capacity=64, serve=True, arena="rle")
+    spawned = []
+    ext._spawn_tracked = lambda coro: (spawned.append(coro), coro.close())
+    doc = SimpleNamespace(name="d")
+    for reason, expect in (
+        ("overflow", 1),
+        ("capacity", 2),
+        ("plane_full", 3),
+        ("unsupported", 3),
+        ("desync", 3),
+        (None, 3),
+    ):
+        ext._maybe_recycle(doc, reason)
+        assert len(spawned) == expect, reason
+    ext._recycle_declined.add("d")
+    ext._maybe_recycle(doc, "overflow")
+    assert len(spawned) == 3, "declined doc must not be retried"

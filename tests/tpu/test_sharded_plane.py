@@ -92,3 +92,37 @@ def test_mesh_divisibility_validated():
     mesh = make_mesh(doc_axis=8)
     with pytest.raises(ValueError):
         MergePlane(num_docs=10, capacity=128, mesh=mesh)  # 10 % 8 != 0
+
+
+async def test_rle_serve_mode_over_mesh_end_to_end():
+    """RLE arena with mesh-sharded entries behind the live server —
+    the churn-surviving arena composes with multi-chip sharding."""
+    mesh = make_mesh(doc_axis=8)
+    ext = TpuMergeExtension(
+        num_docs=32, capacity=256, flush_interval_ms=1, serve=True, mesh=mesh,
+        arena="rle",
+    )
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="mesh-rle")
+    b = new_provider(server, name="mesh-rle")
+    try:
+        await wait_synced(a, b)
+        text = a.document.get_text("body")
+        text.insert(0, "rle over the mesh")
+        # churn a little so runs split/tombstone through the sharded step
+        text.insert(3, "XY")
+        text.delete(3, 2)
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("body").to_string() == "rle over the mesh"
+            )
+        )
+        assert ext.plane.counters["cpu_fallbacks"] == 0
+        c = new_provider(server, name="mesh-rle")
+        await wait_synced(c)
+        assert c.document.get_text("body").to_string() == "rle over the mesh"
+        c.destroy()
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
