@@ -10,11 +10,11 @@ cd /root/repo
 LOG=benchmarks/results/tpu_watch.log
 echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
-  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; x=jnp.ones((256,256),jnp.bfloat16); (x@x).block_until_ready()" 2>>"$LOG"; then
+  if timeout -k 10 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; x=jnp.ones((256,256),jnp.bfloat16); (x@x).block_until_ready()" 2>>"$LOG"; then
     STAMP=$(date -u +%Y%m%dT%H%M%SZ)
     echo "[watch] TPU ALIVE at $STAMP — running bench" >> "$LOG"
     touch benchmarks/results/TPU_ALIVE
-    if timeout 2400 python bench.py > "benchmarks/results/bench_tpu_watch_${STAMP}.json" 2>>"$LOG"; then
+    if timeout -k 30 2400 python bench.py > "benchmarks/results/bench_tpu_watch_${STAMP}.json" 2>>"$LOG"; then
       echo "[watch] bench captured: bench_tpu_watch_${STAMP}.json" >> "$LOG"
       # only keep captures that really landed on-chip THIS run — a
       # stale-capture fallback re-emits an old on-chip artifact and
