@@ -39,6 +39,9 @@ def main() -> None:
 
     num_docs = int(os.environ.get("HPS_DOCS", 8192))
     rounds = int(os.environ.get("HPS_ROUNDS", 3))
+    # native text lane (default): the C++ host path. HPS_LANE=0
+    # measures the Python path for comparison.
+    use_lane = os.environ.get("HPS_LANE", "1") != "0"
 
     # one canonical doc provides the snapshot and the per-window delta
     src = Doc()
@@ -51,12 +54,17 @@ def main() -> None:
     delta = diff_update(encode_state_as_update(src), sv)
 
     plane = MergePlane(num_docs=num_docs, capacity=512)
+    if use_lane:
+        use_lane = plane.enable_lane()
     serving = PlaneServing(plane)
     names = [f"doc-{d}" for d in range(num_docs)]
 
     t0 = time.perf_counter()
     for name in names:
-        plane.register(name)
+        if use_lane:
+            plane.register_lane(name)
+        else:
+            plane.register(name)
         plane.enqueue_update(name, snapshot, presync=True)
     seed_s = time.perf_counter() - t0
 
@@ -87,7 +95,7 @@ def main() -> None:
             plane.dirty.discard(name)
             if serving.doc_healthy(name) is None:
                 continue
-            if serving.build_broadcast(name) is not None:
+            if serving.build_broadcast_pair(name) is not None:
                 made += 1
         bcast.append(time.perf_counter() - t0)
         assert made == num_docs, made
@@ -102,6 +110,7 @@ def main() -> None:
         "unit": "us/doc-window",
         "extra": {
             "docs": num_docs,
+            "native_lane": bool(use_lane),
             "seed_s": round(seed_s, 2),
             "enqueue_us_per_doc": round(min(enq) / num_docs * 1e6, 2),
             "flush_host_s": round(min(flush), 3),

@@ -14,7 +14,7 @@ import sysconfig
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "codec.cpp")
+_SRCS = [os.path.join(_DIR, "codec.cpp"), os.path.join(_DIR, "text_lane.cpp")]
 _SO = os.path.join(_DIR, f"_codec{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
 
 _codec = None
@@ -22,8 +22,12 @@ _build_attempted = False
 
 
 def build(force: bool = False) -> bool:
-    """Compile codec.cpp into an extension module. Returns success."""
-    if not force and os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    """Compile the C++ sources into an extension module. Returns success."""
+    if (
+        not force
+        and os.path.exists(_SO)
+        and all(os.path.getmtime(_SO) >= os.path.getmtime(src) for src in _SRCS)
+    ):
         return True
     include = sysconfig.get_paths()["include"]
     cmd = [
@@ -33,7 +37,7 @@ def build(force: bool = False) -> bool:
         "-shared",
         "-fPIC",
         f"-I{include}",
-        _SRC,
+        *_SRCS,
         "-o",
         _SO,
     ]
@@ -51,7 +55,10 @@ def get_codec():
         return _codec
     if os.environ.get("HOCUSPOCUS_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_SO) and not _build_attempted:
+    if not _build_attempted:
+        # build() no-ops when the .so is newer than every source; a
+        # stale .so (new source file added) must be rebuilt or the
+        # module silently misses the new API
         _build_attempted = True
         build()
     if os.path.exists(_SO):

@@ -546,4 +546,11 @@ PyModuleDef module = {
 
 }  // namespace
 
-PyMODINIT_FUNC PyInit__codec(void) { return PyModule_Create(&module); }
+// text_lane.cpp — the native host path for plain-text documents
+void register_text_lane(PyObject* module);
+
+PyMODINIT_FUNC PyInit__codec(void) {
+    PyObject* m = PyModule_Create(&module);
+    if (m) register_text_lane(m);
+    return m;
+}

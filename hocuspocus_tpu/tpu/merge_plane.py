@@ -66,6 +66,12 @@ class PlaneDoc:
     map_tombstones: list[tuple] = field(default_factory=list)
     retired: bool = False
     retire_reason: Optional[str] = None  # first reason wins (see retire_doc)
+    # native text lane (see native/text_lane.cpp): when set, the whole
+    # host path — lowering, serve log, unit log, dispatch queue — lives
+    # in C++; serve_log/unit_logs here are lazy materializations for
+    # the cold serving paths, cached under lane_cache_key
+    lane_slot: Optional[int] = None
+    lane_cache_key: Optional[tuple] = None
 
 
 class MergePlane:
@@ -200,11 +206,18 @@ class MergePlane:
             "docs_retired_capacity": 0,
             "docs_retired_fallback": 0,
             "docs_retired_plane_full": 0,
+            "docs_retired_lane_demote": 0,
             "docs_recycled": 0,
             "sync_serves": 0,
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
         }
+        # native text lane (enable_lane): the C++ host path for plain-
+        # text docs. _lane_banned remembers docs that demoted (rich
+        # content) so re-onboarding goes straight to the Python path.
+        self._lane = None
+        self._lane_codec = None
+        self._lane_banned: set[str] = set()
 
     # -- arena dispatch ----------------------------------------------------
 
@@ -225,6 +238,135 @@ class MergePlane:
         from .pallas_kernels import integrate_op_slots_fast
 
         return integrate_op_slots_fast
+
+    # -- native text lane --------------------------------------------------
+
+    def enable_lane(self) -> bool:
+        """Switch on the C++ host path for plain-text docs (see
+        native/text_lane.cpp). Safe no-op when the codec is missing."""
+        if self._lane is not None:
+            return True
+        from ..native import get_codec
+
+        codec = get_codec()
+        if codec is None or not hasattr(codec, "lane_new"):
+            return False
+        self._lane_codec = codec
+        self._lane = codec.lane_new()
+        return True
+
+    def register_lane(self, name: str) -> Optional[PlaneDoc]:
+        """Register `name` on the native text lane (one slot, opened
+        eagerly). Returns None when the lane is off / banned for this
+        doc / the plane is full — caller falls back to register()."""
+        if self._lane is None or name in self._lane_banned:
+            return None
+        doc = self.docs.get(name)
+        if doc is not None:
+            return doc if doc.lane_slot is not None else None
+        if not self.free:
+            return None
+        slot = self.free.pop()
+        doc = PlaneDoc(name)
+        doc.lane_slot = slot
+        self.docs[name] = doc
+        self.slot_owner[slot] = name
+        self.queues[slot] = []  # stays empty: ops queue natively
+        self.unit_logs[slot] = []  # lazy materialization target
+        self.projected_len[slot] = 0
+        self.dispatched_units[slot] = 0
+        self.validated_units[slot] = 0
+        self.slot_live[slot] = True
+        self.slot_gen[slot] += 1
+        self._lane_codec.lane_open(self._lane, slot)
+        return doc
+
+    def _enqueue_lane(
+        self, doc: PlaneDoc, update: bytes, presync: bool, remote: bool
+    ) -> int:
+        slot = doc.lane_slot
+        res = self._lane_codec.lane_apply(self._lane, slot, update, presync, remote)
+        if res is None:
+            # rich/tree/map content: this doc needs the Python path.
+            # The ban makes the re-onboard (load-time retry or recycle)
+            # take the plain register() route.
+            self._lane_banned.add(doc.name)
+            self.retire_doc(doc.name, "lane_demote")
+            return 0
+        ops_added, queued_units, queued_ops, root = res
+        if root is not None and not doc.seqs:
+            doc.seqs[("root", root)] = slot
+        # RLE cost counts device-bound QUEUE entries, not serve-log
+        # records: host-only GC records never consume arena entries
+        # (mirrors the Python path routing GC to map_out)
+        cost = queued_ops if self.arena == "rle" else queued_units
+        projected = self.projected_len[slot] + cost
+        if projected > self.capacity:
+            self.retire_doc(doc.name, "capacity")
+            return 0
+        self.projected_len[slot] = projected
+        if ops_added:
+            self.dirty.add(doc.name)
+        return ops_added
+
+    def materialize_lane(self, doc: PlaneDoc) -> None:
+        """Fill doc.serve_log / unit_logs / lowerer.known from the
+        native lane for the Python serving paths (cold/stale syncs,
+        text(), the RLE payload index). Cached on the log lengths, so
+        repeated serves of an unchanged doc pay one export."""
+        if doc.lane_slot is None or self._lane is None:
+            return
+        slot = doc.lane_slot
+        key = self._lane_codec.lane_log_len(self._lane, slot)
+        if doc.lane_cache_key == key:
+            return
+        ops, units_bytes, known, root = self._lane_codec.lane_export(
+            self._lane, slot
+        )
+        self.unit_logs[slot] = np.frombuffer(
+            units_bytes, np.dtype("<u2")
+        ).tolist()
+        parent = ("root", root) if root is not None else None
+        recs = []
+        for kind, client, clock, run_len, lc, lk, rc, rk, unit_off, flags in ops:
+            gc = bool(flags & 2)
+            op = DenseOp(
+                kind=kind,
+                client=client,
+                clock=clock,
+                run_len=run_len,
+                left_client=lc,
+                left_clock=lk,
+                right_client=rc,
+                right_clock=rk,
+                deleted_content=bool(flags & 1),
+                gc=gc,
+                presync=bool(flags & 4),
+                # mirrors the Python lowerer: the wire parent only
+                # exists on origin-less items (and never on deletes/gc)
+                parent=(
+                    parent
+                    if (
+                        kind == KIND_INSERT
+                        and not gc
+                        and lc == NONE_CLIENT
+                        and rc == NONE_CLIENT
+                    )
+                    else None
+                ),
+            )
+            recs.append(
+                LogRec(
+                    op=op,
+                    # gc records are host-only in the Python path
+                    slot=None if gc else slot,
+                    unit_off=unit_off,
+                    remote=bool(flags & 8),
+                )
+            )
+        doc.serve_log = recs
+        doc.lowerer.known = dict(known)
+        doc.lane_cache_key = key
 
     # -- registry ----------------------------------------------------------
 
@@ -265,7 +407,11 @@ class MergePlane:
         # step that donated its buffers. Do NOT take _step_lock on the
         # event loop: it can be held across a device step or a warmup
         # compile (tens of seconds cold), freezing every websocket.
-        for slot in doc.seqs.values():
+        slots = set(doc.seqs.values())
+        if doc.lane_slot is not None:
+            slots.add(doc.lane_slot)  # may predate root discovery
+            self._lane_codec.lane_close(self._lane, doc.lane_slot)
+        for slot in slots:
             self.slot_owner.pop(slot, None)
             self.queues.pop(slot, None)
             self.unit_logs.pop(slot, None)
@@ -321,6 +467,12 @@ class MergePlane:
             self.unit_logs[slot] = []
             self.slot_live[slot] = False
             self.slot_gen[slot] += 1
+        if doc.lane_slot is not None:
+            # lane slots may predate root discovery (not yet in seqs)
+            slot = doc.lane_slot
+            self._lane_codec.lane_clear_queue(self._lane, slot)
+            self.slot_live[slot] = False
+            self.slot_gen[slot] += 1
 
     def _clear_slot(self, slot: int) -> None:
         empty = self._make_empty(1, self.capacity)
@@ -345,6 +497,11 @@ class MergePlane:
         self, name: str, update: bytes, presync: bool = False, remote: bool = False
     ) -> int:
         """Lower + queue one update; returns the number of ops accepted."""
+        lane_doc = self.docs.get(name)
+        if lane_doc is not None and lane_doc.lane_slot is not None:
+            if lane_doc.lowerer.unsupported:
+                return 0
+            return self._enqueue_lane(lane_doc, update, presync, remote)
         doc = self.register(name)
         if doc.lowerer.unsupported:
             return 0
@@ -425,7 +582,10 @@ class MergePlane:
         # list() snapshot: the event-loop thread can insert new queues
         # (doc load / new tree sequence) while an executor-side flush
         # calls this — dict.values() iteration would raise
-        return sum(len(q) for q in list(self.queues.values()))
+        total = sum(len(q) for q in list(self.queues.values()))
+        if self._lane is not None:
+            total += self._lane_codec.lane_queue_total(self._lane)
+        return total
 
     # -- device step -------------------------------------------------------
 
@@ -491,10 +651,12 @@ class MergePlane:
         batches = 0
         while self.pending_ops() > 0 and (max_batches is None or batches < max_batches):
             batches += 1
-            needed = min(
-                max(len(q) for q in list(self.queues.values())),
-                self.max_slots_per_flush,
+            deepest = max(
+                (len(q) for q in list(self.queues.values())), default=0
             )
+            if self._lane is not None:
+                deepest = max(deepest, self._lane_codec.lane_queue_max(self._lane))
+            needed = min(deepest, self.max_slots_per_flush)
             # round K up to a power of two to bound jit recompilations
             k = 1
             while k < needed:
@@ -605,6 +767,28 @@ class MergePlane:
             left_clock[ri, ci] = vals[5]
             right_client[ri, ci] = np.asarray(vals[6], np.uint32)
             right_clock[ri, ci] = vals[7]
+        if self._lane is not None:
+            # native lane drain: one C call pops up to k ops per lane
+            # slot into columnar buffers scattered here — no per-op
+            # Python at all on the hot-doc flush path
+            (
+                lane_built, l_rows, l_slots, l_kind, l_client, l_clock,
+                l_run, l_lc, l_lk, l_rc, l_rk, d_slots, d_units,
+            ) = self._lane_codec.lane_drain(self._lane, k)
+            if lane_built:
+                ri = np.frombuffer(l_rows, np.int64)
+                ci = np.frombuffer(l_slots, np.int64)
+                kind[ri, ci] = np.frombuffer(l_kind, np.int32)
+                client[ri, ci] = np.frombuffer(l_client, np.uint32)
+                clock[ri, ci] = np.frombuffer(l_clock, np.int32)
+                run_len[ri, ci] = np.frombuffer(l_run, np.int32)
+                left_client[ri, ci] = np.frombuffer(l_lc, np.uint32)
+                left_clock[ri, ci] = np.frombuffer(l_lk, np.int32)
+                right_client[ri, ci] = np.frombuffer(l_rc, np.uint32)
+                right_clock[ri, ci] = np.frombuffer(l_rk, np.int32)
+                ds = np.frombuffer(d_slots, np.int64)
+                self.dispatched_units[ds] += np.frombuffer(d_units, np.int64)
+                built += lane_built
         fields = (kind, client, clock, run_len, left_client, left_clock,
                   right_client, right_clock)
         return self._upload_batch(fields), built
@@ -689,6 +873,7 @@ class MergePlane:
             return None
         if doc.lowerer.unsupported:
             return None  # doc fell back to the CPU path (content/overflow)
+        self.materialize_lane(doc)
         roots = [key for key in doc.seqs if key[0] == "root"]
         if len(doc.seqs) != len(roots) or len(roots) > 1:
             return None  # tree-shaped: byte-served, not materialized
@@ -763,6 +948,7 @@ class MergePlane:
         arena stores runs, not per-unit arrival indices, so payload
         lookup goes through the host serve log (which is written at
         enqueue time in dispatch order)."""
+        self.materialize_lane(doc)
         index: dict[int, list] = {}
         for rec in doc.serve_log:
             op = rec.op
@@ -852,6 +1038,7 @@ class TpuMergeExtension(Extension):
         mesh=None,
         broadcast_interval_ms: float = 2.0,
         arena: str = "unit",
+        native_lane: bool = True,
     ) -> None:
         if plane is not None and mesh is not None:
             raise ValueError(
@@ -861,6 +1048,11 @@ class TpuMergeExtension(Extension):
         self.plane = plane or MergePlane(
             num_docs=num_docs, capacity=capacity, mesh=mesh, arena=arena
         )
+        # native text lane: the C++ host path (lower+log+queue+window)
+        # for plain-text docs — the round-3 host-plane bottleneck fix.
+        # Serve-mode only (its broadcast windows ride the lane) and
+        # contingent on the codec building.
+        self.native_lane = bool(native_lane and serve and self.plane.enable_lane())
         self.flush_interval_ms = flush_interval_ms
         # broadcasts build from the HOST serve logs and run on their own
         # (shorter) coalescing window, decoupled from the device flush:
@@ -942,10 +1134,26 @@ class TpuMergeExtension(Extension):
 
         self._instance = data.instance
         name = data.document_name
-        self.plane.register(name)
+        lane_doc = None
+        if self.native_lane:
+            lane_doc = self.plane.register_lane(name)
+        if lane_doc is None:
+            self.plane.register(name)
         snapshot = encode_state_as_update(data.document)
         # receivers get pre-load state via sync, not broadcast
         self.plane.enqueue_update(name, snapshot, presync=True)
+        if lane_doc is not None and not self.plane.is_supported(name):
+            # load-time lane demote (the snapshot holds rich content):
+            # nothing is served yet, so retry on the Python path in
+            # place instead of the full fallback+recycle dance.
+            # flush_lock: release() rebuilds device state and must not
+            # race an executor-side flush holding donated buffers.
+            plane_doc = self.plane.docs.get(name)
+            if plane_doc is not None and plane_doc.retire_reason == "lane_demote":
+                async with self.plane.flush_lock:
+                    self.plane.release(name)
+                    self.plane.register(name)
+                    self.plane.enqueue_update(name, snapshot, presync=True)
         if self.serve and self.plane.is_supported(name):
             self._attach_serving(name, data.document)
         self._schedule_flush()
@@ -1031,12 +1239,20 @@ class TpuMergeExtension(Extension):
             return False
         plane = self.plane
         if not plane.is_supported(name):
+            plane_doc = plane.docs.get(name)
+            reason = plane_doc.retire_reason if plane_doc is not None else None
+            if reason == "lane_demote":
+                # keep serving attached; this update rides the CPU
+                # fan-out until the Python-plane registration lands.
+                # Re-spawn per update: an earlier attempt may have
+                # bailed (e.g. zero connections at the time) and the
+                # rebuild's own guards make redundant spawns no-ops.
+                self._spawn_tracked(self._rebuild_lane_doc(document))
+                return False
             # already degraded (e.g. a device OVERFLOW retire from the
             # post-flush health sweep, where no recycle seam runs) —
             # this fresh traffic is the signal the doc is still busy
             # and worth re-onboarding
-            plane_doc = plane.docs.get(name)
-            reason = plane_doc.retire_reason if plane_doc is not None else None
             self._fallback_to_cpu(document)
             self._maybe_recycle(document, reason)
             return False
@@ -1045,12 +1261,83 @@ class TpuMergeExtension(Extension):
             # this very update degraded the doc; it broadcasts via CPU
             plane_doc = plane.docs.get(name)
             reason = plane_doc.retire_reason if plane_doc is not None else None
+            if reason == "lane_demote":
+                # the doc outgrew the native text lane (first map/rich
+                # op): rebuild it on the Python plane IN PLACE — serving
+                # stays attached, this and subsequent updates ride the
+                # per-update CPU fan-out until the rebuild lands
+                self._spawn_tracked(self._rebuild_lane_doc(document))
+                return False
             self._fallback_to_cpu(document)
             self._maybe_recycle(document, reason)
             return False
         self._schedule_flush()
         self._schedule_broadcast()
         return True
+
+    async def _rebuild_lane_doc(self, document) -> None:
+        """In-place re-onboard of a lane-demoted doc onto the Python
+        plane path.
+
+        Unlike capacity recycling there is no CPU-fallback broadcast:
+        receivers stay current through (1) the pending lane window,
+        shipped here before the log is dropped, and (2) per-update CPU
+        fan-out for every update between the demote and this rebuild
+        (try_capture returns False for a retired doc). The ban set
+        routes register() to the Python path."""
+        from ..crdt import encode_state_as_update
+
+        name = document.name
+        plane = self.plane
+        async with plane.flush_lock:
+            if document.get_connections_count() <= 0:
+                return  # unloading anyway
+            doc = plane.docs.get(name)
+            if (
+                doc is None
+                or not doc.retired
+                or doc.retire_reason != "lane_demote"
+                or name not in self._docs
+            ):
+                return  # state moved on; leave it be
+            try:
+                pair = self.serving.build_broadcast_pair(name)
+            except Exception:
+                pair = None
+            if pair is not None:
+                update, cross = pair
+                document.broadcast_update_frame(update)
+                if cross is not None and self._instance is not None:
+                    self._spawn_tracked(
+                        self._instance.hooks(
+                            "on_plane_broadcast",
+                            Payload(
+                                instance=self._instance,
+                                document_name=name,
+                                document=document,
+                                update=cross,
+                            ),
+                        )
+                    )
+            try:
+                plane.release(name)
+                plane.register(name)
+                plane.enqueue_update(
+                    name, encode_state_as_update(document), presync=True
+                )
+                new_doc = plane.docs.get(name)
+                if new_doc is None or new_doc.lowerer.unsupported:
+                    raise RuntimeError("live content unsupported")
+                # the cursor still points into the LANE's op log; left
+                # stale it would swallow (or mis-slice) every window of
+                # the fresh Python-path registration
+                self.serving.broadcast_cursor[name] = len(new_doc.serve_log)
+            except Exception:
+                # genuinely unsupported content: the doc leaves the
+                # plane for the plain CPU path
+                self._fallback_to_cpu(document)
+                return
+        self._schedule_flush()
 
     def _maybe_recycle(self, document, reason: "Optional[str]") -> None:
         """Schedule a recycle for row-exhaustion retires.
@@ -1068,7 +1355,7 @@ class TpuMergeExtension(Extension):
         the recycle guards. Content retires ("unsupported") and desyncs
         never recycle — the condition is permanent or needs a human.
         """
-        if reason not in ("capacity", "plane_full", "overflow"):
+        if reason not in ("capacity", "plane_full", "overflow", "lane_demote"):
             return
         if document.name in self._recycle_declined:
             return
@@ -1101,11 +1388,26 @@ class TpuMergeExtension(Extension):
                 return  # registration changed under us; leave it be
             try:
                 plane.release(name)
-                plane.register(name)
-                plane.enqueue_update(
-                    name, encode_state_as_update(document), presync=True
-                )
+                # a hot plain-text doc keeps its native lane across the
+                # recycle (unless it demoted: the ban set routes it to
+                # the Python path inside register_lane)
+                if not (self.native_lane and plane.register_lane(name)):
+                    plane.register(name)
+                snapshot = encode_state_as_update(document)
+                plane.enqueue_update(name, snapshot, presync=True)
                 doc = plane.docs.get(name)
+                if (
+                    doc is not None
+                    and doc.retired
+                    and doc.retire_reason == "lane_demote"
+                ):
+                    # the doc had never attempted the lane before (not
+                    # banned) and its snapshot is rich: retry in place
+                    # on the Python path instead of stranding it
+                    plane.release(name)
+                    plane.register(name)
+                    plane.enqueue_update(name, snapshot, presync=True)
+                    doc = plane.docs.get(name)
                 if doc is None or doc.lowerer.unsupported:
                     self._recycle_declined.add(name)
                     return  # live content unsupported/too big: stays on CPU

@@ -193,6 +193,7 @@ class PlaneServing:
         doc = self.plane.docs.get(name)
         if doc is None:
             return False
+        self.plane.materialize_lane(doc)  # lane docs: refresh known
         known = doc.lowerer.known
         for client, clock in document.store.get_state_vector().items():
             if clock > known.get(client, 0):
@@ -515,6 +516,7 @@ class PlaneServing:
 
     def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
         """SyncStep2 bytes for a doc given the per-client cutoff map."""
+        self.plane.materialize_lane(doc)  # lane docs: serve from the export
         cold = len(sm) == len(doc.lowerer.known) and all(
             clock == 0 for clock in sm.values()
         )
@@ -779,6 +781,16 @@ class PlaneServing:
         doc = plane.docs.get(name)
         if doc is None:
             return None
+        if doc.lane_slot is not None:
+            # native path: one C call builds both frames' update bytes
+            full, cross, new_idx, _ = plane._lane_codec.lane_window(
+                plane._lane, doc.lane_slot, self.broadcast_cursor.get(name, 0)
+            )
+            self.broadcast_cursor[name] = new_idx
+            if full is None:
+                return None
+            plane.counters["plane_broadcasts"] += 1
+            return full, cross
         log = doc.serve_log
         cursor = min(self.broadcast_cursor.get(name, 0), len(log))
         window = [rec for rec in log[cursor:] if not rec.op.presync]

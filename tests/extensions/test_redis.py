@@ -271,9 +271,21 @@ async def test_tpu_serve_mode_with_redis_fanout_production_topology():
         _assert(ext_b.plane.counters["cpu_fallbacks"] == 0)
         _assert(ext_a.plane.counters["docs_retired_unsupported"] == 0)
         _assert(ext_b.plane.counters["docs_retired_unsupported"] == 0)
-        # local fan-out on each instance rode the plane
+        # local fan-out on each instance rode the plane. B's first local
+        # ops were map/array — they demote the native text lane and ride
+        # the CPU fan-out while the in-place Python-plane rebuild runs —
+        # so B's plane broadcasts appear with its next traffic.
         _assert(ext_a.plane.counters["plane_broadcasts"] >= 1)
-        _assert(ext_b.plane.counters["plane_broadcasts"] >= 1)
+        await retryable_assertion(
+            lambda: _assert(
+                (doc := ext_b.plane.docs.get("prod-doc")) is not None
+                and not doc.retired
+            )
+        )
+        provider_b.document.get_map("meta").set("post-rebuild", True)
+        await retryable_assertion(
+            lambda: _assert(ext_b.plane.counters["plane_broadcasts"] >= 1)
+        )
 
         # sustained traffic propagates via the coalesced WINDOW frames,
         # not per-op SyncStep1 round trips: many ops cross with only

@@ -64,7 +64,8 @@ async def test_concurrent_editors_converge_across_recycles():
     editors, the server doc, a late joiner) must still converge."""
     import random
 
-    ext = TpuMergeExtension(num_docs=48, capacity=512, flush_interval_ms=1, serve=True)
+    ext = TpuMergeExtension(num_docs=48, capacity=512, flush_interval_ms=1, serve=True,
+                            native_lane=False)  # tests Python-plane recycling under load
     server = await new_hocuspocus(extensions=[ext])
     a = new_provider(server, name="race")
     b = new_provider(server, name="race")
@@ -172,7 +173,8 @@ async def test_capacity_recycle_reclaims_rows_for_subtree_churn():
     elements) exhausts its append-only rows, but the collected
     subtrees vanish from the live snapshot — the doc recycles onto
     fresh rows and STAYS plane-served instead of degrading forever."""
-    ext = TpuMergeExtension(num_docs=16, capacity=512, flush_interval_ms=1, serve=True)
+    ext = TpuMergeExtension(num_docs=16, capacity=512, flush_interval_ms=1, serve=True,
+                            native_lane=False)  # tests Python-plane recycling: a lane rebuild would compact for free
     server = await new_hocuspocus(extensions=[ext])
     a = new_provider(server, name="churny")
     b = new_provider(server, name="churny")

@@ -263,7 +263,16 @@ async def test_map_content_served_from_plane():
         )
         assert ext.plane.counters["docs_retired_unsupported"] == 0
         assert "mapdoc" in ext._docs  # serving still attached
-        assert ext.plane.counters["plane_broadcasts"] >= 1
+        # the first map op demotes the doc off the native text lane (it
+        # rides the per-update CPU fan-out while the in-place rebuild
+        # runs); once rebuilt on the Python plane path, map traffic
+        # broadcasts through plane windows again
+        await retryable_assertion(
+            lambda: _assert(
+                (doc := ext.plane.docs.get("mapdoc")) is not None
+                and not doc.retired
+            )
+        )
         # LWW overwrite + a second key keep flowing through the plane
         provider_b.document.get_map("m").set("k", "v2")
         provider_b.document.get_map("m").set("k2", "w")
@@ -494,17 +503,16 @@ async def test_serve_mode_survives_doc_churn_under_load():
                 p.document.get_text("t").insert(0, f"c{wave}-{i}")
             # edits must actually be in the pipeline before destroy, or
             # the unload races nothing and the test goes vacuous
+            def _known(name):
+                doc = ext.plane.docs.get(name)
+                if doc is None:
+                    return False
+                ext.plane.materialize_lane(doc)  # lane docs keep known in C++
+                return bool(doc.lowerer.known)
+
             await retryable_assertion(
                 lambda: _assert(
-                    all(
-                        ext.plane.docs[f"churn-{wave}-{i}"].lowerer.known
-                        for i in range(4)
-                        if f"churn-{wave}-{i}" in ext.plane.docs
-                    )
-                    and sum(
-                        f"churn-{wave}-{i}" in ext.plane.docs for i in range(4)
-                    )
-                    == 4
+                    sum(_known(f"churn-{wave}-{i}") for i in range(4)) == 4
                 )
             )
             for p in churners:

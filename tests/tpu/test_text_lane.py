@@ -1,0 +1,209 @@
+"""Native text lane ≡ Python host path (differential suite).
+
+The lane (native/text_lane.cpp) re-implements the DocLowerer subset
+for plain-text docs plus the serve-log/window machinery in C++. These
+tests pin byte-identity of broadcast windows, dispatch-stream equality
+into the device batch, sync-serve equality, out-of-order (pending)
+buffering, and the demote path for rich content — the same random
+streams driven through a lane plane and a Python plane side by side.
+"""
+
+import numpy as np
+import pytest
+
+from hocuspocus_tpu.crdt import (
+    Doc,
+    apply_update,
+    diff_update,
+    encode_state_as_update,
+    encode_state_vector,
+)
+from hocuspocus_tpu.native import get_codec
+from hocuspocus_tpu.tpu.merge_plane import MergePlane
+from hocuspocus_tpu.tpu.serving import PlaneServing
+
+pytestmark = pytest.mark.skipif(
+    get_codec() is None or not hasattr(get_codec(), "lane_new"),
+    reason="native text lane unavailable",
+)
+
+
+def _planes(num_docs=8, capacity=4096):
+    lane_plane = MergePlane(num_docs=num_docs, capacity=capacity)
+    assert lane_plane.enable_lane()
+    py_plane = MergePlane(num_docs=num_docs, capacity=capacity)
+    return lane_plane, PlaneServing(lane_plane), py_plane, PlaneServing(py_plane)
+
+
+@pytest.mark.parametrize("seed", [2, 9, 31])
+def test_lane_windows_and_serves_match_python_fuzz(seed):
+    rng = np.random.default_rng(seed)
+    lane_plane, lane_serving, py_plane, py_serving = _planes()
+    assert lane_plane.register_lane("d") is not None
+    py_plane.register("d")
+
+    src = Doc()
+    src.client_id = 7
+    text = src.get_text("body")
+    updates = []
+    src.on("update", lambda u, *r: updates.append(u))
+
+    for round_no in range(12):
+        for _ in range(int(rng.integers(1, 5))):
+            r = rng.random()
+            n = len(text)
+            if r < 0.6 or n < 4:
+                pos = int(rng.integers(0, n + 1))
+                text.insert(pos, f"r{round_no}x{'y' * int(rng.integers(1, 9))}")
+            elif r < 0.85:
+                pos = int(rng.integers(0, n - 2))
+                text.delete(pos, int(rng.integers(1, min(3, n - pos) + 1)))
+            else:
+                pos = int(rng.integers(0, n + 1))
+                text.insert(pos, "emoji\U0001f600")
+        while updates:
+            u = updates.pop(0)
+            assert lane_plane.enqueue_update("d", u) > 0
+            assert py_plane.enqueue_update("d", u) > 0
+        # broadcast windows must be byte-identical
+        lw = lane_serving.build_broadcast_pair("d")
+        pw = py_serving.build_broadcast_pair("d")
+        assert (lw is None) == (pw is None)
+        if lw is not None:
+            assert lw[0] == pw[0], round_no
+            assert lw[1] == pw[1], round_no
+        # integrate as we go (the real pipeline interleaves flushes)
+        lane_plane.flush()
+        py_plane.flush()
+
+    # flush through the real kernels and serve cold + stale
+    lane_plane.flush()
+    py_plane.flush()
+    lane_serving.refresh()
+    py_serving.refresh()
+    assert lane_plane.text("d") == text.to_string() == py_plane.text("d")
+
+    cold_l = lane_serving.encode_state_as_update("d", src, None)
+    cold_p = py_serving.encode_state_as_update("d", src, None)
+    assert cold_l is not None and cold_l == cold_p
+    probe = Doc()
+    apply_update(probe, cold_l)
+    assert probe.get_text("body").to_string() == text.to_string()
+
+    mid_sv = encode_state_vector(src)
+    text.insert(0, "tail ")
+    while updates:
+        u = updates.pop(0)
+        lane_plane.enqueue_update("d", u)
+        py_plane.enqueue_update("d", u)
+    lane_plane.flush()
+    py_plane.flush()
+    lane_serving.refresh()
+    py_serving.refresh()
+    stale_l = lane_serving.encode_state_as_update("d", src, mid_sv)
+    stale_p = py_serving.encode_state_as_update("d", src, mid_sv)
+    assert stale_l is not None and stale_l == stale_p
+
+
+def test_lane_drain_feeds_identical_device_batches():
+    """lane_drain's columnar scatter must hand the kernel the same op
+    stream, slot column for slot column, as the Python queue loop."""
+    lane_plane, _, py_plane, _ = _planes()
+    lane_plane.register_lane("d")
+    py_plane.register("d")
+    src = Doc()
+    src.client_id = 7
+    text = src.get_text("t")
+    text.insert(0, "hello world")
+    text.insert(5, " BIG")
+    text.delete(0, 3)
+    text.insert(0, "emoji\U0001f600")
+    u = encode_state_as_update(src)
+    lane_plane.enqueue_update("d", u)
+    py_plane.enqueue_update("d", u)
+    lane_ops, lane_built = lane_plane._build_batch(64)
+    py_ops, py_built = py_plane._build_batch(64)
+    assert lane_built == py_built > 0
+    ls = lane_plane.docs["d"].lane_slot
+    ps = py_plane.docs["d"].seqs[("root", "t")]
+    for name in ("kind", "client", "clock", "run_len", "left_client",
+                 "left_clock", "right_client", "right_clock"):
+        la = np.asarray(getattr(lane_ops, name))
+        pa = np.asarray(getattr(py_ops, name))
+        np.testing.assert_array_equal(la[:, ls], pa[:, ps], err_msg=name)
+    assert lane_plane.dispatched_units[ls] == py_plane.dispatched_units[ps]
+
+
+def test_lane_buffers_out_of_order_updates():
+    """A delta that arrives before its causal predecessor waits in the
+    lane's pending set and applies once the gap closes — mirroring the
+    Python lowerer (reconnecting offline editors)."""
+    lane_plane, lane_serving, py_plane, py_serving = _planes()
+    lane_plane.register_lane("d")
+    py_plane.register("d")
+
+    src = Doc()
+    src.client_id = 3
+    text = src.get_text("t")
+    text.insert(0, "base ")
+    u1 = encode_state_as_update(src)
+    sv1 = encode_state_vector(src)
+    text.insert(5, "middle ")
+    u2 = diff_update(encode_state_as_update(src), sv1)
+    sv2 = encode_state_vector(src)
+    text.insert(0, "front ")
+    u3 = diff_update(encode_state_as_update(src), sv2)
+
+    for plane in (lane_plane, py_plane):
+        assert plane.enqueue_update("d", u1) > 0
+        assert plane.enqueue_update("d", u3) == 0  # gap: buffered
+        assert plane.enqueue_update("d", u2) > 0  # closes the gap; drains u3
+        assert plane.is_supported("d")
+    lw = lane_serving.build_broadcast_pair("d")
+    pw = py_serving.build_broadcast_pair("d")
+    assert lw is not None and lw[0] == pw[0]
+    lane_plane.flush()
+    lane_serving.refresh()
+    assert lane_plane.text("d") == text.to_string()
+
+
+def test_lane_demotes_on_rich_content_and_bans():
+    lane_plane, lane_serving, _, _ = _planes()
+    lane_plane.register_lane("d")
+    src = Doc()
+    src.get_text("t").insert(0, "plain")
+    assert lane_plane.enqueue_update("d", encode_state_as_update(src)) > 0
+    src.get_map("m").set("k", 1)
+    assert lane_plane.enqueue_update("d", encode_state_as_update(src)) == 0
+    doc = lane_plane.docs["d"]
+    assert doc.retired and doc.retire_reason == "lane_demote"
+    assert "d" in lane_plane._lane_banned
+    assert lane_plane.counters["docs_retired_lane_demote"] == 1
+    # re-onboard goes to the Python path
+    lane_plane.release("d")
+    assert lane_plane.register_lane("d") is None
+
+
+def test_lane_remote_flags_split_cross_instance_windows():
+    lane_plane, lane_serving, py_plane, py_serving = _planes()
+    lane_plane.register_lane("d")
+    py_plane.register("d")
+    src = Doc()
+    src.client_id = 5
+    src.get_text("t").insert(0, "local one ")
+    u_local = encode_state_as_update(src)
+    sv = encode_state_vector(src)
+    peer = Doc()
+    peer.client_id = 6
+    apply_update(peer, u_local)
+    peer.get_text("t").insert(0, "REMOTE ")
+    u_remote = diff_update(encode_state_as_update(peer), sv)
+
+    for plane in (lane_plane, py_plane):
+        plane.enqueue_update("d", u_local)
+        plane.enqueue_update("d", u_remote, remote=True)
+    lw_full, lw_cross = lane_serving.build_broadcast_pair("d")
+    pw_full, pw_cross = py_serving.build_broadcast_pair("d")
+    assert lw_full == pw_full
+    assert lw_cross == pw_cross
+    assert lw_cross != lw_full  # remote record excluded
