@@ -1,0 +1,56 @@
+"""Browser playground: serve examples/browser/index.html + websockets
+from ONE port — the repo's answer to the reference playground frontend
+(`/root/reference/playground/frontend`, Next.js + Tiptap), with a
+dependency-free page speaking the wire protocol directly.
+
+    python examples/browser_demo.py [--port 8000]
+
+then open http://127.0.0.1:8000/ in two browser tabs: text edits sync
+live through the server (the TPU merge plane serves supported docs).
+The page's protocol path is pinned by
+tests/server/test_browser_protocol.py.
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu.server import Configuration, Server  # noqa: E402
+from hocuspocus_tpu.tpu import TpuMergeExtension  # noqa: E402
+
+PAGE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "browser", "index.html")
+
+
+async def serve_page(data) -> None:
+    from aiohttp import web
+
+    if data.request.path in ("/", "/index.html"):
+        with open(PAGE, "rb") as f:
+            body = f.read()
+        data["response"] = web.Response(body=body, content_type="text/html")
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8000)
+    args = parser.parse_args()
+    server = Server(
+        Configuration(
+            extensions=[
+                TpuMergeExtension(
+                    num_docs=64, capacity=8192, flush_interval_ms=2, serve=True
+                )
+            ],
+            on_request=serve_page,
+        )
+    )
+    await server.listen(port=args.port)
+    print(f"open http://127.0.0.1:{args.port}/ in two tabs")
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

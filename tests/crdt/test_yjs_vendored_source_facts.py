@@ -1,0 +1,215 @@
+"""Cross-validation against the REAL yjs implementation's source code.
+
+This image has no JS runtime (no node/deno/bun/chromium), so captured
+byte traffic from a live yjs peer is unobtainable — but JupyterLab
+vendors the genuine, unmodified yjs library (minified) in its static
+bundles (JupyterLab 4 shares yjs with federated extensions, so the
+module ships with its full export surface and the `"__ $YJS$ __"`
+duplicate-import sentinel). That code is EXTERNALLY AUTHORED ground
+truth for the v1 wire format.
+
+These tests mechanically extract the format's load-bearing facts from
+that vendored source at test time — the content-ref reader dispatch
+order, the ContentType type-ref order, the struct info bit layout,
+the GC/Skip refs, the ContentJSON "undefined" special case — and
+assert our codec's constants against them. If our reading of the spec
+had drifted anywhere a real yjs peer would notice, the extraction
+would disagree.
+
+(The complement — full wire blobs — remains covered by the
+hand-derived vectors in test_yjs_golden_vectors.py; reference
+consumption point `packages/server/src/MessageReceiver.ts:195-213`.)
+"""
+
+from __future__ import annotations
+
+import glob
+import re
+import sysconfig
+
+import pytest
+
+
+def _vendored_yjs_source() -> "str | None":
+    site = sysconfig.get_paths()["purelib"]
+    for path in glob.glob(f"{site}/jupyterlab/static/*.js"):
+        try:
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                src = f.read()
+        except OSError:
+            continue
+        if "__ $YJS$ __" in src and "encodeStateAsUpdate" in src:
+            return src
+    return None
+
+
+_SRC = _vendored_yjs_source()
+
+pytestmark = pytest.mark.skipif(
+    _SRC is None, reason="no vendored yjs source in this environment"
+)
+
+
+def _exports() -> dict[str, str]:
+    """Public export name -> minified symbol (rspack keeps the map)."""
+    return dict(
+        re.findall(
+            r'([A-Za-z][A-Za-z0-9]*):\(\)=>([A-Za-z_$][\w$]*)',
+            _SRC,
+        )
+    )
+
+
+def test_content_ref_dispatch_order_matches():
+    """yjs `contentRefs[info & BITS5]`: the reader array's index IS the
+    wire content ref. Extract the array and resolve each entry's
+    constructor back to a public export name."""
+    ex = _exports()
+    sym = {v: k for k, v in ex.items() if k.startswith("Content")}
+    # the dispatch site names the array: NAME[31&x](...)
+    md = re.search(r'([\w$]+)\[31&[\w$]+\]', _SRC)
+    assert md, "info-dispatch site not found"
+    array_name = md.group(1)
+    ma = re.search(re.escape(array_name) + r'=\[', _SRC)
+    assert ma, "contentRefs array not found"
+    # balanced scan from the opening bracket
+    start = ma.end()
+    depth_scan, i = 1, start
+    while depth_scan:
+        ch = _SRC[i]
+        depth_scan += ch in "([{"
+        depth_scan -= ch in ")]}"
+        i += 1
+    body = _SRC[start : i - 1]
+    # split top-level entries (arrow fns, possibly with nested braces)
+    entries, depth, cur = [], 0, ""
+    for ch in body:
+        if ch == "," and depth == 0:
+            entries.append(cur)
+            cur = ""
+            continue
+        depth += ch in "({["
+        depth -= ch in ")}]"
+        cur += ch
+    entries.append(cur)
+    assert len(entries) == 11, entries
+
+    def ctor_of(entry: str) -> "str | None":
+        mm = re.search(r'new ([\w$]+)\(', entry)
+        return sym.get(mm.group(1)) if mm else None
+
+    got = {i: ctor_of(e) for i, e in enumerate(entries)}
+    # indexes 0 and 10 are the invalid/skip error throwers
+    assert got[0] is None and got[10] is None
+    expected = {
+        1: "ContentDeleted",
+        2: "ContentJSON",
+        3: "ContentBinary",
+        4: "ContentString",
+        5: "ContentEmbed",
+        6: "ContentFormat",
+        7: "ContentType",
+        8: "ContentAny",
+    }
+    assert {i: got[i] for i in expected} == expected, got
+    # index 9 = ContentDoc (not re-exported by yjs's index, so resolve
+    # structurally: the reader takes a guid string + an opts Any)
+    assert "readString()" in entries[9] and "readAny()" in entries[9], entries[9]
+
+    # and OUR constants agree with the real implementation
+    from hocuspocus_tpu.crdt import content as c
+
+    assert c.ContentDeleted.ref == 1
+    assert c.ContentJSON.ref == 2
+    assert c.ContentBinary.ref == 3
+    assert c.ContentString.ref == 4
+    assert c.ContentEmbed.ref == 5
+    assert c.ContentFormat.ref == 6
+    assert c.ContentAny.ref == 8
+    from hocuspocus_tpu.crdt.structs import STRUCT_GC_REF, STRUCT_SKIP_REF
+
+    assert STRUCT_GC_REF == 0
+    assert STRUCT_SKIP_REF == 10
+
+
+def test_type_ref_order_matches():
+    """ContentType's `typeRefs[readTypeRef()]` array: index = wire type
+    ref. XmlElement/XmlHook read a key (tag/hook name), matching our
+    writer."""
+    ex = _exports()
+    sym = {v: k for k, v in ex.items()}
+    # the typeRefs array: seven `t=>new X` entries, immediately followed
+    # by the numbered ref constants (=0,=1,...)
+    m = re.search(r'([\w$]+)=\[(t=>new [^\]]+?)\],[\w$]+=0,[\w$]+=1', _SRC)
+    assert m, "typeRefs array not found"
+    body = m.group(2)
+    ctors = re.findall(r'new ([\w$]+)(\([^)]*\)?\)|\(\))?', body)
+    names = [sym.get(c_) for c_, _ in ctors]
+    assert names == [
+        "Array", "Map", "Text", "XmlElement", "XmlFragment", "XmlHook", "XmlText",
+    ], names
+    # readKey consumers: XmlElement (index 3) and XmlHook (index 5)
+    args = [a for _, a in ctors]
+    assert "readKey" in args[3], args
+    assert "readKey" in args[5], args
+    assert all("readKey" not in args[i] for i in (0, 1, 2, 4, 6)), args
+
+    from hocuspocus_tpu.crdt.types.base import (
+        YARRAY_REF,
+        YMAP_REF,
+        YTEXT_REF,
+        YXML_ELEMENT_REF,
+        YXML_FRAGMENT_REF,
+        YXML_HOOK_REF,
+        YXML_TEXT_REF,
+    )
+
+    assert [
+        YARRAY_REF, YMAP_REF, YTEXT_REF, YXML_ELEMENT_REF,
+        YXML_FRAGMENT_REF, YXML_HOOK_REF, YXML_TEXT_REF,
+    ] == [0, 1, 2, 3, 4, 5, 6]
+
+
+def test_struct_info_bit_layout_matches():
+    """The struct decode path in real yjs:
+    - (128 & info) == 128 -> read left origin ID
+    - (64 & info) == 64  -> read right origin ID
+    - (192 & info) == 0  -> read parent info (origin-less item)
+    - (32 & info) == 32  -> read parent_sub string (only with parent)
+    - info ref 0 -> GC with length; info == 10 -> Skip with length
+    Our encoder/decoder use exactly these bits (crdt/structs.py,
+    native/codec.cpp BIT_ORIGIN/BIT_RIGHT_ORIGIN/BIT_PARENT_SUB)."""
+    assert re.search(r'\(128&[\w$]+\)==128\?[\w$]+\.readLeftID\(\)', _SRC)
+    assert re.search(r'\(64&[\w$]+\)==64\?[\w$]+\.readRightID\(\)', _SRC)
+    assert re.search(r'\(192&[\w$]+\)==0', _SRC)
+    assert re.search(r'\(32&[\w$]+\)==32\?[\w$]+\.readString\(\):null', _SRC)
+    ex = _exports()
+    gc_sym = re.escape(ex["GC"])
+    assert re.search(r'case 0:\{let [\w$]+=[\w$]+\.readLen\(\);[^}]*new ' + gc_sym, _SRC)
+    assert re.search(r'10===[\w$]+\)\{', _SRC) or "writeInfo(10)" in _SRC
+
+    from hocuspocus_tpu.native import get_codec
+
+    codec = get_codec()
+    if codec is not None:
+        # the native decoder consumes the same layout: a crafted item
+        # with both origins must decode its ids (smoke-level agreement)
+        from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+
+        d = Doc()
+        d.client_id = 42
+        d.get_text("t").insert(0, "ab")
+        update = encode_state_as_update(d)
+        structs, _ = codec.decode_update(update)
+        assert structs and structs[0][0] == 42
+
+
+def test_content_json_undefined_special_case_matches():
+    """Real yjs readContentJSON: the literal string "undefined" decodes
+    to undefined, everything else through JSON.parse — and the writer
+    emits json_stringify(undefined) == "undefined". Our codec mirrors
+    both directions."""
+    assert re.search(r'"undefined"===[\w$]+\?[\w$]+\.push\(void 0\)', _SRC)
+    from hocuspocus_tpu.crdt.encoding import UNDEFINED, json_stringify
+
+    assert json_stringify(UNDEFINED) == "undefined"

@@ -1,0 +1,475 @@
+"""The browser demo's protocol path, driven from Python byte-for-byte.
+
+examples/browser/index.html speaks the wire protocol with a hand-rolled
+client (lib0 frames, auth submessage, SyncStep1/2/Update, a per-unit
+YATA text CRDT). No JS runtime exists in this image, so this test
+translates that client 1:1 (same frame layout, same single-struct
+update encoding, same ds-only deletes, same stored-origin full-state
+reply to the server's SyncStep1) and drives it over a raw websocket —
+pinning every protocol interaction the page performs against the real
+server, alongside a standard provider.
+
+Reference counterpart: the playground frontend's provider traffic
+(`/root/reference/playground/frontend`) through
+`packages/server/src/ClientConnection.ts:279-343` (auth queueing) and
+`MessageReceiver.ts:137-213` (sync handshake).
+"""
+
+import asyncio
+import random
+
+import aiohttp
+
+from hocuspocus_tpu.crdt.encoding import Decoder, Encoder
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+ROOT = "body"
+MSG_SYNC, MSG_AUTH, MSG_SYNC_REPLY, MSG_SYNC_STATUS = 0, 2, 4, 8
+STEP1, STEP2, UPDATE = 0, 1, 2
+
+
+def _assert(cond):
+    assert cond
+
+
+class _Unit:
+    __slots__ = ("c", "k", "ch", "deleted", "oc", "ok")
+
+    def __init__(self, c, k, ch, oc, ok):
+        self.c, self.k, self.ch = c, k, ch
+        self.oc, self.ok = oc, ok
+        self.deleted = False
+
+
+class BrowserMirrorClient:
+    """Python twin of the JS client in examples/browser/index.html."""
+
+    def __init__(self, doc_name: str = "browser-demo") -> None:
+        self.doc_name = doc_name
+        self.client_id = random.getrandbits(28)
+        self.clock = 0
+        self.units: list[_Unit] = []
+        self.known: dict[int, int] = {}
+        self.pending: list = []
+        self.pending_deletes: list = []  # (client, clock, len) awaiting targets
+        self.synced = False
+        self._session = None
+        self._ws = None
+        self._reader_task = None
+
+    # -- crdt (mirrors integrateRun / applyDelete / drainPending) -----------
+
+    def _idx(self, c, k):
+        for i, u in enumerate(self.units):
+            if u.c == c and u.k == k:
+                return i
+        return -1
+
+    def _integrate(self, run) -> bool:
+        c, k, text, length, oc, ok, rc, rk = run
+        have = self.known.get(c, 0)
+        if k + length <= have:
+            return True
+        if k > have:
+            return False
+        off = have - k
+        left_idx = -1
+        if oc is not None and off == 0:
+            left_idx = self._idx(oc, ok)
+            if left_idx < 0:
+                return False
+        elif off > 0:
+            left_idx = self._idx(c, k + off - 1)
+            if left_idx < 0:
+                return False
+        right_idx = len(self.units)
+        if rc is not None:
+            right_idx = self._idx(rc, rk)
+            if right_idx < 0:
+                return False
+        dest = right_idx
+        for i in range(left_idx + 1, right_idx):
+            u = self.units[i]
+            u_origin = -1 if u.oc is None else self._idx(u.oc, u.ok)
+            skip = u_origin > left_idx or (u_origin == left_idx and u.c < c)
+            if not skip:
+                dest = i
+                break
+        inserted = []
+        for j in range(off, length):
+            inserted.append(
+                _Unit(
+                    c,
+                    k + j,
+                    0 if text is None else ord(text[j]),
+                    oc if j == 0 else c,
+                    ok if j == 0 else k + j - 1,
+                )
+            )
+            if text is None:
+                inserted[-1].deleted = True
+        self.units[dest:dest] = inserted
+        self.known[c] = k + length
+        return True
+
+    def _apply_delete(self, c, k, length):
+        for u in self.units:
+            if u.c == c and k <= u.k < k + length:
+                u.deleted = True
+
+    def _drain_pending(self):
+        progress = True
+        while progress:
+            progress = False
+            for run in list(self.pending):
+                if self._integrate(run):
+                    self.pending.remove(run)
+                    progress = True
+        # deletes are idempotent: re-apply until the range is known (a
+        # delete may target structs that were pending when it arrived)
+        for entry in list(self.pending_deletes):
+            c, k, length = entry
+            self._apply_delete(c, k, length)
+            if self.known.get(c, 0) >= k + length:
+                self.pending_deletes.remove(entry)
+
+    def text(self) -> str:
+        return "".join(chr(u.ch) for u in self.units if not u.deleted)
+
+    # -- v1 codec (mirrors decodeUpdateAndApply / encodeRun / full state) ----
+
+    def _apply_update(self, data: bytes):
+        d = Decoder(data)
+        for _ in range(d.read_var_uint()):
+            num = d.read_var_uint()
+            client = d.read_var_uint()
+            clock = d.read_var_uint()
+            for _ in range(num):
+                info = d.read_uint8()
+                ref = info & 0x1F
+                if ref == 0:  # GC occupies its clock range
+                    clock += d.read_var_uint()
+                    if clock > self.known.get(client, 0):
+                        self.known[client] = clock
+                    continue
+                if ref == 10:  # Skip: a hole, not content
+                    clock += d.read_var_uint()
+                    continue
+                oc = ok = rc = rk = None
+                if info & 0x80:
+                    oc, ok = d.read_var_uint(), d.read_var_uint()
+                if info & 0x40:
+                    rc, rk = d.read_var_uint(), d.read_var_uint()
+                if not (info & 0xC0):
+                    if d.read_var_uint() == 1:
+                        d.read_var_string()
+                    else:
+                        d.read_var_uint(), d.read_var_uint()
+                    if info & 0x20:
+                        d.read_var_string()
+                if ref == 4:
+                    text = d.read_var_string()
+                    length = len(text)
+                elif ref == 1:
+                    text, length = None, d.read_var_uint()
+                else:
+                    raise AssertionError(f"unsupported ref {ref}")
+                run = (client, clock, text, length, oc, ok, rc, rk)
+                if not self._integrate(run):
+                    self.pending.append(run)
+                clock += length
+        for _ in range(d.read_var_uint()):
+            client = d.read_var_uint()
+            for _ in range(d.read_var_uint()):
+                k, length = d.read_var_uint(), d.read_var_uint()
+                self._apply_delete(client, k, length)
+                if self.known.get(client, 0) < k + length:
+                    self.pending_deletes.append((client, k, length))
+        self._drain_pending()
+
+    @staticmethod
+    def _encode_run(e: Encoder, run):
+        c, k, text, _length, oc, ok, rc, rk = run
+        e.write_var_uint(1)
+        e.write_var_uint(1)
+        e.write_var_uint(c)
+        e.write_var_uint(k)
+        info = 0x04 | (0x80 if oc is not None else 0) | (0x40 if rc is not None else 0)
+        e.write_uint8(info)
+        if oc is not None:
+            e.write_var_uint(oc), e.write_var_uint(ok)
+        if rc is not None:
+            e.write_var_uint(rc), e.write_var_uint(rk)
+        if oc is None and rc is None:
+            e.write_var_uint(1)
+            e.write_var_string(ROOT)
+        e.write_var_string(text)
+
+    def _encode_full_state(self, sv: dict) -> bytes:
+        e = Encoder()
+        by: dict[int, list] = {}
+        for u in self.units:
+            if u.k < sv.get(u.c, 0):
+                continue
+            by.setdefault(u.c, []).append(u)
+        e.write_var_uint(len(by))
+        for c in sorted(by, reverse=True):
+            row = sorted(by[c], key=lambda u: u.k)
+            e.write_var_uint(len(row))
+            e.write_var_uint(c)
+            e.write_var_uint(row[0].k)
+            for u in row:
+                info = 0x04 | (0x80 if u.oc is not None else 0)
+                e.write_uint8(info)
+                if u.oc is not None:
+                    e.write_var_uint(u.oc), e.write_var_uint(u.ok)
+                else:
+                    e.write_var_uint(1)
+                    e.write_var_string(ROOT)
+                e.write_var_string(chr(u.ch))
+        ds: dict[int, list] = {}
+        for u in self.units:
+            if u.deleted:
+                ds.setdefault(u.c, []).append(u.k)
+        e.write_var_uint(len(ds))
+        for c in sorted(ds, reverse=True):
+            ks = sorted(ds[c])
+            ranges = []
+            for k in ks:
+                if ranges and ranges[-1][0] + ranges[-1][1] == k:
+                    ranges[-1][1] += 1
+                else:
+                    ranges.append([k, 1])
+            e.write_var_uint(c)
+            e.write_var_uint(len(ranges))
+            for k, l in ranges:
+                e.write_var_uint(k), e.write_var_uint(l)
+        return e.to_bytes()
+
+    # -- frames + socket -----------------------------------------------------
+
+    def _frame(self, msg_type: int, payload: bytes = b"") -> bytes:
+        e = Encoder()
+        e.write_var_string(self.doc_name)
+        e.write_var_uint(msg_type)
+        return e.to_bytes() + payload
+
+    async def connect(self, url: str):
+        self._session = aiohttp.ClientSession()
+        self._ws = await self._session.ws_connect(url)
+        auth = Encoder()
+        auth.write_var_uint(0)
+        auth.write_var_string("browser-demo")
+        await self._ws.send_bytes(self._frame(MSG_AUTH, auth.to_bytes()))
+        step1 = Encoder()
+        step1.write_var_uint(STEP1)
+        step1.write_var_uint8_array(b"\x00")  # empty state vector
+        await self._ws.send_bytes(self._frame(MSG_SYNC, step1.to_bytes()))
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def _read_loop(self):
+        async for msg in self._ws:
+            if msg.type != aiohttp.WSMsgType.BINARY:
+                continue
+            d = Decoder(msg.data)
+            d.read_var_string()
+            msg_type = d.read_var_uint()
+            if msg_type in (MSG_SYNC, MSG_SYNC_REPLY):
+                sub = d.read_var_uint()
+                if sub == STEP1:
+                    sv_reader = Decoder(d.read_var_uint8_array())
+                    sv = {}
+                    for _ in range(sv_reader.read_var_uint()):
+                        client = sv_reader.read_var_uint()
+                        sv[client] = sv_reader.read_var_uint()
+                    reply = Encoder()
+                    reply.write_var_uint(STEP2)
+                    reply.write_var_uint8_array(self._encode_full_state(sv))
+                    await self._ws.send_bytes(
+                        self._frame(MSG_SYNC_REPLY, reply.to_bytes())
+                    )
+                elif sub in (STEP2, UPDATE):
+                    self._apply_update(bytes(d.read_var_uint8_array()))
+                    if sub == STEP2:
+                        self.synced = True
+
+    async def insert(self, pos: int, text: str):
+        """Insert at VISIBLE position pos, like the page's splice diff."""
+        visible = [u for u in self.units if not u.deleted]
+        left = visible[pos - 1] if pos > 0 else None
+        right = visible[pos] if pos < len(visible) else None
+        run = (
+            self.client_id,
+            self.clock,
+            text,
+            len(text),
+            left.c if left else None,
+            left.k if left else 0,
+            right.c if right else None,
+            right.k if right else 0,
+        )
+        self.clock += len(text)
+        assert self._integrate(run)
+        e = Encoder()
+        e.write_var_uint(UPDATE)
+        body = Encoder()
+        self._encode_run(body, run)
+        body.write_var_uint(0)  # trailing (empty) delete set
+        e.write_var_uint8_array(body.to_bytes())
+        await self._ws.send_bytes(self._frame(MSG_SYNC, e.to_bytes()))
+
+    async def delete(self, pos: int, length: int):
+        visible = [u for u in self.units if not u.deleted]
+        doomed = visible[pos : pos + length]
+        for u in doomed:
+            u.deleted = True
+        doomed.sort(key=lambda u: (u.c, u.k))
+        i = 0
+        while i < len(doomed):
+            j = i + 1
+            while (
+                j < len(doomed)
+                and doomed[j].c == doomed[i].c
+                and doomed[j].k == doomed[j - 1].k + 1
+            ):
+                j += 1
+            e = Encoder()
+            e.write_var_uint(UPDATE)
+            body = Encoder()
+            body.write_var_uint(0)  # no struct sections
+            body.write_var_uint(1)
+            body.write_var_uint(doomed[i].c)
+            body.write_var_uint(1)
+            body.write_var_uint(doomed[i].k)
+            body.write_var_uint(j - i)
+            e.write_var_uint8_array(body.to_bytes())
+            await self._ws.send_bytes(self._frame(MSG_SYNC, e.to_bytes()))
+            i = j
+
+    async def close(self):
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._ws is not None:
+            await self._ws.close()
+        if self._session is not None:
+            await self._session.close()
+
+
+async def test_browser_client_converges_with_provider():
+    server = await new_hocuspocus()
+    browser = BrowserMirrorClient()
+    provider = new_provider(server, name="browser-demo")
+    try:
+        await wait_synced(provider)
+        await browser.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(browser.synced))
+
+        await browser.insert(0, "from the browser ")
+        await retryable_assertion(
+            lambda: _assert(
+                provider.document.get_text(ROOT).to_string() == "from the browser "
+            )
+        )
+        provider.document.get_text(ROOT).insert(0, "provider says: ")
+        await retryable_assertion(
+            lambda: _assert(
+                browser.text() == provider.document.get_text(ROOT).to_string()
+            )
+        )
+        # browser-side delete (ds-only update) propagates
+        await browser.delete(0, len("provider says: "))
+        await retryable_assertion(
+            lambda: _assert(
+                provider.document.get_text(ROOT).to_string() == "from the browser "
+                and browser.text() == "from the browser "
+            )
+        )
+    finally:
+        await browser.close()
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_two_browser_tabs_sync_through_server():
+    """The demo's headline: two 'tabs' converge through the server."""
+    server = await new_hocuspocus()
+    tab_a = BrowserMirrorClient()
+    tab_b = BrowserMirrorClient()
+    try:
+        await tab_a.connect(server.web_socket_url)
+        await tab_b.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(tab_a.synced and tab_b.synced))
+        await tab_a.insert(0, "hello ")
+        await retryable_assertion(lambda: _assert(tab_b.text() == "hello "))
+        await tab_b.insert(6, "world")
+        await retryable_assertion(
+            lambda: _assert(tab_a.text() == tab_b.text() == "hello world")
+        )
+        # concurrent same-position inserts resolve identically (YATA)
+        await asyncio.gather(tab_a.insert(5, "A"), tab_b.insert(5, "B"))
+        await retryable_assertion(
+            lambda: _assert(
+                tab_a.text() == tab_b.text() and len(tab_a.text()) == 13
+            )
+        )
+    finally:
+        await tab_a.close()
+        await tab_b.close()
+        await server.destroy()
+
+
+async def test_late_browser_tab_cold_syncs_server_state():
+    server = await new_hocuspocus()
+    provider = new_provider(server, name="browser-demo")
+    late = BrowserMirrorClient()
+    try:
+        await wait_synced(provider)
+        text = provider.document.get_text(ROOT)
+        text.insert(0, "existing state with emoji-free text")
+        text.delete(0, 9)
+        await late.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(late.synced))
+        await retryable_assertion(
+            lambda: _assert(late.text() == text.to_string())
+        )
+    finally:
+        await late.close()
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_cold_sync_with_cross_section_delete_and_tombstones():
+    """Regression for the review findings: a SyncStep2 whose sections
+    are client-id-DESCENDING can carry (a) a high-client run whose
+    origin lives in a later (lower-client) section — it goes pending —
+    and (b) a delete set targeting those pending clocks. The delete
+    must still land once the run integrates."""
+    server = await new_hocuspocus()
+    high = BrowserMirrorClient()
+    low = BrowserMirrorClient()
+    # force the ordering: high client id > low client id
+    high.client_id = 0xFFFFFF0
+    low.client_id = 0x10
+    late = BrowserMirrorClient()
+    try:
+        await low.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(low.synced))
+        await low.insert(0, "base")
+        await high.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(high.synced and high.text() == "base"))
+        await high.insert(4, "XY")  # origin = low's last unit
+        await retryable_assertion(lambda: _assert(low.text() == "baseXY"))
+        await high.delete(4, 2)  # tombstone high's own units
+        await retryable_assertion(lambda: _assert(low.text() == "base"))
+
+        # a COLD joiner receives everything in one SyncStep2 (sections
+        # sorted client-descending: high's structs before low's)
+        await late.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(late.synced))
+        await retryable_assertion(lambda: _assert(late.text() == "base"))
+        assert not late.pending, "high-client run stuck in pending"
+        assert not late.pending_deletes, "delete never resolved"
+    finally:
+        for c in (high, low, late):
+            await c.close()
+        await server.destroy()
