@@ -1,0 +1,40 @@
+#!/bin/bash
+# Spec-scale BASELINE config runs (VERDICT r3 item 4). Invoked by
+# tpu_watch.sh when the TPU tunnel is alive; appends one JSON line per
+# config to benchmarks/results/configs_tpu_<stamp>.jsonl.
+#
+# Scales vs BASELINE.md:
+#   config2: 1,000 docs x 10 clients  (spec)
+#   config3: 10,000 ProseMirror docs  (spec for the transform sweep;
+#            server slice at 64 docs)
+#   config4: 4,096 mixed docs x 2 instances over mini-redis — the
+#            spec's 100k docs would need ~200k sockets (fd limit:
+#            20,000); this is 400x the round-3 capture and the largest
+#            socket-feasible width in one process
+#   config5: 1,000,000 cold device docs (spec)
+cd /root/repo
+STAMP=${1:-$(date -u +%Y%m%dT%H%M%SZ)}
+OUT=benchmarks/results/configs_tpu_${STAMP}.jsonl
+LOG=benchmarks/results/tpu_watch.log
+echo "[configs] start $(date -u +%FT%TZ) -> $OUT" >> "$LOG"
+
+run_cfg() {
+  local name=$1 budget=$2; shift 2
+  if timeout -k 30 "$budget" "$@" >> "$OUT" 2>> "$LOG"; then
+    echo "[configs] $name ok" >> "$LOG"
+  else
+    echo "{\"metric\": \"$name\", \"error\": \"failed or timed out (budget ${budget}s)\"}" >> "$OUT"
+    echo "[configs] $name FAILED" >> "$LOG"
+  fi
+}
+
+run_cfg config1 900  python benchmarks/config1_single_doc_sqlite.py
+C2_DOCS=1000 C2_CLIENTS_PER_DOC=10 C2_SECONDS=10 \
+  run_cfg config2 2400 python benchmarks/config2_many_docs_load.py
+C3_DOCS=10000 C3_SERVER_DOCS=64 \
+  run_cfg config3 2400 python benchmarks/config3_prosemirror_transform.py
+C4_DOCS=4096 C4_SECONDS=10 \
+  run_cfg config4 2400 python benchmarks/config4_redis_fanout.py
+C5_DOCS=1000000 \
+  run_cfg config5 1800 python benchmarks/config5_catchup_storm.py
+echo "[configs] done $(date -u +%FT%TZ)" >> "$LOG"

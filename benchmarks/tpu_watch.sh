@@ -9,6 +9,7 @@
 cd /root/repo
 LOG=benchmarks/results/tpu_watch.log
 echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
+rm -f benchmarks/results/CONFIGS_DONE  # fresh session, fresh sweep
 while true; do
   if timeout -k 10 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'; import jax.numpy as jnp; x=jnp.ones((256,256),jnp.bfloat16); (x@x).block_until_ready()" 2>>"$LOG"; then
     STAMP=$(date -u +%Y%m%dT%H%M%SZ)
@@ -23,6 +24,11 @@ while true; do
          && ! grep -q '"stale_capture": true' "benchmarks/results/bench_tpu_watch_${STAMP}.json"; then
         cp "benchmarks/results/bench_tpu_watch_${STAMP}.json" benchmarks/results/bench_tpu_latest.json
         echo "[watch] promoted to bench_tpu_latest.json" >> "$LOG"
+        # once per watch session: the spec-scale BASELINE config sweep
+        if [ ! -f benchmarks/results/CONFIGS_DONE ]; then
+          touch benchmarks/results/CONFIGS_DONE
+          bash benchmarks/run_configs.sh "$STAMP"
+        fi
       fi
     else
       echo "[watch] bench run failed/timed out" >> "$LOG"

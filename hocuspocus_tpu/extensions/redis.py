@@ -300,7 +300,12 @@ class Redis(Extension):
         if data.transaction_origin == self.redis_transaction_origin:
             return
         document = data.document
-        if getattr(document, "broadcast_source", None) is not None:
+        source = getattr(document, "broadcast_source", None)
+        capturing = source is not None and (
+            not hasattr(source, "is_capturing")
+            or source.is_capturing(data.document_name)
+        )
+        if capturing:
             # plane-served: steady propagation rides the window frames
             # (on_plane_broadcast); keep a LOW-RATE SyncStep1 exchange
             # per doc as anti-entropy so a dropped pub/sub message heals

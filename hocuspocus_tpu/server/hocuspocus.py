@@ -172,6 +172,13 @@ class Hocuspocus:
             if not document.is_loading and self.debouncer.is_debounced(debounce_id):
                 if self.configuration.unload_immediately:
                     self.debouncer.execute_now(debounce_id)
+            elif self.debouncer.in_flight(debounce_id) or document.save_mutex.locked():
+                # a fired store task is scheduled/running but hasn't
+                # completed: unloading NOW would drop the doc from the
+                # registry before its state hits storage (a fast rejoin
+                # would then load an empty doc). The store task's own
+                # finally unloads once it finishes.
+                pass
             else:
                 asyncio.ensure_future(self.unload_document(document))
 

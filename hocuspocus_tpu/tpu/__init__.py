@@ -14,5 +14,6 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
         pass
 
 from .merge_plane import MergePlane, TpuMergeExtension
+from .sharded_extension import ShardedTpuMergeExtension
 
-__all__ = ["MergePlane", "TpuMergeExtension"]
+__all__ = ["MergePlane", "ShardedTpuMergeExtension", "TpuMergeExtension"]

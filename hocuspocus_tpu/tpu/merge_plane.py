@@ -1195,7 +1195,12 @@ class TpuMergeExtension(Extension):
                     self._detach_serving(name, self._docs.pop(name, None))
                     self.plane.release(name)
                     # a future incarnation starts with a fresh recycle
-                    # budget (its live state may be much smaller)
+                    # budget (its live state may be much smaller).
+                    # _lane_banned is deliberately NOT cleared: a doc
+                    # that demoted carries rich content in its stored
+                    # state — re-trying the lane on every reload would
+                    # re-pay the demote transient (degraded cross-
+                    # instance flow while the rebuild lands) each time.
                     self._recycle_declined.discard(name)
                     return
             # A re-load is in flight. Wait for it OUTSIDE the lock: on
@@ -1229,6 +1234,19 @@ class TpuMergeExtension(Extension):
         await self._flush_now(max_batches=None)
 
     # -- serving: update capture (called by Document._handle_update) ---------
+
+    def is_capturing(self, name: str) -> bool:
+        """True when this doc's updates actually ride plane windows
+        right now. False during degrade/demote windows, where updates
+        take the per-update CPU fan-out — consumers that suppress
+        per-op propagation in favor of window frames (the Redis
+        extension's cross-instance publish) must fall back to per-op
+        when this is False, or remote peers starve down to
+        anti-entropy rates."""
+        if name not in self._docs:
+            return False
+        doc = self.plane.docs.get(name)
+        return doc is not None and not doc.retired
 
     def try_capture(self, document, update: bytes, origin) -> bool:
         """Claim an update for plane-batched broadcast. False = CPU fan-out."""

@@ -1,0 +1,82 @@
+"""Doc-partitioned merge plane: N independent planes on one chip.
+
+The integrate kernel's microbatch latency scales with the ARENA WIDTH
+it sweeps — at the 100k-doc regime one monolithic plane pays a
+full-population pass per flush (round-3 capture: 226 ms p99 vs the
+50 ms budget). Documents never interact (SURVEY.md §2.2: doc axis is
+the data-parallel dimension), so the product fix is the same move the
+reference prescribes for scale-out — "split users by a document
+identifier" (`docs/guides/scalability.md`) — applied INSIDE one
+process: a router extension hashing each document onto one of N
+`TpuMergeExtension` shards, each with its own plane, flush pipeline
+and broadcast timers. A microbatch then sweeps one shard's arena
+(population/N docs), pipelining naturally across shards because every
+shard flushes on its own schedule.
+
+This composes with everything the single-plane extension does (native
+text lane, RLE arena, serving, recycling): the shard is a full
+TpuMergeExtension; the router only dispatches hooks by name hash.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+from ..server.types import Extension, Payload
+from .merge_plane import TpuMergeExtension
+
+
+class ShardedTpuMergeExtension(Extension):
+    """Routes per-document hooks to one of N TpuMergeExtension shards."""
+
+    priority = 900
+
+    def __init__(self, shards: int = 4, **extension_kwargs) -> None:
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        self.shards = [TpuMergeExtension(**extension_kwargs) for _ in range(shards)]
+
+    def shard_for(self, document_name: str) -> TpuMergeExtension:
+        digest = zlib.crc32(document_name.encode("utf-8"))
+        return self.shards[digest % len(self.shards)]
+
+    # -- lifecycle hooks (broadcast) ---------------------------------------
+
+    async def on_listen(self, data: Payload) -> None:
+        for shard in self.shards:
+            await shard.on_listen(data)
+
+    async def on_destroy(self, data: Payload) -> None:
+        for shard in self.shards:
+            await shard.on_destroy(data)
+
+    # -- per-document hooks (routed) ---------------------------------------
+
+    async def after_load_document(self, data: Payload) -> None:
+        await self.shard_for(data.document_name).after_load_document(data)
+
+    async def on_change(self, data: Payload) -> None:
+        await self.shard_for(data.document_name).on_change(data)
+
+    async def after_unload_document(self, data: Payload) -> None:
+        await self.shard_for(data.document_name).after_unload_document(data)
+
+    # -- aggregate observability -------------------------------------------
+
+    @property
+    def counters(self) -> dict:
+        total: dict = {}
+        for shard in self.shards:
+            for key, value in shard.plane.counters.items():
+                total[key] = total.get(key, 0) + value
+        return total
+
+    def served_docs(self) -> int:
+        return sum(len(shard._docs) for shard in self.shards)
+
+    def pending_ops(self) -> int:
+        return sum(shard.plane.pending_ops() for shard in self.shards)
+
+    def is_served(self, document_name: str) -> bool:
+        return document_name in self.shard_for(document_name)._docs

@@ -290,7 +290,10 @@ async def test_tpu_serve_mode_with_redis_fanout_production_topology():
         # sustained traffic propagates via the coalesced WINDOW frames,
         # not per-op SyncStep1 round trips: many ops cross with only
         # anti-entropy-level sync chatter (rate-limited to ~1 per
-        # plane_anti_entropy_seconds per doc, not per op)
+        # plane_anti_entropy_seconds per doc, not per op). Measured as
+        # a DELTA over this window: the mixed-content start legitimately
+        # used per-op sync fallback while the lane demote/rebuild ran.
+        serves_at_window_start = ext_b.plane.counters["sync_serves"]
         text_a = provider_a.document.get_text("t")
         for i in range(30):
             text_a.insert(0, f"w{i};")
@@ -301,7 +304,9 @@ async def test_tpu_serve_mode_with_redis_fanout_production_topology():
                 == provider_a.document.get_text("t").to_string()
             )
         )
-        _assert(ext_b.plane.counters["sync_serves"] <= 10)
+        _assert(
+            ext_b.plane.counters["sync_serves"] - serves_at_window_start <= 10
+        )
 
         # a late joiner on B syncs the merged state from B's plane
         serves_before = ext_b.plane.counters["sync_serves"]
