@@ -188,6 +188,7 @@ def main() -> None:
     if onchip is not None:
         os.environ.setdefault("BENCH_SERVER_P99", "0")
         os.environ.setdefault("BENCH_CATCHUP", "0")
+        os.environ.setdefault("BENCH_RLE", "0")
     cpu_smoke = None
     for attempt in range(2):
         cpu_smoke = _run_inner("cpu")
