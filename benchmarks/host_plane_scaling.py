@@ -87,16 +87,20 @@ def main() -> None:
         serving.refresh()
         health.append(time.perf_counter() - t0)
 
-        # mirrors the production dirty-drain: per-doc health check
-        # before each frame build (merge_plane._broadcast_served)
+        # mirrors the production dirty-drain: per-doc health check then
+        # the BATCHED window build (merge_plane._broadcast_served /
+        # serving.build_broadcast_pairs — lane docs drain in one native
+        # call)
         t0 = time.perf_counter()
-        made = 0
-        for name in list(plane.dirty):
-            plane.dirty.discard(name)
-            if serving.doc_healthy(name) is None:
-                continue
-            if serving.build_broadcast_pair(name) is not None:
-                made += 1
+        dirty = list(plane.dirty)
+        plane.dirty.clear()
+        healthy, suspects = serving.filter_healthy(dirty)
+        healthy.extend(
+            name for name in suspects if serving.doc_healthy(name) is not None
+        )
+        pairs, failed = serving.build_broadcast_pairs(healthy)
+        assert not failed, failed
+        made = sum(1 for _name, pair in pairs if pair)
         bcast.append(time.perf_counter() - t0)
         assert made == num_docs, made
         # fresh clocks for the next round's delta

@@ -1506,12 +1506,29 @@ class TpuMergeExtension(Extension):
         converge by CRDT idempotence either way)."""
         if not self.serve:
             return
-        dirty = list(self.plane.dirty)
-        self.plane.dirty.clear()
+        plane = self.plane
+        dirty = list(plane.dirty)
+        plane.dirty.clear()
+        docs_by_name: dict = {}
+        served_dirty: list = []
         for name in dirty:
             document = self._docs.get(name)
-            if document is None:
-                continue
+            if document is not None:
+                docs_by_name[name] = document
+                served_dirty.append(name)
+        # one vectorized health compare covers the common case; only
+        # suspects pay the per-doc check (which retires on failure)
+        try:
+            healthy, suspects = self.serving.filter_healthy(served_dirty)
+        except Exception:
+            from ..server import logger as _logger_mod
+
+            _logger_mod.log_error(
+                "vectorized health filter failed; falling back to per-doc checks"
+            )
+            healthy, suspects = [], served_dirty
+        for name in suspects:
+            document = docs_by_name[name]
             # per-doc guard: the stated safety model is "any serving
             # error degrades that doc to the CPU path" — an exception
             # here must neither strand this doc's ops nor skip the
@@ -1520,40 +1537,66 @@ class TpuMergeExtension(Extension):
                 if self.serving.doc_healthy(name) is None:
                     self._fallback_to_cpu(document)
                     continue
-                pair = self.serving.build_broadcast_pair(name)
-                if pair is not None:
-                    update, cross_update = pair
-                    document.broadcast_update_frame(update)
-                    if (
-                        cross_instance
-                        and cross_update is not None
-                        and self._instance is not None
-                    ):
-                        # cross-instance fan-out rides the merged window
-                        # frame (extensions like Redis publish it) minus
-                        # remote-origin ops, replacing per-op SyncStep1
-                        # chatter with one coalesced message per window
-                        self._spawn_tracked(
-                            self._instance.hooks(
-                                "on_plane_broadcast",
-                                Payload(
-                                    instance=self._instance,
-                                    document_name=name,
-                                    document=document,
-                                    update=cross_update,
-                                ),
-                            )
-                        )
             except Exception:
-                from ..server import logger as _logger_mod
+                self._degrade_one(name, document)
+                continue
+            healthy.append(name)
+        if not healthy:
+            return
+        try:
+            # lane docs inside resolve in ONE batched native call — the
+            # per-doc Python overhead dominates at 10k-doc window widths;
+            # Python-path docs are isolated per doc inside (failed list)
+            pairs, failed = self.serving.build_broadcast_pairs(healthy)
+        except Exception:
+            # only the batch call itself can land here (per-doc failures
+            # come back in `failed`): a plane-level fault, so degrading
+            # the set is the honest outcome
+            for name in healthy:
+                self._degrade_one(name, docs_by_name[name])
+            return
+        for name in failed:
+            self._degrade_one(name, docs_by_name[name])
+        for name, pair in pairs:
+            document = docs_by_name[name]
+            try:
+                if pair is None:
+                    continue
+                update, cross_update = pair
+                document.broadcast_update_frame(update)
+                if (
+                    cross_instance
+                    and cross_update is not None
+                    and self._instance is not None
+                ):
+                    # cross-instance fan-out rides the merged window
+                    # frame (extensions like Redis publish it) minus
+                    # remote-origin ops, replacing per-op SyncStep1
+                    # chatter with one coalesced message per window
+                    self._spawn_tracked(
+                        self._instance.hooks(
+                            "on_plane_broadcast",
+                            Payload(
+                                instance=self._instance,
+                                document_name=name,
+                                document=document,
+                                update=cross_update,
+                            ),
+                        )
+                    )
+            except Exception:
+                self._degrade_one(name, document)
 
-                _logger_mod.log_error(
-                    f"plane broadcast failed for {name!r}; degrading to CPU path"
-                )
-                try:
-                    self._fallback_to_cpu(document)
-                except Exception:
-                    _logger_mod.log_error(f"CPU fallback failed for {name!r}")
+    def _degrade_one(self, name: str, document) -> None:
+        from ..server import logger as _logger_mod
+
+        _logger_mod.log_error(
+            f"plane broadcast failed for {name!r}; degrading to CPU path"
+        )
+        try:
+            self._fallback_to_cpu(document)
+        except Exception:
+            _logger_mod.log_error(f"CPU fallback failed for {name!r}")
 
     async def _flush_now(self, max_batches: Optional[int] = 1) -> None:
         """Flush+serve with the DEVICE step off the event loop.

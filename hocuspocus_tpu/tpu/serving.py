@@ -188,6 +188,54 @@ class PlaneServing:
             return None
         return doc
 
+    def filter_healthy(self, names: "list[str]") -> "tuple[list[str], list[str]]":
+        """(fast_ok, needs_check): one vectorized compare replaces the
+        per-doc health loop for the common case (registered, supported,
+        single-row doc whose cached device row matches its validated
+        tally). A STALE-generation row fast-OKs — check_doc_health
+        skips such slots too (the snapshot predates the binding; the
+        next consistent snapshot covers it). needs_check gets the
+        genuinely suspicious cases — unregistered, unsupported,
+        mismatching current-generation row, multi-row trees, no
+        snapshot yet — for the full doc_healthy treatment (which also
+        performs the retire-on-failure side effects)."""
+        plane = self.plane
+        if self._length_cache is None or self._gen_cache is None:
+            return [], list(names)
+        candidates: list[str] = []
+        slots: list[int] = []
+        needs_check: list[str] = []
+        for name in names:
+            doc = plane.docs.get(name)
+            if doc is None or doc.lowerer.unsupported:
+                needs_check.append(name)
+                continue
+            doc_slots = list(doc.seqs.values())
+            if len(doc_slots) == 0:
+                candidates.append(name)
+                slots.append(-1)
+            elif len(doc_slots) == 1:
+                candidates.append(name)
+                slots.append(doc_slots[0])
+            else:
+                needs_check.append(name)  # multi-row trees: full check
+        if not candidates:
+            return [], needs_check
+        arr = np.asarray(slots, np.int64)
+        rowless = arr < 0
+        safe = np.where(rowless, 0, arr)
+        gen_current = self._gen_cache[safe] == plane.slot_gen[safe]
+        mismatch = (
+            (self._validated_cache[safe] != self._length_cache[safe])
+            | self._overflow_cache[safe]
+        )
+        ok = rowless | ~gen_current | ~mismatch
+        fast_ok = [name for name, good in zip(candidates, ok) if good]
+        needs_check.extend(
+            name for name, good in zip(candidates, ok) if not good
+        )
+        return fast_ok, needs_check
+
     def covers(self, name: str, document) -> bool:
         """Plane has integrated everything the CPU document has seen."""
         doc = self.plane.docs.get(name)
@@ -764,6 +812,45 @@ class PlaneServing:
         window_ds.sort_and_merge()
         window_ds.write(encoder)
         return encoder.to_bytes()
+
+    def build_broadcast_pairs(
+        self, names: "list[str]"
+    ) -> "tuple[list[tuple[str, Optional[tuple[bytes, Optional[bytes]]]]], list[str]]":
+        """Batched window drain -> (pairs, failed_names).
+
+        Lane docs resolve in ONE native call (the per-doc Python
+        overhead dominates at 10k-doc widths; a missing slot yields a
+        None entry, not an exception), Python-path docs fall back to
+        build_broadcast_pair each — WITH per-doc isolation: one doc's
+        encode failure lands it in failed_names instead of aborting
+        the other 10k docs' windows."""
+        plane = self.plane
+        out: list = []
+        failed: list[str] = []
+        lane_names: list = []
+        lane_args: list = []
+        for name in names:
+            doc = plane.docs.get(name)
+            if doc is not None and doc.lane_slot is not None and plane._lane is not None:
+                lane_names.append(name)
+                lane_args.append(
+                    (doc.lane_slot, self.broadcast_cursor.get(name, 0))
+                )
+            else:
+                try:
+                    out.append((name, self.build_broadcast_pair(name)))
+                except Exception:
+                    failed.append(name)
+        if lane_args:
+            results = plane._lane_codec.lane_windows_batch(plane._lane, lane_args)
+            for name, (full, cross, new_idx) in zip(lane_names, results):
+                self.broadcast_cursor[name] = new_idx
+                if full is None:
+                    out.append((name, None))
+                else:
+                    plane.counters["plane_broadcasts"] += 1
+                    out.append((name, (full, cross)))
+        return out, failed
 
     def build_broadcast_pair(
         self, name: str
