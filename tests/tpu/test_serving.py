@@ -601,3 +601,46 @@ async def test_stale_cutoff_mid_surrogate_pair_widens_by_one_unit():
     sm = {9: 4}  # clean boundary inside the second record: untouched
     serving._widen_surrogate_cutoffs(records, sm)
     assert sm == {9: 4}
+
+
+async def test_filter_healthy_vectorized_matches_per_doc_semantics():
+    """The batched drain's fast health path must flag exactly what
+    check_doc_health would: healthy current rows fast-OK, a forced
+    desync lands in needs_check (and doc_healthy then retires it),
+    stale-generation rows fast-OK (snapshot predates the binding)."""
+    from hocuspocus_tpu.crdt import Doc, encode_state_as_update
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+    from hocuspocus_tpu.tpu.serving import PlaneServing
+
+    plane = MergePlane(num_docs=8, capacity=256)
+    serving = PlaneServing(plane)
+    names = [f"d{i}" for i in range(4)]
+    for i, name in enumerate(names):
+        src = Doc()
+        src.client_id = 100 + i
+        src.get_text("t").insert(0, f"content {i}")
+        plane.register(name)
+        plane.enqueue_update(name, encode_state_as_update(src))
+    plane.flush()
+    serving.refresh()
+
+    fast_ok, needs_check = serving.filter_healthy(names)
+    assert sorted(fast_ok) == sorted(names)
+    assert needs_check == []
+
+    # force a desync on one doc: validated tally drifts from the row
+    bad_slot = plane.docs["d1"].seqs[("root", "t")]
+    plane.validated_units[bad_slot] += 5
+    serving.refresh()
+    fast_ok, needs_check = serving.filter_healthy(names)
+    assert "d1" in needs_check and "d1" not in fast_ok
+    assert sorted(fast_ok + needs_check) == sorted(names)
+    assert serving.doc_healthy("d1") is None  # retires via the full path
+    assert plane.docs["d1"].retire_reason == "desync"
+
+    # stale generation: bump a slot's binding gen after the snapshot —
+    # the cached row describes the previous tenant, so it fast-OKs
+    slot2 = plane.docs["d2"].seqs[("root", "t")]
+    plane.slot_gen[slot2] += 1
+    fast_ok, needs_check = serving.filter_healthy(["d2"])
+    assert fast_ok == ["d2"]
