@@ -722,8 +722,12 @@ def _measure_catchup_serving() -> dict:
     snapshot = encode_state_as_update(source)
 
     plane = MergePlane(num_docs=num_docs, capacity=8192)
+    use_lane = os.environ.get("BENCH_CATCHUP_LANE", "1") != "0" and plane.enable_lane()
     for d in range(num_docs):
-        plane.register(f"cold-{d}")
+        if use_lane:
+            plane.register_lane(f"cold-{d}")
+        else:
+            plane.register(f"cold-{d}")
         plane.enqueue_update(f"cold-{d}", snapshot)
     plane.flush()
     serving = PlaneServing(plane)
@@ -757,6 +761,7 @@ def _measure_catchup_serving() -> dict:
     elapsed = time.perf_counter() - start
     return {
         "catchups_per_sec": round(done / elapsed, 1) if done else 0.0,
+        "native_lane": bool(use_lane),
         "docs": num_docs,
         "serves": done,
         "cold_serves": cold,

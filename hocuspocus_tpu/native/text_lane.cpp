@@ -524,6 +524,57 @@ constexpr uint8_t CONTENT_STRING_REF = 4;
 constexpr uint8_t CONTENT_DELETED_REF = 1;
 constexpr uint8_t STRUCT_GC_REF = 0;
 
+// emit one struct entry (GC ref / info byte / origins / root parent /
+// payload), sliced by `offset` units for the first item of a cutoff
+// group (offset 0 = the broadcast-window case). Shared by
+// encode_window and lane_window_sm so the two paths can't diverge
+// byte-wise. Returns false only for a rootless origin-less item.
+bool emit_struct_entry(const SlotLane& lane, const LaneOp& op, int64_t offset,
+                       std::string& out) {
+    if (op.flags & F_GC) {
+        out.push_back(static_cast<char>(STRUCT_GC_REF));
+        put_var_uint(out, static_cast<uint64_t>(op.run_len - offset));
+        return true;
+    }
+    uint8_t info = (op.flags & F_DELETED_CONTENT) ? CONTENT_DELETED_REF
+                                                  : CONTENT_STRING_REF;
+    uint32_t oc = op.left_client;
+    int64_t ok = op.left_clock;
+    if (offset > 0) {
+        // emitting a tail: its origin is the unit just before the cut
+        // (Item.write offset semantics)
+        oc = op.client;
+        ok = op.clock + offset - 1;
+    }
+    bool has_o = oc != NONE_CLIENT;
+    bool has_r = op.right_client != NONE_CLIENT;
+    if (has_o) info |= BIT_ORIGIN;
+    if (has_r) info |= BIT_RIGHT_ORIGIN;
+    out.push_back(static_cast<char>(info));
+    if (has_o) {
+        put_var_uint(out, oc);
+        put_var_uint(out, static_cast<uint64_t>(ok));
+    }
+    if (has_r) {
+        put_var_uint(out, op.right_client);
+        put_var_uint(out, static_cast<uint64_t>(op.right_clock));
+    }
+    if (!has_o && !has_r) {
+        if (!lane.root_known) return false;
+        put_var_uint(out, 1);
+        put_var_string(out, lane.root.data(), lane.root.size());
+    }
+    if (op.flags & F_DELETED_CONTENT) {
+        put_var_uint(out, static_cast<uint64_t>(op.run_len - offset));
+    } else {
+        std::string payload;
+        utf16_to_utf8(lane.units.data() + op.unit_off + offset,
+                      static_cast<size_t>(op.run_len - offset), payload);
+        put_var_string(out, payload.data(), payload.size());
+    }
+    return true;
+}
+
 // encode one window (indices into lane.ops) as update bytes;
 // byte-identical to serving._encode_window + DeleteSet.write
 bool encode_window(const SlotLane& lane, const std::vector<uint32_t>& recs,
@@ -553,41 +604,7 @@ bool encode_window(const SlotLane& lane, const std::vector<uint32_t>& recs,
         put_var_uint(out, client);
         put_var_uint(out, static_cast<uint64_t>(lane.ops[idxs[0]].clock));
         for (uint32_t idx : idxs) {
-            const LaneOp& op = lane.ops[idx];
-            if (op.flags & F_GC) {
-                out.push_back(static_cast<char>(STRUCT_GC_REF));
-                put_var_uint(out, static_cast<uint64_t>(op.run_len));
-                continue;
-            }
-            uint8_t info = (op.flags & F_DELETED_CONTENT)
-                               ? CONTENT_DELETED_REF
-                               : CONTENT_STRING_REF;
-            bool has_o = op.left_client != NONE_CLIENT;
-            bool has_r = op.right_client != NONE_CLIENT;
-            if (has_o) info |= BIT_ORIGIN;
-            if (has_r) info |= BIT_RIGHT_ORIGIN;
-            out.push_back(static_cast<char>(info));
-            if (has_o) {
-                put_var_uint(out, op.left_client);
-                put_var_uint(out, static_cast<uint64_t>(op.left_clock));
-            }
-            if (has_r) {
-                put_var_uint(out, op.right_client);
-                put_var_uint(out, static_cast<uint64_t>(op.right_clock));
-            }
-            if (!has_o && !has_r) {
-                if (!lane.root_known) return false;
-                put_var_uint(out, 1);
-                put_var_string(out, lane.root.data(), lane.root.size());
-            }
-            if (op.flags & F_DELETED_CONTENT) {
-                put_var_uint(out, static_cast<uint64_t>(op.run_len));
-            } else {
-                std::string payload;
-                utf16_to_utf8(lane.units.data() + op.unit_off,
-                              static_cast<size_t>(op.run_len), payload);
-                put_var_string(out, payload.data(), payload.size());
-            }
+            if (!emit_struct_entry(lane, lane.ops[idx], 0, out)) return false;
         }
     }
     // window delete set: sorted + merged ranges, clients descending
@@ -861,6 +878,161 @@ PyObject* lane_window(PyObject* /*self*/, PyObject* args) {
     return Py_BuildValue("(NNLL)", full_obj, cross_obj, log_len, log_len);
 }
 
+// lane_window_sm(cap, slot, [(client, cutoff), ...]) -> bytes
+// The struct section of a stale/cold SyncStep2 for a lane doc: per-
+// client cutoff trimming, the first emitted item's offset slice with
+// its origin rewrite, and the mid-surrogate-pair cutoff widening — the
+// native mirror of serving._encode_from_sm's struct work (the caller
+// appends the device-tombstone delete set). Clients absent from the
+// map are skipped, matching the Python path.
+PyObject* lane_window_sm(PyObject* /*self*/, PyObject* args) {
+    PyObject* cap;
+    long long slot;
+    PyObject* sm_obj;
+    if (!PyArg_ParseTuple(args, "OLO", &cap, &slot, &sm_obj)) return nullptr;
+    LaneRegistry* reg = registry_of(cap);
+    if (!reg) return nullptr;
+    auto it = reg->slots.find(slot);
+    if (it == reg->slots.end()) {
+        PyErr_SetString(PyExc_KeyError, "lane slot not open");
+        return nullptr;
+    }
+    const SlotLane& lane = it->second;
+    PyObject* sm_items = PySequence_Fast(sm_obj, "expected a sequence");
+    if (!sm_items) return nullptr;
+    std::unordered_map<uint32_t, int64_t> sm;
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(sm_items); i++) {
+        unsigned long long client;
+        long long cutoff;
+        if (!PyArg_ParseTuple(PySequence_Fast_GET_ITEM(sm_items, i), "KL",
+                              &client, &cutoff)) {
+            Py_DECREF(sm_items);
+            return nullptr;
+        }
+        sm[static_cast<uint32_t>(client)] = cutoff;
+    }
+    Py_DECREF(sm_items);
+
+    // mid-surrogate-pair cutoff widening, ONE pass over the log
+    // (serving semantics: the unit AT the cutoff and the one BEFORE it
+    // resolved independently — the pair may span two records)
+    std::unordered_map<uint32_t, uint16_t> at_unit, prev_unit;
+    for (const LaneOp& op : lane.ops) {
+        if (op.kind != KIND_INSERT || (op.flags & F_GC) ||
+            (op.flags & F_DELETED_CONTENT))
+            continue;
+        auto sit = sm.find(op.client);
+        if (sit == sm.end() || sit->second <= 0) continue;
+        int64_t cutoff = sit->second;
+        if (op.clock <= cutoff && cutoff < op.clock + op.run_len)
+            at_unit[op.client] = lane.units[static_cast<size_t>(
+                op.unit_off + (cutoff - op.clock))];
+        if (op.clock <= cutoff - 1 && cutoff - 1 < op.clock + op.run_len)
+            prev_unit[op.client] = lane.units[static_cast<size_t>(
+                op.unit_off + (cutoff - 1 - op.clock))];
+    }
+    for (auto& [client, at] : at_unit) {
+        auto pit = prev_unit.find(client);
+        if (pit != prev_unit.end() && at >= 0xDC00 && at < 0xE000 &&
+            pit->second >= 0xD800 && pit->second < 0xDC00)
+            sm[client] -= 1;
+    }
+
+    // group overlapping insert records by client (descending)
+    std::map<uint32_t, std::vector<uint32_t>, std::greater<uint32_t>> by;
+    for (uint32_t i = 0; i < lane.ops.size(); i++) {
+        const LaneOp& op = lane.ops[i];
+        if (op.kind != KIND_INSERT) continue;
+        auto sit = sm.find(op.client);
+        if (sit == sm.end()) continue;
+        if (op.clock + op.run_len <= sit->second) continue;
+        by[op.client].push_back(i);
+    }
+    std::string out;
+    put_var_uint(out, by.size());
+    for (auto& [client, idxs] : by) {
+        std::stable_sort(idxs.begin(), idxs.end(),
+                         [&](uint32_t a, uint32_t b) {
+                             return lane.ops[a].clock < lane.ops[b].clock;
+                         });
+        int64_t cutoff = sm[client];
+        int64_t write_clock = std::max(cutoff, lane.ops[idxs[0]].clock);
+        put_var_uint(out, idxs.size());
+        put_var_uint(out, client);
+        put_var_uint(out, static_cast<uint64_t>(write_clock));
+        bool first = true;
+        for (uint32_t idx : idxs) {
+            const LaneOp& op = lane.ops[idx];
+            int64_t offset =
+                first ? std::max<int64_t>(write_clock - op.clock, 0) : 0;
+            first = false;
+            if (!emit_struct_entry(lane, op, offset, out)) {
+                PyErr_SetString(PyExc_ValueError, "rootless lane item");
+                return nullptr;
+            }
+        }
+    }
+    return PyBytes_FromStringAndSize(out.data(),
+                                     static_cast<Py_ssize_t>(out.size()));
+}
+
+// lane_covers(cap, slot, [(client, clock), ...]) -> bool
+PyObject* lane_covers(PyObject* /*self*/, PyObject* args) {
+    PyObject* cap;
+    long long slot;
+    PyObject* sv_obj;
+    if (!PyArg_ParseTuple(args, "OLO", &cap, &slot, &sv_obj)) return nullptr;
+    LaneRegistry* reg = registry_of(cap);
+    if (!reg) return nullptr;
+    auto it = reg->slots.find(slot);
+    if (it == reg->slots.end()) Py_RETURN_FALSE;
+    PyObject* items = PySequence_Fast(sv_obj, "expected a sequence");
+    if (!items) return nullptr;
+    bool ok = true;
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(items); i++) {
+        unsigned long long client;
+        long long clock;
+        if (!PyArg_ParseTuple(PySequence_Fast_GET_ITEM(items, i), "KL",
+                              &client, &clock)) {
+            Py_DECREF(items);
+            return nullptr;
+        }
+        if (clock > it->second.known_of(static_cast<uint32_t>(client))) {
+            ok = false;
+            break;
+        }
+    }
+    Py_DECREF(items);
+    if (ok) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+// lane_known(cap, slot) -> dict client -> next clock
+PyObject* lane_known(PyObject* /*self*/, PyObject* args) {
+    PyObject* cap;
+    long long slot;
+    if (!PyArg_ParseTuple(args, "OL", &cap, &slot)) return nullptr;
+    LaneRegistry* reg = registry_of(cap);
+    if (!reg) return nullptr;
+    PyObject* known = PyDict_New();
+    if (!known) return nullptr;
+    auto it = reg->slots.find(slot);
+    if (it == reg->slots.end()) return known;
+    for (auto& [c, k] : it->second.known) {
+        PyObject* key = PyLong_FromUnsignedLong(c);
+        PyObject* val = PyLong_FromLongLong(k);
+        if (!key || !val || PyDict_SetItem(known, key, val) < 0) {
+            Py_XDECREF(key);
+            Py_XDECREF(val);
+            Py_DECREF(known);
+            return nullptr;
+        }
+        Py_DECREF(key);
+        Py_DECREF(val);
+    }
+    return known;
+}
+
 // lane_windows_batch(cap, [(slot, from_idx), ...])
 //   -> [(full|None, cross|None, new_idx), ...]
 // One call drains the whole dirty set's broadcast windows — the
@@ -1047,6 +1219,12 @@ PyMethodDef lane_methods[] = {
      "Build (full, cross) broadcast window updates since an index."},
     {"lane_windows_batch", lane_windows_batch, METH_VARARGS,
      "Drain broadcast windows for many slots in one call."},
+    {"lane_window_sm", lane_window_sm, METH_VARARGS,
+     "Struct section of a stale/cold SyncStep2 under per-client cutoffs."},
+    {"lane_covers", lane_covers, METH_VARARGS,
+     "Whether the lane's known clocks cover a state vector."},
+    {"lane_known", lane_known, METH_VARARGS,
+     "The lane's per-client next-clock map."},
     {"lane_export", lane_export, METH_VARARGS,
      "Materialize a lane's log for the Python serving paths."},
     {"lane_log_len", lane_log_len, METH_VARARGS,

@@ -249,7 +249,10 @@ class MergePlane:
         from ..native import get_codec
 
         codec = get_codec()
-        if codec is None or not hasattr(codec, "lane_new"):
+        # gate on the NEWEST lane symbol: a stale prebuilt .so (build()
+        # failed but the old module imported) must degrade to the safe
+        # no-op, not AttributeError inside the serve path
+        if codec is None or not hasattr(codec, "lane_window_sm"):
             return False
         self._lane_codec = codec
         self._lane = codec.lane_new()

@@ -236,14 +236,29 @@ class PlaneServing:
         )
         return fast_ok, needs_check
 
+    def _local_sv(self, doc: PlaneDoc) -> dict:
+        """The plane's integrated clocks for this doc (lane docs keep
+        them natively; others in the Python lowerer)."""
+        plane = self.plane
+        if doc.lane_slot is not None and plane._lane is not None:
+            return plane._lane_codec.lane_known(plane._lane, doc.lane_slot)
+        return dict(doc.lowerer.known)
+
     def covers(self, name: str, document) -> bool:
         """Plane has integrated everything the CPU document has seen."""
-        doc = self.plane.docs.get(name)
+        plane = self.plane
+        doc = plane.docs.get(name)
         if doc is None:
             return False
-        self.plane.materialize_lane(doc)  # lane docs: refresh known
+        sv = document.store.get_state_vector()
+        if doc.lane_slot is not None and plane._lane is not None:
+            return bool(
+                plane._lane_codec.lane_covers(
+                    plane._lane, doc.lane_slot, list(sv.items())
+                )
+            )
         known = doc.lowerer.known
-        for client, clock in document.store.get_state_vector().items():
+        for client, clock in sv.items():
             if clock > known.get(client, 0):
                 return False
         return True
@@ -564,7 +579,34 @@ class PlaneServing:
 
     def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
         """SyncStep2 bytes for a doc given the per-client cutoff map."""
-        self.plane.materialize_lane(doc)  # lane docs: serve from the export
+        plane = self.plane
+        if doc.lane_slot is not None and plane._lane is not None:
+            # native path: cutoff trimming, offset origin-rewrite and
+            # surrogate widening all happen in C — no materialization,
+            # so a reconnect storm never exports the log
+            known = plane._lane_codec.lane_known(plane._lane, doc.lane_slot)
+            cold = len(sm) == len(known) and all(
+                clock == 0 for clock in sm.values()
+            )
+            key = plane._lane_codec.lane_log_len(plane._lane, doc.lane_slot)
+            if cold:
+                cached = self._cold_sync_cache.get(doc.name)
+                if cached is not None and cached[0] is doc and cached[1] == key:
+                    plane.counters["sync_serves"] += 1
+                    return cached[2]
+            encoder = Encoder()
+            encoder.write_bytes(
+                plane._lane_codec.lane_window_sm(
+                    plane._lane, doc.lane_slot, list(sm.items())
+                )
+            )
+            self._device_delete_set(doc).write(encoder)
+            plane.counters["sync_serves"] += 1
+            payload = encoder.to_bytes()
+            if cold:
+                self._cold_sync_cache[doc.name] = (doc, key, payload)
+            return payload
+        self.plane.materialize_lane(doc)
         cold = len(sm) == len(doc.lowerer.known) and all(
             clock == 0 for clock in sm.values()
         )
@@ -615,7 +657,7 @@ class PlaneServing:
             # was just flushed), so the diff is computed before building
             # Items — a nearly-current reconnect pays for its tail, not
             # the full doc
-            local_sv = dict(doc.lowerer.known)
+            local_sv = self._local_sv(doc)
             target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
             sm: dict[int, int] = {}
             for client, clock in target_sv.items():
@@ -697,7 +739,7 @@ class PlaneServing:
                 if doc is None or not self.covers(name, document):
                     future.done() or future.set_result(None)
                     continue
-                local_sv = dict(doc.lowerer.known)
+                local_sv = self._local_sv(doc)
                 try:
                     target_sv = decode_state_vector(sv_bytes) if sv_bytes else {}
                 except Exception:

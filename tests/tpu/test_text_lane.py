@@ -207,3 +207,40 @@ def test_lane_remote_flags_split_cross_instance_windows():
     assert lw_full == pw_full
     assert lw_cross == pw_cross
     assert lw_cross != lw_full  # remote record excluded
+
+
+def test_lane_native_sm_serves_match_python_cross_product():
+    """The native stale/cold serve (lane_window_sm: cutoff trimming,
+    offset origin-rewrite, surrogate widening in C) must be
+    byte-identical to the Python _encode_from_sm path across the full
+    per-client cutoff cross-product, surrogate pairs included."""
+    lane_plane, lane_serving, py_plane, py_serving = _planes(capacity=4096)
+    assert lane_plane.register_lane("d") is not None
+    py_plane.register("d")
+    a, b = Doc(), Doc()
+    a.client_id, b.client_id = 7, 0x9000001
+    ta = a.get_text("t")
+    ta.insert(0, "base \U0001f600 text")
+    u1 = encode_state_as_update(a)
+    apply_update(b, u1)
+    b.get_text("t").insert(3, "B\U0001f680B")
+    u2 = encode_state_as_update(b)
+    apply_update(a, u2)
+    ta.insert(0, "more ")
+    ta.delete(2, 4)
+    u3 = encode_state_as_update(a)
+    for plane in (lane_plane, py_plane):
+        for u in (u1, u2, u3):
+            plane.enqueue_update("d", u)
+        plane.flush()
+    lane_serving.refresh()
+    py_serving.refresh()
+    lane_doc, py_doc = lane_plane.docs["d"], py_plane.docs["d"]
+    known = lane_serving._local_sv(lane_doc)
+    assert known == dict(py_doc.lowerer.known)
+    for cut_a in range(known.get(7, 0) + 1):
+        for cut_b in range(0, known.get(0x9000001, 0) + 1, 2):
+            sm = {7: cut_a, 0x9000001: cut_b}
+            assert lane_serving._encode_from_sm(
+                lane_doc, dict(sm)
+            ) == py_serving._encode_from_sm(py_doc, dict(sm)), sm
