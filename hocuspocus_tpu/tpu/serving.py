@@ -577,14 +577,23 @@ class PlaneServing:
             ):
                 sm[client] = sm[client] - 1
 
-    def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
-        """SyncStep2 bytes for a doc given the per-client cutoff map."""
+    def _encode_from_sm(
+        self,
+        doc: PlaneDoc,
+        sm: dict[int, int],
+        local_sv: "Optional[dict]" = None,
+    ) -> bytes:
+        """SyncStep2 bytes for a doc given the per-client cutoff map.
+
+        local_sv: the plane's integrated clocks when the caller already
+        computed them (both sync paths do) — saves a second native
+        known-map fetch per serve on the storm hot path."""
         plane = self.plane
         if doc.lane_slot is not None and plane._lane is not None:
             # native path: cutoff trimming, offset origin-rewrite and
             # surrogate widening all happen in C — no materialization,
             # so a reconnect storm never exports the log
-            known = plane._lane_codec.lane_known(plane._lane, doc.lane_slot)
+            known = local_sv if local_sv is not None else self._local_sv(doc)
             cold = len(sm) == len(known) and all(
                 clock == 0 for clock in sm.values()
             )
@@ -666,7 +675,7 @@ class PlaneServing:
             for client in local_sv:
                 if client not in target_sv:
                     sm[client] = 0
-            return self._encode_from_sm(doc, sm)
+            return self._encode_from_sm(doc, sm, local_sv)
 
     # -- batched catch-up (the storm path) -----------------------------------
 
@@ -771,7 +780,7 @@ class PlaneServing:
                         sm[cid] = 0
                 if not future.done():
                     try:
-                        future.set_result(self._encode_from_sm(doc, sm))
+                        future.set_result(self._encode_from_sm(doc, sm, local_sv))
                     except Exception:
                         future.set_result(None)
                 return
@@ -803,7 +812,7 @@ class PlaneServing:
                         for j, cid in enumerate(columns)
                         if missing_len[i, j] > 0
                     }
-                    future.set_result(self._encode_from_sm(doc, sm))
+                    future.set_result(self._encode_from_sm(doc, sm, local_sv))
                 except Exception:
                     future.set_result(None)  # degrade this request to CPU
         except Exception:
