@@ -66,6 +66,23 @@ def build_parser() -> argparse.ArgumentParser:
         "share one frame per doc; idle edits broadcast immediately), "
         "default 2",
     )
+    parser.add_argument(
+        "--tpu-shards",
+        type=int,
+        default=1,
+        help="doc-partitioned merge planes (serve mode): each shard "
+        "flushes its own arena, keeping microbatch latency bounded at "
+        "large doc populations; --tpu-docs is the per-shard width. "
+        "Default 1 (single plane)",
+    )
+    parser.add_argument(
+        "--tpu-arena",
+        choices=("unit", "rle"),
+        default="unit",
+        help="device arena layout: 'unit' (one slot per UTF-16 unit) or "
+        "'rle' (one entry per run — survives churny long-lived docs; "
+        "--tpu-capacity then counts entries)",
+    )
     return parser
 
 
@@ -93,17 +110,22 @@ async def run(args: argparse.Namespace) -> None:
     if args.tpu_merge or args.tpu_serve:
         # importing .tpu pins the backend to CPU when JAX_PLATFORMS=cpu
         # (see hocuspocus_tpu/tpu/__init__.py)
-        from .tpu import TpuMergeExtension
+        from .tpu import ShardedTpuMergeExtension, TpuMergeExtension
 
-        extensions.append(
-            TpuMergeExtension(
-                num_docs=args.tpu_docs,
-                capacity=args.tpu_capacity,
-                serve=args.tpu_serve,
-                flush_interval_ms=args.tpu_flush_interval,
-                broadcast_interval_ms=args.tpu_broadcast_interval,
-            )
+        plane_kwargs = dict(
+            num_docs=args.tpu_docs,
+            capacity=args.tpu_capacity,
+            serve=args.tpu_serve,
+            flush_interval_ms=args.tpu_flush_interval,
+            broadcast_interval_ms=args.tpu_broadcast_interval,
+            arena=args.tpu_arena,
         )
+        if args.tpu_shards > 1:
+            extensions.append(
+                ShardedTpuMergeExtension(shards=args.tpu_shards, **plane_kwargs)
+            )
+        else:
+            extensions.append(TpuMergeExtension(**plane_kwargs))
 
     server = Server(Configuration(extensions=extensions, quiet=False))
     await server.listen(port=args.port, host=args.host)

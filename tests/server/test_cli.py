@@ -79,3 +79,33 @@ async def test_cli_tpu_serve_mode():
             for p in (a, b):
                 if p is not None:
                     p.destroy()
+
+
+async def test_cli_sharded_serve_flags():
+    """--tpu-shards/--tpu-arena boot the doc-partitioned serve-mode
+    server from the CLI; docs on different shards converge end to end."""
+    async with _launch_cli(
+        "--tpu-serve", "--tpu-shards", "2", "--tpu-arena", "rle",
+        "--tpu-docs", "16", "--tpu-capacity", "512",
+        "--tpu-flush-interval", "1", "--tpu-broadcast-interval", "1",
+    ) as port:
+        providers = []
+        try:
+            for d in range(4):
+                w = HocuspocusProvider(name=f"shard-{d}", url=f"ws://127.0.0.1:{port}")
+                r = HocuspocusProvider(name=f"shard-{d}", url=f"ws://127.0.0.1:{port}")
+                providers += [w, r]
+            await wait_for(lambda: all(p.synced for p in providers), timeout=40)
+            for d in range(4):
+                providers[2 * d].document.get_text("t").insert(0, f"doc {d} content")
+            await wait_for(
+                lambda: all(
+                    providers[2 * d + 1].document.get_text("t").to_string()
+                    == f"doc {d} content"
+                    for d in range(4)
+                ),
+                timeout=25,
+            )
+        finally:
+            for p in providers:
+                p.destroy()
