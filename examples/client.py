@@ -5,7 +5,12 @@ Run examples/default.py first, then: python examples/client.py
 
 import asyncio
 
-from hocuspocus_tpu.provider import HocuspocusProvider
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu.provider import HocuspocusProvider  # noqa: E402
 
 
 async def main() -> None:

@@ -7,8 +7,13 @@ Run: python examples/default.py
 import asyncio
 import logging
 
-from hocuspocus_tpu import Configuration, Server
-from hocuspocus_tpu.extensions import Logger, SQLite
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu import Configuration, Server  # noqa: E402
+from hocuspocus_tpu.extensions import Logger, SQLite  # noqa: E402
 
 
 async def main() -> None:

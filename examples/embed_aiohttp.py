@@ -12,8 +12,13 @@ import asyncio
 
 from aiohttp import WSMsgType, web
 
-from hocuspocus_tpu.server import Hocuspocus, RequestInfo
-from hocuspocus_tpu.server.server import AiohttpWebSocketTransport
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu.server import Hocuspocus, RequestInfo  # noqa: E402
+from hocuspocus_tpu.server.server import AiohttpWebSocketTransport  # noqa: E402
 
 hocuspocus = Hocuspocus()
 

@@ -14,7 +14,12 @@ import asyncio
 import tornado.web
 import tornado.websocket
 
-from hocuspocus_tpu.server import (
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu.server import (  # noqa: E402
     CallbackWebSocketTransport,
     Hocuspocus,
     RequestInfo,

@@ -12,10 +12,15 @@ Run: python examples/redis_multi.py
 
 import asyncio
 
-from hocuspocus_tpu import Configuration, Server
-from hocuspocus_tpu.extensions import Redis
-from hocuspocus_tpu.net.mini_redis import MiniRedis
-from hocuspocus_tpu.tpu import TpuMergeExtension
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu import Configuration, Server  # noqa: E402
+from hocuspocus_tpu.extensions import Redis  # noqa: E402
+from hocuspocus_tpu.net.mini_redis import MiniRedis  # noqa: E402
+from hocuspocus_tpu.tpu import TpuMergeExtension  # noqa: E402
 
 
 async def main() -> None:

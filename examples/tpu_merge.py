@@ -16,9 +16,14 @@ the arenas over the available devices.
 
 import asyncio
 
-from hocuspocus_tpu import Configuration, Server
-from hocuspocus_tpu.extensions import Logger
-from hocuspocus_tpu.tpu import TpuMergeExtension
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu import Configuration, Server  # noqa: E402
+from hocuspocus_tpu.extensions import Logger  # noqa: E402
+from hocuspocus_tpu.tpu import TpuMergeExtension  # noqa: E402
 
 
 async def main() -> None:

@@ -14,6 +14,10 @@ else is in the document.
 Keys: printable characters insert at the cursor; arrows move;
 backspace deletes; Ctrl-Q quits.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import asyncio
 import curses
