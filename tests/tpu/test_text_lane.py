@@ -326,3 +326,52 @@ def test_lane_concurrent_editors_differential(seed):
             assert stale_l is not None and stale_l == stale_p, (seed, round_no)
     # final content equality against the CPU replicas
     assert lane_plane.text("conc") == a.get_text("t").to_string()
+
+
+def test_lane_gc_structs_match_python():
+    """A wire GC struct (collected range) on a text doc: the lane must
+    record it host-side (never queued to the device), advance known
+    past the range, integrate subsequent structs that chain onto it,
+    and serve windows byte-identical to the Python path."""
+    from hocuspocus_tpu.crdt.encoding import Encoder
+
+    lane_plane, lane_serving, py_plane, py_serving = _planes()
+    assert lane_plane.register_lane("d") is not None
+    py_plane.register("d")
+
+    # [1 section][2 structs][client 42][clock 0]
+    #   GC len 4, then ContentString "hi" with origin (42, 3)
+    e = Encoder()
+    e.write_var_uint(1)
+    e.write_var_uint(2)
+    e.write_var_uint(42)
+    e.write_var_uint(0)
+    e.write_uint8(0)  # GC ref
+    e.write_var_uint(4)
+    e.write_uint8(0x04 | 0x80)  # ContentString + origin
+    e.write_var_uint(42)
+    e.write_var_uint(3)
+    e.write_var_string("hi")
+    e.write_var_uint(0)  # empty delete set
+    update = e.to_bytes()
+
+    assert lane_plane.enqueue_update("d", update) > 0
+    assert py_plane.enqueue_update("d", update) > 0
+    assert lane_plane.is_supported("d") and py_plane.is_supported("d")
+    # BOTH structs end up host-only GC records: the insert's origin
+    # resolves into the collected range, so it too collapses to GC
+    # (yjs Item.getMissing semantics) — nothing queues to the device
+    assert lane_plane.pending_ops() == py_plane.pending_ops() == 0
+    lw = lane_serving.build_broadcast_pair("d")
+    pw = py_serving.build_broadcast_pair("d")
+    assert lw is not None and lw[0] == pw[0]
+    lane_plane.flush()
+    py_plane.flush()
+    lane_serving.refresh()
+    py_serving.refresh()
+    assert lane_serving._local_sv(lane_plane.docs["d"]) == {42: 6}
+    # cold + stale serves agree (stale cutoff inside the GC range)
+    for sm in ({42: 0}, {42: 2}, {42: 4}, {42: 5}):
+        assert lane_serving._encode_from_sm(
+            lane_plane.docs["d"], dict(sm)
+        ) == py_serving._encode_from_sm(py_plane.docs["d"], dict(sm)), sm
