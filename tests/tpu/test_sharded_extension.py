@@ -123,3 +123,55 @@ async def test_sharded_concurrent_edits_converge():
         b.destroy()
     finally:
         await server.destroy()
+
+
+async def test_sharded_planes_with_redis_fanout():
+    """The full production combo: doc-partitioned shard planes on TWO
+    instances behind (mini-)Redis — cross-instance window fan-out and
+    late joins must work per shard."""
+    from hocuspocus_tpu.extensions import Redis
+    from hocuspocus_tpu.net.mini_redis import MiniRedis
+
+    redis = await MiniRedis().start()
+    ext_a = ShardedTpuMergeExtension(
+        shards=2, num_docs=8, capacity=1024, flush_interval_ms=1, serve=True
+    )
+    ext_b = ShardedTpuMergeExtension(
+        shards=2, num_docs=8, capacity=1024, flush_interval_ms=1, serve=True
+    )
+    server_a = await new_hocuspocus(
+        extensions=[Redis(port=redis.port, identifier="sha", disconnect_delay=100), ext_a]
+    )
+    server_b = await new_hocuspocus(
+        extensions=[Redis(port=redis.port, identifier="shb", disconnect_delay=100), ext_b]
+    )
+    try:
+        writers = {}
+        readers = {}
+        for d in range(4):
+            name = f"xdoc-{d}"
+            writers[name] = new_provider(server_a, name=name)
+            readers[name] = new_provider(server_b, name=name)
+        await wait_synced(*writers.values(), *readers.values())
+        for name, w in writers.items():
+            w.document.get_text("t").insert(0, f"payload {name}")
+        for name, r in readers.items():
+            await retryable_assertion(
+                lambda r=r, name=name: _assert(
+                    r.document.get_text("t").to_string() == f"payload {name}"
+                )
+            )
+        assert ext_a.counters["cpu_fallbacks"] == 0
+        assert ext_b.counters["cpu_fallbacks"] == 0
+        assert ext_a.counters["plane_broadcasts"] >= 1
+        # late joiner on B pulls one of the docs from B's shard plane
+        late = new_provider(server_b, name="xdoc-2")
+        await wait_synced(late)
+        assert late.document.get_text("t").to_string() == "payload xdoc-2"
+        late.destroy()
+        for p in list(writers.values()) + list(readers.values()):
+            p.destroy()
+    finally:
+        await server_a.destroy()
+        await server_b.destroy()
+        await redis.stop()
