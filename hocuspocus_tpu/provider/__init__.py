@@ -1,8 +1,10 @@
+from .inprocess import InProcessProviderSocket
 from .message_receiver import MessageReceiver
 from .provider import AwarenessError, HocuspocusProvider
 from .websocket import HocuspocusProviderWebsocket, WebSocketStatus
 
 __all__ = [
+    "InProcessProviderSocket",
     "MessageReceiver",
     "AwarenessError",
     "HocuspocusProvider",

@@ -1,0 +1,150 @@
+"""In-process provider socket: full provider semantics with no OS socket.
+
+Binds `HocuspocusProvider` instances directly to a `Hocuspocus` server
+in the same process through the transport seam
+(`Hocuspocus.handle_connection` + `CallbackWebSocketTransport`), so
+embedders — and the at-scale load harness (`hocuspocus_tpu.loadgen`) —
+get the complete client pipeline (auth, SyncStep1/2, awareness,
+unsynced-changes acking, multiplexing many documents per "socket")
+without websockets, fd limits, or network framing overhead.
+
+The reference's only in-process editing API is the hook-level
+`DirectConnection` (`packages/server/src/DirectConnection.ts`); this
+class goes further: the real provider runs against the real server
+message pipeline (`ClientConnection.handleMessage` equivalent), which
+is what makes socket-free load generation representative of production
+behavior. The interface mirrors `HocuspocusProviderWebsocket`
+(`packages/provider/src/HocuspocusProviderWebsocket.ts`) so providers
+can't tell the difference.
+
+Ordering: both directions are drained by single pump tasks —
+client→server frames apply strictly in send order (the server path is
+awaited sequentially), and server→client frames arrive in transport
+send order (CallbackWebSocketTransport's writer queue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from ..aio import spawn_tracked
+from ..crdt.doc import Observable
+from ..crdt.encoding import Decoder
+from .websocket import WebSocketStatus
+
+
+class InProcessProviderSocket(Observable):
+    """Provider-socket lookalike wired straight into a Hocuspocus core.
+
+    Parameters:
+    - hocuspocus: the server core (a `Hocuspocus`, or a `Server` whose
+      `.hocuspocus` is used).
+    - context: default context dict passed to the connection's hook
+      payloads (what the websocket host derives from the upgrade).
+    - request: optional RequestInfo; defaults to a plain "/" request.
+    """
+
+    def __init__(self, hocuspocus, context: Optional[dict] = None, request=None) -> None:
+        super().__init__()
+        core = getattr(hocuspocus, "hocuspocus", hocuspocus)
+        from ..server.hocuspocus import RequestInfo
+        from ..server.transports import CallbackWebSocketTransport
+
+        self._core = core
+        self.provider_map: dict[str, Any] = {}
+        self.status = WebSocketStatus.Connected
+        self.should_connect = True
+        self._destroyed = False
+        self._bg_tasks: set = set()
+        self._in_queue: asyncio.Queue = asyncio.Queue()
+
+        self._transport = CallbackWebSocketTransport(
+            send_async=self._deliver_to_client,
+            close_async=self._closed_by_server,
+        )
+        self._client_connection = core.handle_connection(
+            self._transport,
+            request or RequestInfo(),
+            dict(context or {}),
+        )
+        self._pump_task = asyncio.ensure_future(self._pump())
+
+    # -- lifecycle (socket-interface no-ops / teardown) --------------------
+
+    def connect(self) -> None:
+        pass
+
+    async def wait_connected(self, timeout: float = 30) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        self.destroy()
+
+    def destroy(self) -> None:
+        if self._destroyed:
+            return
+        self._destroyed = True
+        self.emit("destroy")
+        self._pump_task.cancel()
+        self._transport.abort()
+        task = asyncio.ensure_future(
+            self._client_connection.handle_transport_close(1000, "destroyed")
+        )
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        self._set_status(WebSocketStatus.Disconnected)
+        self._observers = {}
+
+    # -- provider attachment (mirrors HocuspocusProviderWebsocket) ---------
+
+    def attach(self, provider) -> None:
+        self.provider_map[provider.name] = provider
+        if not self._destroyed:
+            spawn_tracked(self._bg_tasks, provider.on_open())
+
+    def detach(self, provider) -> None:
+        if provider.name in self.provider_map:
+            from ..protocol.message import OutgoingMessage
+
+            provider.send(OutgoingMessage(provider.name).write_close_message("closed"))
+            del self.provider_map[provider.name]
+
+    # -- IO ----------------------------------------------------------------
+
+    def send(self, data: bytes) -> None:
+        if not self._destroyed:
+            self._in_queue.put_nowait(data)
+
+    async def _pump(self) -> None:
+        while True:
+            data = await self._in_queue.get()
+            try:
+                await self._client_connection.handle_message(data)
+            except Exception:
+                # per-message isolation, like the websocket host's
+                # per-socket error handler (Server.ts:71-80 analog)
+                pass
+
+    async def _deliver_to_client(self, data: bytes) -> None:
+        self.emit("message", {"data": data})
+        try:
+            document_name = Decoder(data).read_var_string()
+        except Exception:
+            return
+        provider = self.provider_map.get(document_name)
+        if provider is not None:
+            provider.on_message(data)
+
+    async def _closed_by_server(self, code: int, reason: str) -> None:
+        if self._destroyed:
+            return
+        self._set_status(WebSocketStatus.Disconnected)
+        event = {"code": code, "reason": reason}
+        self.emit("close", {"event": event})
+        self.emit("disconnect", {"event": event})
+
+    def _set_status(self, status: WebSocketStatus) -> None:
+        if self.status != status:
+            self.status = status
+            self.emit("status", {"status": status})

@@ -1,0 +1,170 @@
+"""InProcessProviderSocket: full provider semantics with no OS socket.
+
+The socket-free path must be indistinguishable from the websocket path
+for sync, multiplexing, auth hooks, awareness, and teardown — it backs
+the at-scale load harness (hocuspocus_tpu.loadgen), so any divergence
+here would make the 100k-doc measurements unrepresentative.
+"""
+
+import asyncio
+
+from hocuspocus_tpu.protocol.close_events import CloseEvent
+from hocuspocus_tpu.provider import HocuspocusProvider, InProcessProviderSocket
+from hocuspocus_tpu.server import Configuration, Hocuspocus
+from tests.utils import retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_inprocess_provider_syncs_and_edits():
+    server = Hocuspocus(Configuration(quiet=True))
+    socket = InProcessProviderSocket(server)
+    provider = HocuspocusProvider(name="inproc-doc", websocket_provider=socket)
+    provider.attach()
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "hello inproc")
+        await retryable_assertion(
+            lambda: _assert(
+                server.documents["inproc-doc"].get_text("t").to_string()
+                == "hello inproc"
+            )
+        )
+        # server -> client direction
+        direct = await server.open_direct_connection("inproc-doc")
+        await direct.transact(
+            lambda doc: doc.get_text("t").insert(0, "server says ")
+        )
+        await direct.disconnect()
+        await retryable_assertion(
+            lambda: _assert(
+                provider.document.get_text("t").to_string()
+                == "server says hello inproc"
+            )
+        )
+    finally:
+        provider.destroy()
+        socket.destroy()
+
+
+async def test_inprocess_socket_multiplexes_documents():
+    server = Hocuspocus(Configuration(quiet=True))
+    socket = InProcessProviderSocket(server)
+    providers = [
+        HocuspocusProvider(name=f"mux-{i}", websocket_provider=socket)
+        for i in range(4)
+    ]
+    for p in providers:
+        p.attach()
+    try:
+        await wait_synced(*providers)
+        assert server.get_documents_count() == 4
+        # one underlying connection => one socket id
+        assert server.get_connections_count() == 1
+        for i, p in enumerate(providers):
+            p.document.get_text("t").insert(0, f"doc {i}")
+        await retryable_assertion(
+            lambda: _assert(
+                all(
+                    server.documents[f"mux-{i}"].get_text("t").to_string()
+                    == f"doc {i}"
+                    for i in range(4)
+                )
+            )
+        )
+    finally:
+        for p in providers:
+            p.destroy()
+        socket.destroy()
+
+
+async def test_inprocess_socket_runs_auth_hooks():
+    seen_tokens = []
+
+    async def on_authenticate(payload):
+        seen_tokens.append(payload.token)
+        if payload.token != "let-me-in":
+            raise CloseEvent(4401, "Unauthorized")
+        return {"user": "authed"}
+
+    contexts = []
+
+    async def connected(payload):
+        contexts.append(payload.context)
+
+    server = Hocuspocus(
+        Configuration(
+            quiet=True, on_authenticate=on_authenticate, connected=connected
+        )
+    )
+    good_socket = InProcessProviderSocket(server)
+    good = HocuspocusProvider(
+        name="auth-doc", websocket_provider=good_socket, token="let-me-in"
+    )
+    good.attach()
+    bad_socket = InProcessProviderSocket(server)
+    denied = []
+    bad = HocuspocusProvider(
+        name="auth-doc",
+        websocket_provider=bad_socket,
+        token="wrong",
+        on_authentication_failed=lambda data: denied.append(data),
+    )
+    bad.attach()
+    try:
+        await wait_synced(good)
+        await retryable_assertion(lambda: _assert(len(denied) == 1))
+        assert seen_tokens == ["let-me-in", "wrong"] or seen_tokens == [
+            "wrong",
+            "let-me-in",
+        ]
+        assert contexts and contexts[0].get("user") == "authed"
+    finally:
+        good.destroy()
+        bad.destroy()
+        good_socket.destroy()
+        bad_socket.destroy()
+
+
+async def test_inprocess_socket_awareness_propagates():
+    server = Hocuspocus(Configuration(quiet=True))
+    socket_a = InProcessProviderSocket(server)
+    socket_b = InProcessProviderSocket(server)
+    a = HocuspocusProvider(name="aw-doc", websocket_provider=socket_a)
+    b = HocuspocusProvider(name="aw-doc", websocket_provider=socket_b)
+    a.attach()
+    b.attach()
+    try:
+        await wait_synced(a, b)
+        a.set_awareness_field("user", {"name": "alice"})
+        await retryable_assertion(
+            lambda: _assert(
+                any(
+                    state.get("user", {}).get("name") == "alice"
+                    for state in b.awareness.get_states().values()
+                )
+            )
+        )
+    finally:
+        a.destroy()
+        b.destroy()
+        socket_a.destroy()
+        socket_b.destroy()
+
+
+async def test_inprocess_socket_destroy_disconnects_and_unloads():
+    server = Hocuspocus(Configuration(quiet=True, unload_immediately=True))
+    socket = InProcessProviderSocket(server)
+    provider = HocuspocusProvider(name="bye-doc", websocket_provider=socket)
+    provider.attach()
+    await wait_synced(provider)
+    provider.document.get_text("t").insert(0, "x")
+    await asyncio.sleep(0.05)
+    provider.destroy()
+    socket.destroy()
+    await retryable_assertion(
+        lambda: _assert(server.get_documents_count() == 0)
+    )
+    assert server.get_connections_count() == 0
