@@ -10,7 +10,13 @@ users by a document identifier", here applied in-process).
 import asyncio
 
 from hocuspocus_tpu.tpu import ShardedTpuMergeExtension
-from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+from tests.utils import (
+    assert_on_update,
+    new_hocuspocus,
+    new_provider,
+    retryable_assertion,
+    wait_synced,
+)
 
 
 def _assert(cond):
@@ -152,13 +158,14 @@ async def test_sharded_planes_with_redis_fanout():
             name = f"xdoc-{d}"
             writers[name] = new_provider(server_a, name=name)
             readers[name] = new_provider(server_b, name=name)
-        # generous: 8 providers + 2 serve planes warming compiles + the
-        # cross-instance join protocol, possibly on a loaded runner
+        # event-driven: timeouts here are liveness bounds only — the
+        # waits resolve on synced/update events, not interval polls
         await wait_synced(*writers.values(), *readers.values(), timeout=60)
         for name, w in writers.items():
             w.document.get_text("t").insert(0, f"payload {name}")
         for name, r in readers.items():
-            await retryable_assertion(
+            await assert_on_update(
+                r.document,
                 lambda r=r, name=name: _assert(
                     r.document.get_text("t").to_string() == f"payload {name}"
                 ),
@@ -170,10 +177,11 @@ async def test_sharded_planes_with_redis_fanout():
         # late joiner on B pulls one of the docs from B's shard plane
         late = new_provider(server_b, name="xdoc-2")
         await wait_synced(late, timeout=30)
-        await retryable_assertion(
+        await assert_on_update(
+            late.document,
             lambda: _assert(
                 late.document.get_text("t").to_string() == "payload xdoc-2"
-            )
+            ),
         )
         late.destroy()
         for p in list(writers.values()) + list(readers.values()):

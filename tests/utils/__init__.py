@@ -47,10 +47,66 @@ async def retryable_assertion(fn, timeout: float = 10.0, interval: float = 0.05)
             await asyncio.sleep(interval)
 
 
-async def wait_synced(*providers, timeout: float = 10.0) -> None:
-    """Wait until every provider has completed its first sync handshake."""
-    for provider in providers:
-        await wait_for(lambda p=provider: p.synced, timeout=timeout)
+async def wait_synced(*providers, timeout: float = 30.0) -> None:
+    """Wait until every provider has completed its first sync handshake.
+
+    Event-driven: resolves on each provider's "synced" emit rather than
+    interval polling, so the timeout is purely a liveness bound — a
+    loaded runner slows the wait, never breaks it."""
+    loop = asyncio.get_running_loop()
+    waiters: list = []
+    try:
+        for provider in providers:
+            if provider.synced:
+                continue
+            fut = loop.create_future()
+
+            def handler(payload, fut=fut):
+                if payload.get("state") and not fut.done():
+                    fut.set_result(None)
+
+            provider.on("synced", handler)
+            waiters.append((provider, handler, fut))
+        if waiters:
+            await asyncio.wait_for(
+                asyncio.gather(*(fut for _, _, fut in waiters)), timeout=timeout
+            )
+    finally:
+        for provider, handler, _ in waiters:
+            provider.off("synced", handler)
+
+
+async def assert_on_update(observable, fn, event: str = "update", timeout: float = 30.0):
+    """Event-driven eventual assertion: run `fn` now and again after every
+    `event` emission on `observable` (e.g. a provider's Y.Doc), returning
+    as soon as it stops raising AssertionError. Unlike interval polling,
+    the deadline only bounds liveness — it can't race the event itself."""
+    loop = asyncio.get_running_loop()
+    wake = asyncio.Event()
+
+    def handler(*args) -> None:
+        loop.call_soon_threadsafe(wake.set)
+
+    observable.on(event, handler)
+    deadline = time.monotonic() + timeout
+    try:
+        while True:
+            try:
+                result = fn()
+                if asyncio.iscoroutine(result):
+                    result = await result
+                return result
+            except AssertionError:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise
+                wake.clear()
+                try:
+                    await asyncio.wait_for(wake.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass  # final re-check, then raise from fn
+    finally:
+        observable.off(event, handler)
 
 
 async def wait_for(predicate, timeout: float = 10.0, interval: float = 0.02) -> None:
