@@ -171,6 +171,7 @@ def main() -> None:
             continue
         _attach_baseline_scale_pass(result, platforms)
         _attach_sharded_scale_pass(result, platforms)
+        _attach_served_scale_pass(result, platforms)
         if errors:
             result.setdefault("extra", {})["failed_attempts"] = errors
         print(json.dumps(result))
@@ -263,10 +264,42 @@ def _save_capture(result: dict) -> None:
         pass
 
 
+def _run_inner_pass(result: dict, key: str, env: dict, timeout: int, transform=None) -> None:
+    """Run `bench.py --inner` under `env` with its own budget and attach
+    its final JSON line to result.extra[key] (via `transform` if given).
+    Shared by every side-pass: losing a side metric must never cost the
+    already-computed headline number."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        result.setdefault("extra", {})[key] = {"error": "timeout"}
+        return
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                payload = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            result.setdefault("extra", {})[key] = (
+                transform(payload) if transform else payload
+            )
+            return
+    result.setdefault("extra", {})[key] = {
+        "error": f"rc={proc.returncode}",
+        "stderr_tail": proc.stderr[-300:],
+    }
+
+
 def _attach_baseline_scale_pass(result: dict, platforms: "str | None") -> None:
     """On a live TPU, also run the BASELINE-regime scale point (100k docs
-    x 10KB capacity ~ 9.6 GB HBM) and attach it under extra.baseline_scale.
-    Never jeopardizes the headline result."""
+    x 10KB capacity ~ 9.6 GB HBM) and attach it under extra.baseline_scale."""
     if os.environ.get("BENCH_BASELINE_SCALE", "1") == "0" or "BENCH_DOCS" in os.environ:
         return
     env = _env_for(platforms)
@@ -284,74 +317,76 @@ def _attach_baseline_scale_pass(result: dict, platforms: "str | None") -> None:
             "BENCH_BASELINE_SCALE": "0",
         }
     )
-    # a short independent budget: losing the scale point must never cost
-    # the already-computed headline number under an outer deadline
-    scale_timeout = int(os.environ.get("BENCH_SCALE_TIMEOUT", 300))
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--inner"],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=scale_timeout,
-        )
-    except subprocess.TimeoutExpired:
-        result.setdefault("extra", {})["baseline_scale"] = {"error": "timeout"}
-        return
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                scale = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            result.setdefault("extra", {})["baseline_scale"] = {
-                "merges_per_sec": scale.get("value"),
-                **{
-                    k: v
-                    for k, v in scale.get("extra", {}).items()
-                    if k in ("docs", "capacity", "total_merges", "p99_microbatch_ms", "backend")
-                },
-            }
-            return
-    result.setdefault("extra", {})["baseline_scale"] = {
-        "error": f"rc={proc.returncode}",
-        "stderr_tail": proc.stderr[-300:],
-    }
+
+    def summarize(scale: dict) -> dict:
+        return {
+            "merges_per_sec": scale.get("value"),
+            **{
+                k: v
+                for k, v in scale.get("extra", {}).items()
+                if k in ("docs", "capacity", "total_merges", "p99_microbatch_ms", "backend")
+            },
+        }
+
+    _run_inner_pass(
+        result,
+        "baseline_scale",
+        env,
+        int(os.environ.get("BENCH_SCALE_TIMEOUT", 300)),
+        transform=summarize,
+    )
 
 
 def _attach_sharded_scale_pass(result: dict, platforms: "str | None") -> None:
     """The production 100k-doc topology (13 doc-partitioned shard
-    planes) measured on-chip; attached as extra.sharded_100k. Own
-    budget — never jeopardizes the headline."""
+    planes) measured on-chip; attached as extra.sharded_100k."""
     if os.environ.get("BENCH_SHARDED", "1") == "0" or "BENCH_DOCS" in os.environ:
         return
     env = _env_for(platforms)
     env["BENCH_MODE"] = "sharded100k"
-    timeout = int(os.environ.get("BENCH_SHARDED_TIMEOUT", 600))
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--inner"],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        result.setdefault("extra", {})["sharded_100k"] = {"error": "timeout"}
+    _run_inner_pass(
+        result, "sharded_100k", env, int(os.environ.get("BENCH_SHARDED_TIMEOUT", 600))
+    )
+
+
+def _attach_served_scale_pass(result: dict, platforms: "str | None") -> None:
+    """The SERVED 100k-doc regime: real server objects, full provider
+    pipeline, cross-instance Redis fan-out — via the in-process
+    transport (hocuspocus_tpu.loadgen), which is how a population this
+    size fits in one process (fd limits cap real sockets near 4k).
+    Attached as extra.served_100k with its own budget."""
+    if os.environ.get("BENCH_SERVED", "1") == "0" or "BENCH_DOCS" in os.environ:
         return
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                result.setdefault("extra", {})["sharded_100k"] = json.loads(line)
-                return
-            except json.JSONDecodeError:
-                continue
-    result.setdefault("extra", {})["sharded_100k"] = {
-        "error": f"rc={proc.returncode}",
-        "stderr_tail": proc.stderr[-300:],
-    }
+    env = _env_for(platforms)
+    env["BENCH_MODE"] = "served100k"
+    _run_inner_pass(
+        result, "served_100k", env, int(os.environ.get("BENCH_SERVED_TIMEOUT", 1200))
+    )
+
+
+def _measure_served_scale() -> dict:
+    """BENCH_MODE=served100k inner: loadgen harness at the 100k-doc
+    served population, 2 instances through mini-Redis (config4 topology
+    at BASELINE scale)."""
+    import asyncio
+
+    from hocuspocus_tpu.loadgen import run_served_load
+
+    docs = int(os.environ.get("BENCH_SERVED_DOCS", 100_000))
+    return asyncio.run(
+        run_served_load(
+            num_docs=docs,
+            instances=int(os.environ.get("BENCH_SERVED_INSTANCES", 2)),
+            sampled=int(os.environ.get("BENCH_SERVED_SAMPLED", 48)),
+            edits=int(os.environ.get("BENCH_SERVED_EDITS", 150)),
+            shards=int(os.environ.get("BENCH_SERVED_SHARDS", 13)),
+            capacity=int(os.environ.get("BENCH_SERVED_CAPACITY", 1024)),
+            docs_per_socket=1024,
+            sync_timeout=float(os.environ.get("BENCH_SERVED_SYNC_TIMEOUT", 700)),
+            budget_s=float(os.environ.get("BENCH_SERVED_BUDGET", 1100)),
+            progress=_log,
+        )
+    )
 
 
 MAX_RUN = 16  # UTF-16 units per synthetic insert op (typing-burst sized)
@@ -417,6 +452,9 @@ def run_bench() -> None:
         jax.config.update("jax_platforms", "cpu")
     if os.environ.get("BENCH_MODE") == "sharded100k":
         print(json.dumps(_measure_sharded_scale()))
+        return
+    if os.environ.get("BENCH_MODE") == "served100k":
+        print(json.dumps(_measure_served_scale()))
         return
     import jax.numpy as jnp
 

@@ -5,6 +5,41 @@ from __future__ import annotations
 import asyncio
 
 
+async def await_synced(providers, timeout: float = 30.0, what: str = "providers") -> None:
+    """Event-driven sync barrier over providers.
+
+    Resolves on each provider's "synced" emit (no interval polling), so
+    the timeout is a pure liveness bound. Raises TimeoutError naming
+    `what` and the stragglers' count."""
+    providers = list(providers)
+    loop = asyncio.get_running_loop()
+    handlers = []
+    futs = []
+    try:
+        for p in providers:
+            if p.synced:
+                continue
+            fut = loop.create_future()
+
+            def handler(payload, fut=fut):
+                if payload.get("state") and not fut.done():
+                    fut.set_result(None)
+
+            p.on("synced", handler)
+            handlers.append((p, handler))
+            futs.append(fut)
+        if futs:
+            await asyncio.wait_for(asyncio.gather(*futs), timeout=timeout)
+    except asyncio.TimeoutError:
+        raise TimeoutError(
+            f"{what}: {sum(1 for p in providers if not p.synced)}/"
+            f"{len(providers)} providers never synced"
+        )
+    finally:
+        for p, handler in handlers:
+            p.off("synced", handler)
+
+
 def spawn_tracked(registry: set, coro) -> "asyncio.Task":
     """Fire-and-forget with a strong reference.
 

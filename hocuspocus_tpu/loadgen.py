@@ -1,0 +1,299 @@
+"""Socket-free at-scale load harness for the served merge plane.
+
+The reference's scale story is doc-sharding across instances
+(`docs/guides/scalability.md:7-14`), but OS sockets cap any in-process
+measurement near 4k docs (fd limits). This harness drives a
+config4-shaped population — live served docs with writers, sampled
+readers, steady background load, and optional cross-instance Redis
+fan-out — through REAL server objects over `InProcessProviderSocket`,
+so the 100k-doc regime is measurable in CI and on-chip (`bench.py`
+reuses it for the served p99 metric).
+
+Everything on the path is production code: providers run the full
+auth/SyncStep1/2/awareness pipeline, the server runs the full hook
+chain, and docs are served by `ShardedTpuMergeExtension` planes. Only
+the network framing (websocket upgrade + TCP) is absent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from .aio import await_synced
+from .provider import HocuspocusProvider
+from .provider.inprocess import InProcessProviderSocket
+from .server import Configuration, Server
+from .tpu import ShardedTpuMergeExtension, TpuMergeExtension
+
+
+class ServedLoadHarness:
+    """One measured run of the served-plane topology.
+
+    Parameters:
+    - num_docs: live documents (each gets a writer provider).
+    - instances: server instances; >1 wires them through Redis
+      (mini_redis unless REDIS_HOST targets a real one) and places the
+      sampled readers on the SECOND instance so the timed path crosses
+      the fan-out, exactly like benchmarks/config4.
+    - sampled: docs that get a reader and are latency-timed.
+    - shards / shard_rows / capacity / flush_interval_ms: plane layout
+      per instance (rows must exceed num_docs/shards + hash skew).
+    - docs_per_socket: provider multiplexing width per in-process socket.
+    """
+
+    def __init__(
+        self,
+        num_docs: int = 1024,
+        instances: int = 1,
+        sampled: int = 32,
+        edits: int = 200,
+        shards: int = 4,
+        shard_rows: Optional[int] = None,
+        capacity: int = 1024,
+        flush_interval_ms: float = 2.0,
+        docs_per_socket: int = 512,
+        sync_timeout: float = 600.0,
+        background_fraction: int = 16,
+        progress=None,
+    ) -> None:
+        self.num_docs = num_docs
+        self.instances = instances
+        self.sampled = min(sampled, num_docs)
+        self.edits = edits
+        self.shards = shards
+        self.shard_rows = shard_rows or max(int(num_docs / max(shards, 1) * 1.25), 64)
+        self.capacity = capacity
+        self.flush_interval_ms = flush_interval_ms
+        self.docs_per_socket = docs_per_socket
+        self.sync_timeout = sync_timeout
+        self.background_fraction = background_fraction
+        self._progress = progress or (lambda msg: None)
+
+        self.servers: list[Server] = []
+        self.extensions: list[Any] = []
+        self.sockets: list[InProcessProviderSocket] = []
+        self.writers: list[HocuspocusProvider] = []
+        self.readers: list[HocuspocusProvider] = []
+        self._mini_redis = None
+        self._bg_len: list[int] = []
+
+    # -- topology ----------------------------------------------------------
+
+    async def _start_servers(self) -> None:
+        import os
+
+        redis_cfg = None
+        if self.instances > 1:
+            host = os.environ.get("REDIS_HOST")
+            if host:
+                redis_cfg = (host, int(os.environ.get("REDIS_PORT", 6379)))
+            else:
+                from .net.mini_redis import MiniRedis
+
+                self._mini_redis = await MiniRedis().start()
+                redis_cfg = ("127.0.0.1", self._mini_redis.port)
+        for i in range(self.instances):
+            if self.shards > 1:
+                ext = ShardedTpuMergeExtension(
+                    shards=self.shards,
+                    num_docs=self.shard_rows,
+                    capacity=self.capacity,
+                    flush_interval_ms=self.flush_interval_ms,
+                    serve=True,
+                )
+                planes = [s.plane for s in ext.shards]
+            else:
+                ext = TpuMergeExtension(
+                    num_docs=self.shard_rows,
+                    capacity=self.capacity,
+                    flush_interval_ms=self.flush_interval_ms,
+                    serve=True,
+                )
+                planes = [ext.plane]
+            extensions: list[Any] = []
+            if redis_cfg is not None:
+                from .extensions import Redis
+
+                extensions.append(
+                    Redis(
+                        host=redis_cfg[0],
+                        port=redis_cfg[1],
+                        identifier=f"loadgen-{i}",
+                        disconnect_delay=100,
+                    )
+                )
+            extensions.append(ext)
+            server = Server(Configuration(quiet=True, extensions=extensions))
+            await server.listen(port=0)
+            for plane in planes:
+                plane.warmup_compiles()
+            self.servers.append(server)
+            self.extensions.append(ext)
+
+    def _counters(self, instance: int = 0) -> dict:
+        ext = self.extensions[instance]
+        return ext.counters if hasattr(ext, "counters") else ext.plane.counters
+
+    async def _connect_writers(self) -> None:
+        """Writers for every doc on instance 0, multiplexed over
+        in-process sockets, synced chunk by chunk (one chunk's sync
+        storm completes before the next connects — the same pacing a
+        production rollout's connection ramp gives the server)."""
+        server = self.servers[0]
+        t0 = time.perf_counter()
+        for base in range(0, self.num_docs, self.docs_per_socket):
+            socket = InProcessProviderSocket(server)
+            self.sockets.append(socket)
+            chunk = []
+            for d in range(base, min(base + self.docs_per_socket, self.num_docs)):
+                p = HocuspocusProvider(name=f"load-{d}", websocket_provider=socket)
+                p.attach()
+                chunk.append(p)
+            self.writers.extend(chunk)
+            await await_synced(chunk, self.sync_timeout, f"writer chunk @{base}")
+            if base % (self.docs_per_socket * 8) == 0:
+                rate = len(self.writers) / (time.perf_counter() - t0)
+                self._progress(
+                    f"writers {len(self.writers)}/{self.num_docs} ({rate:.0f}/s)"
+                )
+        self._bg_len = [0] * self.num_docs
+
+    async def _connect_readers(self) -> None:
+        server = self.servers[1 if self.instances > 1 else 0]
+        socket = InProcessProviderSocket(server)
+        self.sockets.append(socket)
+        for d in range(self.sampled):
+            p = HocuspocusProvider(name=f"load-{d}", websocket_provider=socket)
+            p.attach()
+            self.readers.append(p)
+        await await_synced(self.readers, self.sync_timeout, "readers")
+
+    # -- measurement -------------------------------------------------------
+
+    async def _one_edit(self, i: int) -> float:
+        """Writer inserts; returns seconds until the reader's doc shows
+        the grown text. Event-driven: woken by reader doc updates."""
+        d = i % self.sampled
+        wtext = self.writers[d].document.get_text("body")
+        rdoc = self.readers[d].document
+        rtext = rdoc.get_text("body")
+        expected = len(rtext) + 16
+        wake = asyncio.Event()
+        handler = lambda *args: wake.set()  # noqa: E731
+        rdoc.on("update", handler)
+        try:
+            t0 = time.perf_counter()
+            wtext.insert(len(wtext), "x" * 16)
+            while len(rtext) < expected:
+                if time.perf_counter() - t0 > 30:
+                    raise TimeoutError(f"edit {i} never observed by reader")
+                wake.clear()
+                try:
+                    await asyncio.wait_for(wake.wait(), timeout=0.25)
+                except asyncio.TimeoutError:
+                    pass
+            return time.perf_counter() - t0
+        finally:
+            rdoc.off("update", handler)
+
+    async def _background_load(self, stop: asyncio.Event) -> None:
+        """Steady inserts across ~1/background_fraction of the
+        non-sampled population per tick, so flushes run at real batch
+        width during the timed samples."""
+        tick = 0
+        n = self.background_fraction
+        while not stop.is_set():
+            for d in range(self.sampled + tick % n, self.num_docs, n):
+                self.writers[d].document.get_text("body").insert(
+                    self._bg_len[d], "y" * 8
+                )
+                self._bg_len[d] += 8
+                await asyncio.sleep(0)
+                if stop.is_set():
+                    return
+            tick += 1
+            await asyncio.sleep(0.01)
+
+    async def run(self, budget_s: float = 600.0) -> dict:
+        """Build the topology, measure, tear down; returns the metrics
+        dict (config4-shaped: served p99 + plane health)."""
+        t_start = time.perf_counter()
+        try:
+            self._progress(
+                f"starting {self.instances} instance(s), "
+                f"{self.shards}x{self.shard_rows}x{self.capacity} planes"
+            )
+            await self._start_servers()
+            await self._connect_writers()
+            await self._connect_readers()
+            self._progress("population synced; warming sampled docs")
+
+            for i in range(self.sampled):
+                await self._one_edit(i)
+
+            stop = asyncio.Event()
+            load_task = asyncio.ensure_future(self._background_load(stop))
+            lat: list[float] = []
+            try:
+                deadline = t_start + budget_s * 0.8
+                for i in range(self.edits):
+                    lat.append(await self._one_edit(i))
+                    if time.perf_counter() > deadline and len(lat) >= 50:
+                        break
+            finally:
+                stop.set()
+                await load_task
+
+            counters = [dict(self._counters(i)) for i in range(self.instances)]
+            if counters[0]["plane_broadcasts"] <= 0:
+                raise RuntimeError(f"plane never served: {counters[0]}")
+            lat_ms = np.array(lat) * 1000
+            return {
+                "metric": "served_merge_to_broadcast_p99_ms",
+                "value": round(float(np.percentile(lat_ms, 99)), 2),
+                "unit": "ms",
+                "extra": {
+                    "docs": self.num_docs,
+                    "instances": self.instances,
+                    "cross_instance": self.instances > 1,
+                    "shards": self.shards,
+                    "shard_rows": self.shard_rows,
+                    "capacity": self.capacity,
+                    "sampled_docs": self.sampled,
+                    "samples": len(lat),
+                    "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                    "served_docs": [
+                        self.extensions[i].served_docs()
+                        if hasattr(self.extensions[i], "served_docs")
+                        else len(self.extensions[i]._docs)
+                        for i in range(self.instances)
+                    ],
+                    "plane_health": counters,
+                    "transport": "in-process",
+                    "setup_s": round(time.perf_counter() - t_start, 1),
+                },
+            }
+        finally:
+            await self._teardown()
+
+    async def _teardown(self) -> None:
+        for p in self.writers + self.readers:
+            p.destroy()
+        for socket in self.sockets:
+            socket.destroy()
+        # let the destroy-close tasks run before the servers go away
+        await asyncio.sleep(0)
+        for server in self.servers:
+            await server.destroy()
+        if self._mini_redis is not None:
+            await self._mini_redis.stop()
+
+
+async def run_served_load(**kwargs) -> dict:
+    """Convenience wrapper: build + run a ServedLoadHarness."""
+    budget_s = kwargs.pop("budget_s", 600.0)
+    return await ServedLoadHarness(**kwargs).run(budget_s=budget_s)

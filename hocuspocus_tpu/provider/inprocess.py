@@ -13,9 +13,13 @@ The reference's only in-process editing API is the hook-level
 class goes further: the real provider runs against the real server
 message pipeline (`ClientConnection.handleMessage` equivalent), which
 is what makes socket-free load generation representative of production
-behavior. The interface mirrors `HocuspocusProviderWebsocket`
-(`packages/provider/src/HocuspocusProviderWebsocket.ts`) so providers
-can't tell the difference.
+behavior. The interface and event sequence mirror
+`HocuspocusProviderWebsocket`
+(`packages/provider/src/HocuspocusProviderWebsocket.ts`): construction
+starts Connecting, and one scheduled "connect moment" flips status to
+Connected, emits open/connect, and runs `on_open` for every attached
+provider — so `on_connect`/`on_status` callbacks fire exactly as they
+would over a real socket.
 
 Ordering: both directions are drained by single pump tasks —
 client→server frames apply strictly in send order (the server path is
@@ -28,13 +32,15 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Optional
 
+import logging
+
 from ..aio import spawn_tracked
-from ..crdt.doc import Observable
-from ..crdt.encoding import Decoder
-from .websocket import WebSocketStatus
+from .socket_base import ProviderSocketBase, WebSocketStatus
+
+logger = logging.getLogger("hocuspocus_tpu")
 
 
-class InProcessProviderSocket(Observable):
+class InProcessProviderSocket(ProviderSocketBase):
     """Provider-socket lookalike wired straight into a Hocuspocus core.
 
     Parameters:
@@ -53,11 +59,12 @@ class InProcessProviderSocket(Observable):
 
         self._core = core
         self.provider_map: dict[str, Any] = {}
-        self.status = WebSocketStatus.Connected
+        self.status = WebSocketStatus.Connecting
         self.should_connect = True
         self._destroyed = False
         self._bg_tasks: set = set()
         self._in_queue: asyncio.Queue = asyncio.Queue()
+        self._connected_event = asyncio.Event()
 
         self._transport = CallbackWebSocketTransport(
             send_async=self._deliver_to_client,
@@ -69,19 +76,34 @@ class InProcessProviderSocket(Observable):
             dict(context or {}),
         )
         self._pump_task = asyncio.ensure_future(self._pump())
+        # the "connect moment": scheduled, not inline, so providers
+        # constructed right after this socket still observe the
+        # Connecting→Connected transition (open/connect/status events +
+        # on_open) in websocket order
+        spawn_tracked(self._bg_tasks, self._establish())
 
     # -- lifecycle (socket-interface no-ops / teardown) --------------------
+
+    async def _establish(self) -> None:
+        if self._destroyed:
+            return
+        self._set_status(WebSocketStatus.Connected)
+        self._connected_event.set()
+        self.emit("open", {})
+        self.emit("connect")
+        for provider in list(self.provider_map.values()):
+            spawn_tracked(self._bg_tasks, provider.on_open())
 
     def connect(self) -> None:
         pass
 
     async def wait_connected(self, timeout: float = 30) -> None:
-        pass
+        await asyncio.wait_for(self._connected_event.wait(), timeout)
 
     def disconnect(self) -> None:
         self.destroy()
 
-    def destroy(self) -> None:
+    def destroy(self, code: int = 1000, reason: str = "destroyed") -> None:
         if self._destroyed:
             return
         self._destroyed = True
@@ -89,26 +111,27 @@ class InProcessProviderSocket(Observable):
         self._pump_task.cancel()
         self._transport.abort()
         task = asyncio.ensure_future(
-            self._client_connection.handle_transport_close(1000, "destroyed")
+            self._client_connection.handle_transport_close(code, reason)
         )
         self._bg_tasks.add(task)
         task.add_done_callback(self._bg_tasks.discard)
+        # same event sequence the websocket transport emits when the
+        # connection dies (status -> close -> disconnect): providers
+        # reset synced/authenticated in their "close" handler, so
+        # skipping it would leave them synced=True on a dead socket
         self._set_status(WebSocketStatus.Disconnected)
+        event = {"code": code, "reason": reason}
+        self.emit("close", {"event": event})
+        self.emit("disconnect", {"event": event})
         self._observers = {}
 
     # -- provider attachment (mirrors HocuspocusProviderWebsocket) ---------
 
     def attach(self, provider) -> None:
         self.provider_map[provider.name] = provider
-        if not self._destroyed:
+        if not self._destroyed and self.status == WebSocketStatus.Connected:
             spawn_tracked(self._bg_tasks, provider.on_open())
-
-    def detach(self, provider) -> None:
-        if provider.name in self.provider_map:
-            from ..protocol.message import OutgoingMessage
-
-            provider.send(OutgoingMessage(provider.name).write_close_message("closed"))
-            del self.provider_map[provider.name]
+        # else: _establish runs on_open at the connect moment
 
     # -- IO ----------------------------------------------------------------
 
@@ -121,20 +144,18 @@ class InProcessProviderSocket(Observable):
             data = await self._in_queue.get()
             try:
                 await self._client_connection.handle_message(data)
-            except Exception:
-                # per-message isolation, like the websocket host's
-                # per-socket error handler (Server.ts:71-80 analog)
-                pass
+            except Exception as error:
+                # mirror the websocket host (server.py websocket loop):
+                # log, then tear the whole client connection down — a
+                # silently dropped frame would leave providers hanging
+                # un-synced with no diagnostic trail
+                logger.error(f"in-process socket error: {error!r}")
+                if not self._destroyed:
+                    self.destroy(code=1011, reason="internal error")
+                return
 
     async def _deliver_to_client(self, data: bytes) -> None:
-        self.emit("message", {"data": data})
-        try:
-            document_name = Decoder(data).read_var_string()
-        except Exception:
-            return
-        provider = self.provider_map.get(document_name)
-        if provider is not None:
-            provider.on_message(data)
+        self._route_frame(data)
 
     async def _closed_by_server(self, code: int, reason: str) -> None:
         if self._destroyed:
@@ -143,8 +164,3 @@ class InProcessProviderSocket(Observable):
         event = {"code": code, "reason": reason}
         self.emit("close", {"event": event})
         self.emit("disconnect", {"event": event})
-
-    def _set_status(self, status: WebSocketStatus) -> None:
-        if self.status != status:
-            self.status = status
-            self.emit("status", {"status": status})

@@ -11,24 +11,18 @@ from __future__ import annotations
 import asyncio
 import random
 import time
-from enum import Enum
 from typing import Any, Optional
 
 import aiohttp
 
 from ..aio import spawn_tracked
 
-from ..crdt.doc import Observable
-from ..crdt.encoding import Decoder
+from .socket_base import ProviderSocketBase, WebSocketStatus
+
+__all__ = ["HocuspocusProviderWebsocket", "WebSocketStatus"]
 
 
-class WebSocketStatus(str, Enum):
-    Connecting = "connecting"
-    Connected = "connected"
-    Disconnected = "disconnected"
-
-
-class HocuspocusProviderWebsocket(Observable):
+class HocuspocusProviderWebsocket(ProviderSocketBase):
     def __init__(
         self,
         url: str,
@@ -123,13 +117,6 @@ class HocuspocusProviderWebsocket(Observable):
             self.connect()
         if self.status == WebSocketStatus.Connected:
             self._spawn(provider.on_open())
-
-    def detach(self, provider) -> None:
-        if provider.name in self.provider_map:
-            from ..protocol.message import OutgoingMessage
-
-            provider.send(OutgoingMessage(provider.name).write_close_message("closed"))
-            del self.provider_map[provider.name]
 
     # -- IO ----------------------------------------------------------------
 
@@ -228,21 +215,9 @@ class HocuspocusProviderWebsocket(Observable):
             delay = random.uniform(self.min_delay, max(delay, self.min_delay))
         return delay / 1000
 
-    def _set_status(self, status: WebSocketStatus) -> None:
-        if self.status != status:
-            self.status = status
-            self.emit("status", {"status": status})
-
     def _on_message(self, data: bytes) -> None:
         self.last_message_received = time.monotonic()
-        self.emit("message", {"data": data})
-        try:
-            document_name = Decoder(data).read_var_string()
-        except Exception:
-            return
-        provider = self.provider_map.get(document_name)
-        if provider is not None:
-            provider.on_message(data)
+        self._route_frame(data)
 
     async def _connection_checker(self) -> None:
         interval = self.message_reconnect_timeout / 10 / 1000

@@ -117,7 +117,10 @@ async def test_has_unsynced_changes_lifecycle():
     provider.on("unsynced_changes", lambda data: events.append(data["number"]))
     try:
         await wait_synced(provider)
-        assert not provider.has_unsynced_changes
+        # "synced" fires on SyncStep2 receipt; the initial unsynced count
+        # (startSync's reset to 1) drains one round-trip later via the
+        # SyncStatus ack (reference HocuspocusProvider.ts:251-270)
+        await wait_for(lambda: not provider.has_unsynced_changes)
         provider.document.get_text("t").insert(0, "x")
         assert provider.has_unsynced_changes
         await wait_for(lambda: not provider.has_unsynced_changes)

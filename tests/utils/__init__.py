@@ -50,30 +50,12 @@ async def retryable_assertion(fn, timeout: float = 10.0, interval: float = 0.05)
 async def wait_synced(*providers, timeout: float = 30.0) -> None:
     """Wait until every provider has completed its first sync handshake.
 
-    Event-driven: resolves on each provider's "synced" emit rather than
-    interval polling, so the timeout is purely a liveness bound — a
-    loaded runner slows the wait, never breaks it."""
-    loop = asyncio.get_running_loop()
-    waiters: list = []
-    try:
-        for provider in providers:
-            if provider.synced:
-                continue
-            fut = loop.create_future()
+    Event-driven (delegates to `hocuspocus_tpu.aio.await_synced`): the
+    timeout is purely a liveness bound — a loaded runner slows the
+    wait, never breaks it."""
+    from hocuspocus_tpu.aio import await_synced
 
-            def handler(payload, fut=fut):
-                if payload.get("state") and not fut.done():
-                    fut.set_result(None)
-
-            provider.on("synced", handler)
-            waiters.append((provider, handler, fut))
-        if waiters:
-            await asyncio.wait_for(
-                asyncio.gather(*(fut for _, _, fut in waiters)), timeout=timeout
-            )
-    finally:
-        for provider, handler, _ in waiters:
-            provider.off("synced", handler)
+    await await_synced(providers, timeout=timeout, what="wait_synced")
 
 
 async def assert_on_update(observable, fn, event: str = "update", timeout: float = 30.0):
