@@ -15,7 +15,10 @@ while true; do
     STAMP=$(date -u +%Y%m%dT%H%M%SZ)
     echo "[watch] TPU ALIVE at $STAMP — running bench" >> "$LOG"
     touch benchmarks/results/TPU_ALIVE
-    if timeout -k 30 2400 python bench.py > "benchmarks/results/bench_tpu_watch_${STAMP}.json" 2>>"$LOG"; then
+    # budget covers every side-pass: inner 900 + scale 300 + sharded 600
+    # + served-100k 1200, with slack (a timeout kill loses the whole
+    # JSON — bench.py prints only at the end)
+    if timeout -k 30 3900 python bench.py > "benchmarks/results/bench_tpu_watch_${STAMP}.json" 2>>"$LOG"; then
       echo "[watch] bench captured: bench_tpu_watch_${STAMP}.json" >> "$LOG"
       # only keep captures that really landed on-chip THIS run — a
       # stale-capture fallback re-emits an old on-chip artifact and

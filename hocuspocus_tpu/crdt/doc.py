@@ -41,7 +41,12 @@ class Observable:
             listeners.remove(fn)
 
     def emit(self, name: str, *args: Any) -> None:
-        for fn in list(self._observers.get(name, ())):
+        listeners = self._observers.get(name)
+        if not listeners:
+            # fast path: transaction plumbing emits 7 lifecycle events
+            # per transact and most go unobserved — don't allocate
+            return
+        for fn in list(listeners):
             fn(*args)
 
     def has_listeners(self, name: str) -> bool:
@@ -206,11 +211,24 @@ def _cleanup_transactions(cleanups: list[Transaction], i: int) -> None:
             doc.client_id = generate_new_client_id()
         doc.emit("afterTransactionCleanup", transaction, doc)
         if doc.has_listeners("update"):
-            from .update import write_update_message_from_transaction
+            wire = transaction.meta.get("wire_update")
+            if wire is not None and (
+                transaction.delete_set.clients
+                or any(
+                    transaction.before_state.get(client, 0) != clock
+                    for client, clock in transaction.after_state.items()
+                )
+            ):
+                # clean remote apply (see update.apply_update): the
+                # transaction is exactly the received update, so re-emit
+                # the wire bytes and skip the store re-encode
+                doc.emit("update", wire, transaction.origin, doc, transaction)
+            else:
+                from .update import write_update_message_from_transaction
 
-            encoder = Encoder()
-            if write_update_message_from_transaction(encoder, transaction):
-                doc.emit("update", encoder.to_bytes(), transaction.origin, doc, transaction)
+                encoder = Encoder()
+                if write_update_message_from_transaction(encoder, transaction):
+                    doc.emit("update", encoder.to_bytes(), transaction.origin, doc, transaction)
         if transaction.subdocs_added or transaction.subdocs_removed or transaction.subdocs_loaded:
             for subdoc in transaction.subdocs_added:
                 subdoc.client_id = doc.client_id

@@ -179,8 +179,14 @@ def _integrate_structs(
                         struct_refs["i"] += 1
                         continue
                 elif offset == 0 or offset < stack_head.length:
+                    if offset != 0:
+                        # partial dedup: part of this struct was known
+                        transaction.meta["input_dedup"] = True
                     stack_head.integrate(transaction, offset)
                     state[client] = stack_head.id.clock + stack_head.length
+                else:
+                    # fully-known struct skipped
+                    transaction.meta["input_dedup"] = True
         # next struct
         if stack:
             stack_head = stack.pop()
@@ -241,6 +247,11 @@ def _read_and_apply_delete_set(
                                     index, struct.split(transaction, clock_end - struct.id.clock)
                                 )
                             struct.delete(transaction)
+                        else:
+                            # range covers already-deleted/GC'd content:
+                            # the transaction's delete set will be
+                            # narrower than the wire's
+                            transaction.meta["input_dedup"] = True
                     else:
                         break
             elif dlen > 0:
@@ -251,8 +262,18 @@ def _read_and_apply_delete_set(
 
 
 def apply_update(doc: "Doc", update: bytes, transaction_origin: Any = None) -> None:
+    # wire reuse is only sound when THIS call owns the whole transaction
+    # (nested applies share a transaction whose content exceeds this
+    # update; beforeTransaction-era listener mutations would too)
+    dedicated = doc._transaction is None
+
     def run(transaction: "Transaction") -> None:
         store = doc.store
+        ds_had_pending = store.pending_ds is not None
+        # a beforeTransaction listener may have already mutated the doc
+        # inside this very transaction — then its content exceeds the
+        # update even though we own the transact call
+        pre_dirty = bool(transaction.changed) or bool(transaction.delete_set.clients)
         decoder = Decoder(update)
         refs = _read_client_struct_refs(decoder)
         rest = _integrate_structs(transaction, store, refs)
@@ -294,6 +315,23 @@ def apply_update(doc: "Doc", update: bytes, transaction_origin: Any = None) -> N
             encoder.write_var_uint(0)
             DeleteSet.read(Decoder(ds_rest)).write(encoder)
             store.pending_ds = encoder.to_bytes()
+
+        if (
+            dedicated
+            and not pre_dirty
+            and rest is None
+            and ds_rest is None
+            and not ds_had_pending
+            and not transaction.meta.get("input_dedup")
+        ):
+            # CLEAN apply: every struct integrated at offset 0, every
+            # delete range was fresh, nothing went to (or drained from)
+            # the pending buffers — the transaction's content is exactly
+            # this update, so the "update" event can re-emit the wire
+            # bytes verbatim instead of re-encoding from the store
+            # (the remote-apply hot path: server fan-out and provider
+            # receive both skip one full update encode)
+            transaction.meta["wire_update"] = bytes(update)
 
     doc.transact(run, origin=transaction_origin, local=False)
     retry = doc.store.pending_structs is not None and any(

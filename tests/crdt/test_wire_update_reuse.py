@@ -1,0 +1,142 @@
+"""Wire-update reuse on clean remote applies.
+
+A remote update that integrates cleanly (every struct at offset 0,
+every delete range fresh, no pending interaction) re-emits the received
+bytes verbatim from the "update" event instead of re-encoding from the
+store — the remote-apply hot path (server fan-out + provider receive).
+Anything unclean must fall back to the store re-encode, whose output
+reflects only what actually changed.
+"""
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+
+
+def _updates_of(doc: Doc) -> list:
+    collected: list = []
+    doc.on("update", lambda u, *r: collected.append(bytes(u)))
+    return collected
+
+
+def test_clean_apply_reemits_wire_bytes():
+    a = Doc()
+    a.get_text("t").insert(0, "hello wire")
+    update = encode_state_as_update(a)
+
+    b = Doc()
+    out = _updates_of(b)
+    apply_update(b, update)
+    assert out == [update]
+    assert b.get_text("t").to_string() == "hello wire"
+
+
+def test_duplicate_apply_emits_nothing():
+    a = Doc()
+    a.get_text("t").insert(0, "dup")
+    update = encode_state_as_update(a)
+    b = Doc()
+    apply_update(b, update)
+    out = _updates_of(b)
+    apply_update(b, update)  # fully known: no-op, no event
+    assert out == []
+
+
+def test_partially_known_apply_reencodes():
+    a = Doc()
+    ta = a.get_text("t")
+    ta.insert(0, "base")
+    u1 = encode_state_as_update(a)
+    ta.insert(4, " more")
+    u_full = encode_state_as_update(a)  # contains u1's content too
+
+    b = Doc()
+    apply_update(b, u1)
+    out = _updates_of(b)
+    apply_update(b, u_full)  # overlaps known content -> must re-encode
+    assert len(out) == 1
+    assert out[0] != u_full
+    # the re-encoded delta applied elsewhere still converges
+    c = Doc()
+    apply_update(c, u1)
+    apply_update(c, out[0])
+    assert c.get_text("t").to_string() == "base more"
+
+
+def test_out_of_order_apply_buffers_then_reencodes():
+    a = Doc()
+    ta = a.get_text("t")
+    updates = []
+    a.on("update", lambda u, *r: updates.append(bytes(u)))
+    ta.insert(0, "first")
+    ta.insert(5, " second")
+    assert len(updates) == 2
+
+    b = Doc()
+    out = _updates_of(b)
+    apply_update(b, updates[1])  # depends on updates[0]: pending
+    assert out == []  # nothing applied, nothing emitted
+    apply_update(b, updates[0])  # drains pending in a follow-up txn
+    assert b.get_text("t").to_string() == "first second"
+    # the first emitted event is exactly updates[0] (clean), the drain
+    # transaction re-encodes the pending content
+    assert out[0] == updates[0]
+    assert len(out) == 2
+
+
+def test_delete_overlap_reencodes():
+    a = Doc()
+    ta = a.get_text("t")
+    ta.insert(0, "abcdef")
+    base = encode_state_as_update(a)
+
+    b = Doc()
+    apply_update(b, base)
+    # a deletes [1, 4); b already deleted [2, 3) locally — overlapping
+    a_updates = []
+    a.on("update", lambda u, *r: a_updates.append(bytes(u)))
+    ta.delete(1, 3)
+    b.get_text("t").delete(2, 1)
+    out = _updates_of(b)
+    apply_update(b, a_updates[0])
+    assert b.get_text("t").to_string() == "aef"
+    # overlapped delete range -> transaction narrower than wire -> re-encode
+    assert len(out) == 1 and out[0] != a_updates[0]
+
+
+def test_nested_transaction_never_reuses_wire():
+    a = Doc()
+    a.get_text("t").insert(0, "nested")
+    update = encode_state_as_update(a)
+
+    b = Doc()
+    out = _updates_of(b)
+
+    def both(txn):
+        b.get_text("t").insert(0, "local+")
+        apply_update(b, update)
+
+    b.transact(both)
+    assert len(out) == 1
+    assert out[0] != update  # transaction content exceeds the wire update
+    c = Doc()
+    apply_update(c, out[0])
+    # concurrent position-0 inserts: order is YATA's choice, but the
+    # emitted update must carry BOTH edits and converge with b
+    assert c.get_text("t").to_string() == b.get_text("t").to_string()
+    assert "local+" in c.get_text("t").to_string()
+    assert "nested" in c.get_text("t").to_string()
+
+
+def test_wire_reuse_converges_across_peers():
+    """Relay topology: A -> B -> C forwarding emitted updates; C must
+    converge with A even when B's emits are verbatim wire bytes."""
+    a, b, c = Doc(), Doc(), Doc()
+    b_out = _updates_of(b)
+    ta = a.get_text("t")
+    a_out = _updates_of(a)
+    for i, word in enumerate(["alpha ", "beta ", "gamma"]):
+        ta.insert(len(ta.to_string()), word)
+    for u in a_out:
+        apply_update(b, u)
+    for u in b_out:
+        apply_update(c, u)
+    assert c.get_text("t").to_string() == a.get_text("t").to_string() == "alpha beta gamma"
