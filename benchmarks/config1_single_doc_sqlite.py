@@ -84,14 +84,15 @@ async def main() -> None:
             b.document.get_text("t")
         )
         # headline counts only DELIVERED updates: if convergence timed
-        # out, credit what both peers actually hold (min length), not
-        # what the senders enqueued
-        delivered = (
-            sent
-            if converged
-            else min(len(a.document.get_text("t")), len(b.document.get_text("t")))
-            // chunk
-        )
+        # out, credit only REMOTELY-RECEIVED content (each peer's text
+        # includes its own local inserts, which never crossed the wire)
+        if converged:
+            delivered = sent
+        else:
+            own = (sent // 2) * chunk  # chars each peer inserted locally
+            a_recv = max(len(a.document.get_text("t")) - own, 0)
+            b_recv = max(len(b.document.get_text("t")) - own, 0)
+            delivered = (a_recv + b_recv) // chunk
 
         p99 = float(np.percentile(np.array(latencies) * 1000, 99)) if latencies else None
         print(

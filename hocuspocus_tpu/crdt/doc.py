@@ -211,21 +211,15 @@ def _cleanup_transactions(cleanups: list[Transaction], i: int) -> None:
             doc.client_id = generate_new_client_id()
         doc.emit("afterTransactionCleanup", transaction, doc)
         if doc.has_listeners("update"):
+            from .update import transaction_changed, write_update_message_from_transaction
+
             wire = transaction.meta.get("wire_update")
-            if wire is not None and (
-                transaction.delete_set.clients
-                or any(
-                    transaction.before_state.get(client, 0) != clock
-                    for client, clock in transaction.after_state.items()
-                )
-            ):
+            if wire is not None and transaction_changed(transaction):
                 # clean remote apply (see update.apply_update): the
                 # transaction is exactly the received update, so re-emit
                 # the wire bytes and skip the store re-encode
                 doc.emit("update", wire, transaction.origin, doc, transaction)
             else:
-                from .update import write_update_message_from_transaction
-
                 encoder = Encoder()
                 if write_update_message_from_transaction(encoder, transaction):
                     doc.emit("update", encoder.to_bytes(), transaction.origin, doc, transaction)

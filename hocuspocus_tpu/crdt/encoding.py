@@ -112,7 +112,15 @@ class Encoder:
                 self.write_uint8(123)
                 self.write_float64(float(data))
         elif isinstance(data, float):
-            if math.isfinite(data) and struct.unpack(">f", struct.pack(">f", data))[0] == data:
+            # float32-fitness probe: cap magnitude first — pack(">f")
+            # raises OverflowError beyond float32 range, where lib0's
+            # isFloat32 just answers false (a 1e300 payload must encode
+            # as float64, not crash the encoder)
+            if (
+                math.isfinite(data)
+                and abs(data) <= 3.4028234663852886e38
+                and struct.unpack(">f", struct.pack(">f", data))[0] == data
+            ):
                 self.write_uint8(124)
                 self.write_float32(data)
             else:

@@ -71,12 +71,17 @@ def _write_clients_structs(encoder: Encoder, store: StructStore, target_sv: dict
         _write_structs(encoder, store.clients[client], client, sm[client])
 
 
-def write_update_message_from_transaction(encoder: Encoder, transaction: "Transaction") -> bool:
-    changed = any(
+def transaction_changed(transaction: "Transaction") -> bool:
+    """Did this transaction add structs or delete anything? Gates both
+    the update-event emit paths (wire reuse and store re-encode)."""
+    return bool(transaction.delete_set.clients) or any(
         transaction.before_state.get(client, 0) != clock
         for client, clock in transaction.after_state.items()
     )
-    if not transaction.delete_set.clients and not changed:
+
+
+def write_update_message_from_transaction(encoder: Encoder, transaction: "Transaction") -> bool:
+    if not transaction_changed(transaction):
         return False
     transaction.delete_set.sort_and_merge()
     _write_clients_structs(encoder, transaction.doc.store, transaction.before_state)
@@ -205,10 +210,24 @@ def _integrate_structs(
         encoder.write_var_uint(len(rest_structs))
         for client in sorted(rest_structs, reverse=True):
             structs = rest_structs[client]
-            encoder.write_var_uint(len(structs))
+            # the v1 reader assigns each struct's id from the RUNNING
+            # clock, so clock holes (merged sections for one client, or
+            # refs buffered around a wire Skip) must be made explicit as
+            # Skip structs — exactly what the format uses them for.
+            # Without them the pending retry decodes shifted ids and
+            # corrupts the store (fuzz: "struct for clock N not found").
+            with_skips: list[Struct] = [structs[0]]
+            for struct in structs[1:]:
+                prev = with_skips[-1]
+                prev_end = prev.id.clock + prev.length
+                gap = struct.id.clock - prev_end
+                if gap > 0:
+                    with_skips.append(Skip(ID(client, prev_end), gap))
+                with_skips.append(struct)
+            encoder.write_var_uint(len(with_skips))
             encoder.write_var_uint(client)
-            encoder.write_var_uint(structs[0].id.clock)
-            for struct in structs:
+            encoder.write_var_uint(with_skips[0].id.clock)
+            for struct in with_skips:
                 struct.write(encoder, 0)
         encoder.write_var_uint(0)  # empty delete set
         return {"missing": missing_sv, "update": encoder.to_bytes()}

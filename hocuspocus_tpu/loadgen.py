@@ -181,7 +181,12 @@ class ServedLoadHarness:
         wtext = self.writers[d].document.get_text("body")
         rdoc = self.readers[d].document
         rtext = rdoc.get_text("body")
-        expected = len(rtext) + 16
+        # target = WRITER's post-insert length: after a swallowed
+        # straggler, a reader-relative target (+16 over current reader
+        # length) would be satisfied by the straggler's late bytes and
+        # record a bogus ~0 latency; the writer high-water mark requires
+        # THIS edit to have landed
+        expected = len(wtext) + 16
         wake = asyncio.Event()
         handler = lambda *args: wake.set()  # noqa: E731
         rdoc.on("update", handler)

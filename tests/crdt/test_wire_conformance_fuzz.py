@@ -1,0 +1,615 @@
+"""Adversarial wire-conformance fuzz: randomized VALID v1 byte streams.
+
+The differential fuzz suites compare this repo against itself (lane vs
+Python); this generator instead hand-constructs Yjs v1 update blobs at
+the BYTE level — from the wire facts cross-validated against the
+vendored yjs source (test_yjs_vendored_source_facts.py) and the golden
+vectors — and asserts the engine's behavior on everything a real yjs
+peer can emit:
+
+- multi-section updates, multiple sections for ONE client, sections in
+  random (non-sorted) order
+- Skip structs covering in-update clock gaps
+- out-of-causal-order delivery across updates (pending buffering)
+- GC runs and ContentDeleted items standing in for deleted content
+- astral-plane characters (UTF-16 unit accounting) and U+FFFD
+- ContentAny edge values (None/bools/ints/floats/nested), ContentJSON
+- duplicate delete ranges across updates (idempotence)
+
+Assertions per seed: every permutation of update delivery converges to
+the same text/array/map state, the same state vector, and the same
+encode_state_as_update bytes; and decode→integrate→re-encode is a
+fixpoint. Any divergence from documented yjs behavior is a bug.
+
+(ContentAny/JSON payload bytes are produced via the repo's lib0 value
+codec — their byte layout is covered by the golden vectors; the
+STRUCTURE around them is what this fuzzer varies.)
+
+Reference consumption point: `packages/server/src/MessageReceiver.ts:195-213`.
+Env: FUZZ_WIRE_SEEDS (default 1000), FUZZ_WIRE_OPS (default 28).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.crdt.encoding import Encoder
+
+
+# -- minimal independent lib0 writers (varint layout per the spec) ----------
+
+
+def _vu(out: bytearray, num: int) -> None:
+    while num > 0x7F:
+        out.append(0x80 | (num & 0x7F))
+        num >>= 7
+    out.append(num)
+
+
+def _vstr(out: bytearray, s: str) -> None:
+    data = s.encode("utf-8")
+    _vu(out, len(data))
+    out += data
+
+
+def _any_bytes(value) -> bytes:
+    enc = Encoder()
+    enc.write_any(value)
+    return enc.to_bytes()
+
+
+# struct info bits (cross-validated by test_yjs_vendored_source_facts)
+BIT_ORIGIN = 0x80
+BIT_RIGHT_ORIGIN = 0x40
+BIT_PARENT_SUB = 0x20
+REF_GC = 0
+REF_DELETED = 1
+REF_JSON = 2
+REF_STRING = 4
+REF_ANY = 8
+REF_SKIP = 10
+
+
+class _StructRec:
+    __slots__ = ("client", "clock", "length", "body", "op_index")
+
+    def __init__(self, client, clock, length, body, op_index):
+        self.client = client
+        self.clock = clock
+        self.length = length
+        self.body = body  # bytes AFTER the implicit (client, clock) header
+        self.op_index = op_index
+
+
+def _item_body(
+    ref: int,
+    origin,
+    right_origin,
+    parent_root: "str | None",
+    parent_sub: "str | None",
+    content: bytes,
+) -> bytes:
+    out = bytearray()
+    info = (
+        (ref & 0x1F)
+        | (BIT_ORIGIN if origin is not None else 0)
+        | (BIT_RIGHT_ORIGIN if right_origin is not None else 0)
+        | (BIT_PARENT_SUB if parent_sub is not None else 0)
+    )
+    out.append(info)
+    if origin is not None:
+        _vu(out, origin[0])
+        _vu(out, origin[1])
+    if right_origin is not None:
+        _vu(out, right_origin[0])
+        _vu(out, right_origin[1])
+    if origin is None and right_origin is None:
+        _vu(out, 1)  # parent is a root-type key
+        _vstr(out, parent_root)
+        if parent_sub is not None:
+            _vstr(out, parent_sub)
+    out += content
+    return bytes(out)
+
+
+_TEXT_POOL = ["a", "b", "Z", "é", "中", " ", "𝔘", "🦀", "�", " "]
+_ANY_POOL = [
+    None,
+    True,
+    False,
+    0,
+    -1,
+    63,
+    -64,
+    2**31,
+    -(2**31) - 7,
+    0.5,
+    -2.25,
+    1e300,
+    "",
+    "plain",
+    "🦀🦀",
+    [1, "two", None],
+    {"k": [True, {"n": 3.5}]},
+]
+_JSON_POOL = [1, 2.5, "s", [1, 2], {"a": "b"}, True]
+
+
+class _WireGen:
+    """Sequentially-consistent multi-client history over root text "t",
+    root array "a", root map "m" — emitted as raw v1 bytes."""
+
+    def __init__(self, rng: random.Random, n_clients: int = 3) -> None:
+        self.rng = rng
+        self.clients = [rng.randrange(1, 2**30) for _ in range(n_clients)]
+        self.clocks = {c: 0 for c in self.clients}
+        # per-UNIT total orders: [client, clock, deleted, code_unit, role]
+        # role: 0 solo unit, 1 high half of a surrogate pair, 2 low half
+        self.text_units: list[list] = []
+        # item-split points between a pair's halves corrupt both halves
+        # to U+FFFD (yjs ContentString.splice); keyed by the LOW half id
+        self.split_pairs: set[tuple] = set()
+        # array units: [client, clock, deleted, value]
+        self.array_units: list[list] = []
+        self.map_last: dict[str, tuple] = {}  # key -> (client, clock)
+        self.map_deleted: set[str] = set()
+        self.structs: list[_StructRec] = []
+        self.deletes: list[tuple] = []  # (op_index, client, clock, length)
+        self.op_index = 0
+
+    # -- ops ---------------------------------------------------------------
+
+    def _alloc(self, client: int, length: int) -> int:
+        clock = self.clocks[client]
+        self.clocks[client] = clock + length
+        return clock
+
+    def _mark_split(self, index: int) -> None:
+        """An item split lands between units index-1 and index; if they
+        are the two halves of one surrogate pair, both become U+FFFD."""
+        if 0 < index < len(self.text_units):
+            left = self.text_units[index - 1]
+            right = self.text_units[index]
+            if (
+                left[4] == 1
+                and right[4] == 2
+                and left[0] == right[0]
+                and left[1] + 1 == right[1]
+            ):
+                self.split_pairs.add((right[0], right[1]))
+
+    def text_insert(self) -> None:
+        rng = self.rng
+        client = rng.choice(self.clients)
+        s = "".join(rng.choice(_TEXT_POOL) for _ in range(rng.randint(1, 5)))
+        units = s.encode("utf-16-le", "surrogatepass")
+        code_units = [
+            int.from_bytes(units[i : i + 2], "little") for i in range(0, len(units), 2)
+        ]
+        roles = []
+        for cu in code_units:
+            if 0xD800 <= cu <= 0xDBFF:
+                roles.append(1)
+            elif 0xDC00 <= cu <= 0xDFFF:
+                roles.append(2)
+            else:
+                roles.append(0)
+        k = rng.randint(0, len(self.text_units))
+        self._mark_split(k)
+        left = self.text_units[k - 1] if k > 0 else None
+        right = self.text_units[k] if k < len(self.text_units) else None
+        clock = self._alloc(client, len(code_units))
+        body = _item_body(
+            REF_STRING,
+            (left[0], left[1]) if left is not None else None,
+            (right[0], right[1]) if right is not None else None,
+            "t",
+            None,
+            (lambda out=bytearray(): (_vstr(out, s), bytes(out))[1])(),
+        )
+        self.structs.append(
+            _StructRec(client, clock, len(code_units), body, self.op_index)
+        )
+        new_units = [
+            [client, clock + i, False, cu, role]
+            for i, (cu, role) in enumerate(zip(code_units, roles))
+        ]
+        self.text_units[k:k] = new_units
+        self.op_index += 1
+
+    def text_delete(self) -> None:
+        rng = self.rng
+        visible = [i for i, u in enumerate(self.text_units) if not u[2]]
+        if not visible:
+            return
+        start = rng.choice(visible)
+        length = rng.randint(1, min(6, len(self.text_units) - start))
+        for i in range(start, start + length):
+            u = self.text_units[i]
+            if not u[2]:
+                u[2] = True
+                self.deletes.append((self.op_index, u[0], u[1], 1))
+        self.op_index += 1
+
+    def array_insert(self) -> None:
+        rng = self.rng
+        client = rng.choice(self.clients)
+        if rng.random() < 0.3:
+            values = [rng.choice(_JSON_POOL) for _ in range(rng.randint(1, 3))]
+            content = bytearray()
+            _vu(content, len(values))
+            from hocuspocus_tpu.crdt.content import json_stringify
+
+            for v in values:
+                _vstr(content, json_stringify(v))
+            ref = REF_JSON
+        else:
+            values = [rng.choice(_ANY_POOL) for _ in range(rng.randint(1, 3))]
+            content = bytearray()
+            _vu(content, len(values))
+            for v in values:
+                content += _any_bytes(v)
+            ref = REF_ANY
+        k = rng.randint(0, len(self.array_units))
+        left = self.array_units[k - 1] if k > 0 else None
+        right = self.array_units[k] if k < len(self.array_units) else None
+        clock = self._alloc(client, len(values))
+        body = _item_body(
+            ref,
+            (left[0], left[1]) if left is not None else None,
+            (right[0], right[1]) if right is not None else None,
+            "a",
+            None,
+            bytes(content),
+        )
+        self.structs.append(_StructRec(client, clock, len(values), body, self.op_index))
+        self.array_units[k:k] = [
+            [client, clock + i, False, v] for i, v in enumerate(values)
+        ]
+        self.op_index += 1
+
+    def map_set(self) -> None:
+        rng = self.rng
+        client = rng.choice(self.clients)
+        key = rng.choice(["alpha", "beta", "gamma"])
+        value = rng.choice(_ANY_POOL)
+        prev = self.map_last.get(key)
+        clock = self._alloc(client, 1)
+        content = bytearray()
+        _vu(content, 1)
+        content += _any_bytes(value)
+        body = _item_body(
+            REF_ANY,
+            prev,  # origin = previous entry's id (yjs typeMapSet)
+            None,
+            "m" if prev is None else None,
+            key if prev is None else None,
+            bytes(content),
+        )
+        self.structs.append(_StructRec(client, clock, 1, body, self.op_index))
+        self.map_last[key] = (client, clock)
+        self.map_deleted.discard(key)
+        self.op_index += 1
+
+    def map_delete(self) -> None:
+        live = [k for k in self.map_last if k not in self.map_deleted]
+        if not live:
+            return
+        key = self.rng.choice(live)
+        client, clock = self.map_last[key]
+        self.deletes.append((self.op_index, client, clock, 1))
+        self.map_deleted.add(key)
+        self.op_index += 1
+
+    def generate(self, n_ops: int) -> None:
+        moves = [
+            (self.text_insert, 4),
+            (self.text_delete, 2),
+            (self.array_insert, 2),
+            (self.map_set, 2),
+            (self.map_delete, 1),
+            (self.gc_run, 1),
+        ]
+        population = [fn for fn, w in moves for _ in range(w)]
+        self.text_insert()  # ensure a non-trivial doc
+        for _ in range(n_ops - 1):
+            self.rng.choice(population)()
+
+    # -- post-processing: GC / ContentDeleted stand-ins ---------------------
+
+    def gc_run(self) -> None:
+        """A dead subtree's clock range, emitted as a GC struct — what
+        a real peer's encode produces after collecting the children of
+        a deleted type. GC structs carry no parent/origin references,
+        so nothing outside the (gone) subtree can depend on them."""
+        client = self.rng.choice(self.clients)
+        length = self.rng.randint(1, 5)
+        clock = self._alloc(client, length)
+        body = bytearray([REF_GC])
+        _vu(body, length)
+        self.structs.append(_StructRec(client, clock, length, bytes(body), self.op_index))
+        self.op_index += 1
+
+    def degrade_deleted_items(self) -> None:
+        """Re-encode some fully-deleted text items as ContentDeleted —
+        what a real yjs peer emits for content collected inside a LIVE
+        parent (the YATA metadata survives; only the payload is
+        dropped). Whole-struct GC inside a live parent would be an
+        invalid stream: yjs only GCs a struct when its parent type
+        itself died (Item.gc parentGCd), because dependents derive
+        ordering/parents from the metadata."""
+        deleted_units = {
+            (u[0], u[1]) for u in self.text_units if u[2]
+        }
+        for idx, rec in enumerate(self.structs):
+            covered = all(
+                (rec.client, rec.clock + i) in deleted_units
+                for i in range(rec.length)
+            )
+            if not covered or rec.body[0] & 0x1F != REF_STRING:
+                continue
+            if self.rng.random() < 0.45:
+                # same YATA metadata, ContentDeleted payload
+                old = rec.body
+                info = old[0]
+                head = bytearray()
+                head.append((info & ~0x1F) | REF_DELETED)
+                # copy origin/parent section: parse past the varints
+                pos = 1
+
+                def skip_vu(b, p):
+                    while b[p] & 0x80:
+                        p += 1
+                    return p + 1
+
+                if info & BIT_ORIGIN:
+                    pos = skip_vu(old, pos)
+                    pos = skip_vu(old, pos)
+                if info & BIT_RIGHT_ORIGIN:
+                    pos = skip_vu(old, pos)
+                    pos = skip_vu(old, pos)
+                if not (info & (BIT_ORIGIN | BIT_RIGHT_ORIGIN)):
+                    p2 = skip_vu(old, pos)  # parent kind flag
+                    # root string: length-prefixed
+                    ln_start = p2
+                    p3 = skip_vu(old, ln_start)
+                    strlen = 0
+                    shift = 0
+                    for b in old[ln_start:p3]:
+                        strlen |= (b & 0x7F) << shift
+                        shift += 7
+                    pos = p3 + strlen
+                head += old[1:pos]
+                _vu(head, rec.length)
+                self.structs[idx] = _StructRec(
+                    rec.client, rec.clock, rec.length, bytes(head), rec.op_index
+                )
+
+    # -- chunked encoding ----------------------------------------------------
+
+    def encode_chunks(self, n_chunks: int) -> "tuple[list[bytes], bool]":
+        """Returns (updates, needs_heal).
+
+        Two partitions:
+        - contiguous op windows (what real transactions / SV-diffs
+          produce: per-client clock suffixes) — convergence must hold
+          from the updates alone, in any delivery order;
+        - adversarial random scatter — valid bytes, but partitions no
+          real emission produces. yjs's pending-retry trigger (min
+          missing clock per client vs store state) is a lossy liveness
+          heuristic, and mutually-dependent pendings can stall on such
+          streams in REAL yjs too; the ecosystem heals via the next
+          sync diff. needs_heal=True tells the test to deliver that
+          heal (a full-state update) before asserting convergence —
+          still catching divergence/corruption, without asserting
+          stronger liveness than yjs itself has.
+        """
+        rng = self.rng
+        total_ops = self.op_index
+        needs_heal = rng.random() >= 0.6
+        if not needs_heal:
+            cuts = sorted(rng.sample(range(1, total_ops), min(n_chunks - 1, total_ops - 1)))
+            bounds = [0, *cuts, total_ops]
+            chunk_of = {}
+            for ci in range(len(bounds) - 1):
+                for op in range(bounds[ci], bounds[ci + 1]):
+                    chunk_of[op] = ci
+            n_actual = len(bounds) - 1
+        else:
+            # random assignment: forces per-client clock gaps (Skips or
+            # split sections) and pending-buffer stress
+            chunk_of = {op: rng.randrange(n_chunks) for op in range(total_ops)}
+            n_actual = n_chunks
+
+        updates = []
+        for ci in range(n_actual):
+            structs = [s for s in self.structs if chunk_of[s.op_index] == ci]
+            dels = [d for d in self.deletes if chunk_of[d[0]] == ci]
+            updates.append(self._encode_update(structs, dels))
+        # occasionally re-deliver an early delete range in a later chunk
+        if self.deletes and rng.random() < 0.4:
+            d = rng.choice(self.deletes)
+            updates.append(self._encode_update([], [d]))
+        return [u for u in updates if u is not None], needs_heal
+
+    def encode_heal(self) -> bytes:
+        """One causally-complete full-state update (what a sync step
+        serves a stalled peer)."""
+        return self._encode_update(self.structs, self.deletes)
+
+    def _encode_update(self, structs: list, dels: list) -> "bytes | None":
+        rng = self.rng
+        if not structs and not dels:
+            return None
+        by_client: dict[int, list] = {}
+        for s in structs:
+            by_client.setdefault(s.client, []).append(s)
+        sections = []
+        for client, recs in by_client.items():
+            recs.sort(key=lambda r: r.clock)
+            # contiguous runs
+            runs = [[recs[0]]]
+            for rec in recs[1:]:
+                prev = runs[-1][-1]
+                if prev.clock + prev.length == rec.clock:
+                    runs[-1].append(rec)
+                else:
+                    runs.append([rec])
+            if len(runs) > 1 and rng.random() < 0.5:
+                # ONE section with Skip structs bridging the gaps
+                merged = bytearray()
+                count = 0
+                for ri, run in enumerate(runs):
+                    if ri > 0:
+                        prev = runs[ri - 1][-1]
+                        gap = run[0].clock - (prev.clock + prev.length)
+                        skip = bytearray([REF_SKIP])
+                        _vu(skip, gap)
+                        merged += skip
+                        count += 1
+                    for rec in run:
+                        merged += rec.body
+                        count += 1
+                sections.append((count, client, runs[0][0].clock, bytes(merged)))
+            else:
+                # split sections (multiple sections for one client)
+                for run in runs:
+                    body = b"".join(rec.body for rec in run)
+                    sections.append((len(run), client, run[0].clock, body))
+        rng.shuffle(sections)  # out-of-causal-order across sections
+
+        out = bytearray()
+        _vu(out, len(sections))
+        for count, client, clock, body in sections:
+            _vu(out, count)
+            _vu(out, client)
+            _vu(out, clock)
+            out += body
+        # delete set
+        ds: dict[int, list] = {}
+        for _op, client, clock, length in dels:
+            ds.setdefault(client, []).append((clock, length))
+        _vu(out, len(ds))
+        for client, ranges in ds.items():
+            ranges.sort()
+            # merge adjacent
+            merged = [list(ranges[0])]
+            for clock, length in ranges[1:]:
+                if merged[-1][0] + merged[-1][1] == clock:
+                    merged[-1][1] += length
+                else:
+                    merged.append([clock, length])
+            _vu(out, client)
+            _vu(out, len(merged))
+            for clock, length in merged:
+                _vu(out, clock)
+                _vu(out, length)
+        return bytes(out)
+
+    # -- expected model ------------------------------------------------------
+
+    def expected_text(self) -> str:
+        """Visible units, with yjs surrogate-split semantics: a pair
+        half whose partner was deleted, or whose pair an item split
+        passed through (insert landed between the halves), renders as
+        U+FFFD on BOTH sides (ContentString.splice replacement)."""
+        by_id = {(u[0], u[1]): u for u in self.text_units}
+        out = []
+        for u in self.text_units:
+            if u[2]:
+                continue
+            cu = u[3]
+            if u[4] == 1:  # high half; partner = (client, clock+1)
+                partner = by_id.get((u[0], u[1] + 1))
+                if (
+                    partner is None
+                    or partner[2]
+                    or (u[0], u[1] + 1) in self.split_pairs
+                ):
+                    cu = 0xFFFD
+            elif u[4] == 2:  # low half; partner = (client, clock-1)
+                partner = by_id.get((u[0], u[1] - 1))
+                if partner is None or partner[2] or (u[0], u[1]) in self.split_pairs:
+                    cu = 0xFFFD
+            out.append(int(cu).to_bytes(2, "little"))
+        return b"".join(out).decode("utf-16-le", "surrogatepass")
+
+    def expected_array(self) -> list:
+        return [u[3] for u in self.array_units if not u[2]]
+
+    def expected_map_keys(self) -> set:
+        return {k for k in self.map_last if k not in self.map_deleted}
+
+
+def _apply_all(updates: list[bytes]) -> Doc:
+    doc = Doc()
+    for update in updates:
+        apply_update(doc, update)
+    return doc
+
+
+def _state_of(doc: Doc) -> tuple:
+    return (
+        doc.get_text("t").to_string(),
+        doc.get_array("a").to_json(),
+        doc.get_map("m").to_json(),
+    )
+
+
+def _run_seed(seed: int, n_ops: int) -> None:
+    rng = random.Random(seed)
+    gen = _WireGen(rng, n_clients=rng.randint(2, 4))
+    gen.generate(n_ops)
+    gen.degrade_deleted_items()
+    updates, needs_heal = gen.encode_chunks(rng.randint(2, 6))
+    assert updates, f"seed {seed}: no updates generated"
+    if needs_heal:
+        updates = [*updates, gen.encode_heal()]
+
+    doc_x = _apply_all(updates)
+    state_x = _state_of(doc_x)
+
+    # model agreement (text and array are exactly simulated; map keys
+    # because concurrent same-key sets resolve by YATA order)
+    assert state_x[0] == gen.expected_text(), f"seed {seed}: text diverged from model"
+    assert state_x[1] == gen.expected_array(), f"seed {seed}: array diverged from model"
+    assert set(state_x[2].keys()) == gen.expected_map_keys(), (
+        f"seed {seed}: map keys diverged from model"
+    )
+
+    sv_x = doc_x.store.get_state_vector()
+    enc_x = encode_state_as_update(doc_x)
+
+    for perm in range(2):
+        shuffled = updates[:]
+        random.Random(seed * 1000 + perm).shuffle(shuffled)
+        doc_y = _apply_all(shuffled)
+        assert _state_of(doc_y) == state_x, f"seed {seed} perm {perm}: state diverged"
+        assert doc_y.store.get_state_vector() == sv_x, (
+            f"seed {seed} perm {perm}: state vector diverged"
+        )
+        assert encode_state_as_update(doc_y) == enc_x, (
+            f"seed {seed} perm {perm}: encode diverged"
+        )
+
+    # decode -> integrate -> re-encode fixpoint
+    doc_w = Doc()
+    apply_update(doc_w, enc_x)
+    assert _state_of(doc_w) == state_x, f"seed {seed}: fixpoint state diverged"
+    assert encode_state_as_update(doc_w) == enc_x, f"seed {seed}: re-encode not a fixpoint"
+
+
+_SEEDS = int(os.environ.get("FUZZ_WIRE_SEEDS", 1000))
+_OPS = int(os.environ.get("FUZZ_WIRE_OPS", 28))
+_BATCHES = 20
+
+
+@pytest.mark.parametrize("batch", range(_BATCHES))
+def test_wire_conformance_sweep(batch: int) -> None:
+    per_batch = max(_SEEDS // _BATCHES, 1)
+    for i in range(per_batch):
+        _run_seed(batch * per_batch + i, _OPS)
