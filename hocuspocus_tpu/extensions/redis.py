@@ -93,6 +93,12 @@ class Redis(Extension):
             self.sub = ClusterSubscriber(nodes, on_message=self._handle_incoming_message)
         else:
             self.sub = RedisSubscriber(host, port, on_message=self._handle_incoming_message)
+        # resync on self-healed resubscribe: frames published while this
+        # instance's subscriber was down/reconnecting are gone forever
+        # (pub/sub is at-most-once) — publishing our SyncStep1 per loaded
+        # doc makes peers send back whatever we missed (and vice versa)
+        if hasattr(self.sub, "on_reconnect"):
+            self.sub.on_reconnect = self._resync_after_reconnect
         self.instance = None
         # plane-served docs: last anti-entropy SyncStep1 publish per
         # doc, plus trailing timers so a QUIESCENT doc still gets one
@@ -147,6 +153,23 @@ class Redis(Extension):
         await self.pub.publish(
             self.get_key(document_name), self.encode_message(sync_message.to_bytes())
         )
+
+    async def _resync_after_reconnect(self) -> None:
+        """Subscriber self-healed after an outage: pull missed state.
+
+        Publishing SyncStep1 (our state vector) per loaded doc makes
+        every peer reply Step2 with what we lack + their own Step1, so
+        both directions close the at-most-once gap. Awareness states
+        are re-requested the same way. Best-effort: a doc that fails
+        here heals on its next change exchange."""
+        if self.instance is None:
+            return
+        for name, document in list(self.instance.documents.items()):
+            try:
+                await self.publish_first_sync_step(name, document)
+                await self.request_awareness_from_other_instances(name)
+            except Exception:
+                logger.log_error(f"[redis] post-reconnect resync failed for {name!r}")
 
     async def request_awareness_from_other_instances(self, document_name: str) -> None:
         message = OutgoingMessage(document_name).write_query_awareness()

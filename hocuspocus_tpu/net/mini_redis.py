@@ -43,6 +43,17 @@ class MiniRedis:
         # slot space; keyed commands off this node's ranges answer MOVED,
         # publishes fan out to every node's subscribers (the cluster bus)
         self.cluster_ranges: Optional[list[tuple[int, int, "MiniRedis"]]] = None
+        # fault injection (tests): PUBLISH silently drops the next N
+        # messages — models real pub/sub's at-most-once delivery, which
+        # the extension's anti-entropy must heal. When drop_channel is
+        # set, only publishes to that channel count (determinism: an
+        # unrelated keepalive can't eat the injected fault)
+        self.drop_publishes = 0
+        self.drop_channel: Optional[bytes] = None
+        # keys mid-migration (ASK emulation): a keyed command on such a
+        # key answers -ASK <slot> target; the target executes it only
+        # on an ASKING-flagged connection, like a real resharding window
+        self.migrating: dict[bytes, "MiniRedis"] = {}
 
     def configure_cluster(self, ranges: list[tuple[int, int, "MiniRedis"]]) -> None:
         self.cluster_ranges = ranges
@@ -97,6 +108,7 @@ class MiniRedis:
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         subscribed: set[bytes] = set()
+        asking = False  # one-shot ASKING flag (consumed by next keyed command)
         self._conns.add(writer)
         try:
             while True:
@@ -116,15 +128,28 @@ class MiniRedis:
                 elif command == b"EVAL" and len(args) > 2 and int(args[1]) > 0:
                     routed_key = args[2]
                 if routed_key is not None:
+                    was_asking, asking = asking, False
+                    target = self.migrating.get(routed_key)
+                    if target is not None:
+                        # slot migration window: the source answers ASK
+                        writer.write(
+                            b"-ASK %d %s:%d\r\n"
+                            % (key_hash_slot(routed_key), target.host.encode(), target.port)
+                        )
+                        await writer.drain()
+                        continue
                     owner = self._owns(routed_key)
-                    if owner is not None:
+                    if owner is not None and not was_asking:
                         writer.write(
                             b"-MOVED %d %s:%d\r\n"
                             % (key_hash_slot(routed_key), owner.host.encode(), owner.port)
                         )
                         await writer.drain()
                         continue
-                if command == b"PING":
+                if command == b"ASKING":
+                    asking = True
+                    writer.write(b"+OK\r\n")
+                elif command == b"PING":
                     writer.write(b"+PONG\r\n")
                 elif command == b"CLUSTER" and args and args[0].upper() == b"SLOTS":
                     if self.cluster_ranges is None:
@@ -198,6 +223,16 @@ class MiniRedis:
                         writer.write(b"-ERR unsupported script\r\n")
                 elif command == b"PUBLISH":
                     channel, payload = args[0], args[1]
+                    if self.drop_publishes > 0 and (
+                        self.drop_channel is None or channel == self.drop_channel
+                    ):
+                        # injected fault: the frame vanishes in flight
+                        # (subscriber never sees it; publisher is none
+                        # the wiser — pub/sub is at-most-once)
+                        self.drop_publishes -= 1
+                        writer.write(b":0\r\n")
+                        await writer.drain()
+                        continue
                     delivered = self._deliver(channel, payload)
                     if self.cluster_ranges is not None:
                         # cluster bus: published messages reach every

@@ -174,6 +174,14 @@ class RedisClient(RedisCommands):
     def connected(self) -> bool:
         return self.writer is not None and not self.writer.is_closing()
 
+    def _drop_connection(self) -> None:
+        if self.writer is not None:
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+        self.reader = self.writer = None
+
     async def execute(self, *args: Union[bytes, str, int, float], key=None) -> Any:
         # connect under the same lock that serializes stream use: a
         # concurrent execute (or a close() racing the connected check)
@@ -184,11 +192,26 @@ class RedisClient(RedisCommands):
                 # racing teardown) must fail, not silently reopen a
                 # connection nobody will ever close
                 raise ConnectionError("redis client closed")
-            if not self.connected:
-                await self.connect()
-            self.writer.write(encode_command(*args))
-            await self.writer.drain()
-            return await read_reply(self.reader)
+            # retry ONCE on a fresh socket: after a server restart the
+            # old transport still reports connected (is_closing() only
+            # flips on first failed IO), so the first command after an
+            # outage would otherwise just die with "Connection lost".
+            # One retry is safe for this client's command set: PUBLISH
+            # is at-most-once anyway; SET NX / EVAL compare-and-del
+            # re-runs fail toward NOT holding the lock
+            for attempt in (0, 1):
+                try:
+                    if not self.connected:
+                        await self.connect()
+                    self.writer.write(encode_command(*args))
+                    await self.writer.drain()
+                    return await read_reply(self.reader)
+                except RespError:
+                    raise  # a server REPLY, not a transport failure
+                except (OSError, ConnectionError, asyncio.IncompleteReadError):
+                    self._drop_connection()
+                    if attempt:
+                        raise
 
     async def execute_many(self, commands: list[tuple]) -> list[Any]:
         """Pipeline several commands atomically on this connection (no
@@ -198,18 +221,26 @@ class RedisClient(RedisCommands):
         async with self._lock:
             if self._closed:
                 raise ConnectionError("redis client closed")
-            if not self.connected:
-                await self.connect()
-            for command in commands:
-                self.writer.write(encode_command(*command))
-            await self.writer.drain()
-            replies: list[Any] = []
-            for _ in commands:
+            for attempt in (0, 1):
+                replies: list[Any] = []
                 try:
-                    replies.append(await read_reply(self.reader))
-                except RespError as error:
-                    replies.append(error)
-            return replies
+                    if not self.connected:
+                        await self.connect()
+                    for command in commands:
+                        self.writer.write(encode_command(*command))
+                    await self.writer.drain()
+                    for _ in commands:
+                        try:
+                            replies.append(await read_reply(self.reader))
+                        except RespError as error:
+                            replies.append(error)
+                    return replies
+                except (OSError, ConnectionError, asyncio.IncompleteReadError):
+                    self._drop_connection()
+                    # retry only when NO reply was consumed (otherwise a
+                    # partial pipeline could double-execute a command)
+                    if attempt or replies:
+                        raise
 
     def close(self) -> None:
         self._closed = True
@@ -341,6 +372,9 @@ class RedisSubscriber:
         host: str = "127.0.0.1",
         port: int = 6379,
         on_message: Optional[Callable[[bytes, bytes], None]] = None,
+        reconnect: bool = True,
+        reconnect_delay: float = 0.25,
+        reconnect_max_delay: float = 5.0,
     ) -> None:
         self.host = host
         self.port = port
@@ -352,6 +386,19 @@ class RedisSubscriber:
         self.channels: set[bytes] = set()
         self._conn_lock = asyncio.Lock()
         self._closed = False
+        # a dead read loop on an IDLE subscriber must heal itself: the
+        # extension only touches the subscriber on doc load/unload, so
+        # without this a Redis restart leaves every already-loaded doc
+        # deaf to cross-instance updates until the next load
+        self.reconnect = reconnect
+        self.reconnect_delay = reconnect_delay
+        self.reconnect_max_delay = reconnect_max_delay
+        self._reconnect_task: Optional[asyncio.Task] = None
+        # awaited after a SELF-HEALED reconnect: pub/sub is at-most-once,
+        # so anything published during the outage/reconnect window is
+        # gone — the owner hooks a resync here (e.g. the Redis extension
+        # publishes SyncStep1 per loaded doc to pull what it missed)
+        self.on_reconnect: Optional[Callable[[], Any]] = None
 
     async def connect(self) -> "RedisSubscriber":
         # concurrent subscribes during startup must not each open a
@@ -366,6 +413,15 @@ class RedisSubscriber:
                 return self
             if self._reader_task is not None:
                 self._reader_task.cancel()
+            if self.writer is not None:
+                # a half-closed server FIN leaves is_closing() False; the
+                # dead transport must be closed, not just overwritten, or
+                # every self-healed reconnect leaks one socket
+                try:
+                    self.writer.close()
+                except Exception:
+                    pass
+                self.reader = self.writer = None
             reader, writer = await asyncio.open_connection(self.host, self.port)
             if self._closed:  # close() landed while the socket opened
                 writer.close()
@@ -414,8 +470,43 @@ class RedisSubscriber:
                     waiter = self._subscribed.pop(channel, None)
                     if waiter is not None and not waiter.done():
                         waiter.set_result(True)
-        except (ConnectionError, asyncio.IncompleteReadError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            return  # deliberate teardown/replacement: no reconnect
+        except (OSError, asyncio.IncompleteReadError):
+            # OSError, not just ConnectionError: an ETIMEDOUT keepalive
+            # death raises TimeoutError (an OSError), and a loop that
+            # doesn't catch it never reaches the reconnect below
             pass
+        # the connection died underneath us (server restart, half-close)
+        if not self._closed and self.reconnect and self.channels:
+            self._schedule_reconnect()
+
+    def _schedule_reconnect(self) -> None:
+        if self._reconnect_task is not None and not self._reconnect_task.done():
+            return
+        self._reconnect_task = asyncio.ensure_future(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        delay = self.reconnect_delay
+        while not self._closed and not self.connected and self.channels:
+            await asyncio.sleep(delay)
+            try:
+                await self.connect()  # connect() re-issues every SUBSCRIBE
+            except (OSError, ConnectionError):
+                delay = min(delay * 2, self.reconnect_max_delay)
+                continue
+            if self.on_reconnect is not None:
+                try:
+                    result = self.on_reconnect()
+                    if asyncio.iscoroutine(result):
+                        await result
+                except Exception:
+                    pass  # resync is best-effort; the next change heals
+            # loop (don't return): if the fresh connection died while
+            # on_reconnect was awaited, the new read loop's
+            # _schedule_reconnect() no-oped because THIS task was still
+            # running — the while condition is the only re-check
+            delay = self.reconnect_delay
 
     async def _send(self, *args: Union[bytes, str]) -> None:
         if not self.connected:
@@ -443,6 +534,9 @@ class RedisSubscriber:
         if self._reader_task is not None:
             self._reader_task.cancel()
             self._reader_task = None
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
+            self._reconnect_task = None
         if self.writer is not None:
             self.writer.close()
             self.writer = None

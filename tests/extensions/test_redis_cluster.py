@@ -295,3 +295,44 @@ async def test_lock_released_when_store_chain_fails():
         provider.destroy()
         await server.destroy()
         await redis.stop()
+
+
+async def test_cluster_ask_redirect_during_slot_migration():
+    """Mid-resharding, the source node answers -ASK for a migrating
+    key; the client must send ASKING + the command to the target as an
+    atomic pair (mini_redis only honors the command on an ASKING-flagged
+    connection, like real cluster IMPORTING state). When the migration
+    completes and ownership flips, a MOVED + slot refresh takes over."""
+    nodes = await _mini_cluster(2)
+    try:
+        client = RedisClusterClient([(n.host, n.port) for n in nodes])
+        await client.set("mig-key", b"v1")
+        source = next(n for n in nodes if b"mig-key" in n.data)
+        target = next(n for n in nodes if n is not source)
+
+        # migration window: the key already lives on the target; the
+        # source answers ASK until the slot flips
+        target.data[b"mig-key"] = source.data.pop(b"mig-key")
+        source.migrating[b"mig-key"] = target
+        assert await client.get("mig-key") == b"v1"
+
+        # writes during the window follow ASK too (and land on target)
+        await client.set("mig-key", b"v2")
+        assert target.data[b"mig-key"][0] == b"v2"
+        assert b"mig-key" not in source.data
+
+        # migration completes: slot reassigned to target, ASK state gone
+        del source.migrating[b"mig-key"]
+        slot = key_hash_slot("mig-key")
+        new_ranges = []
+        for start, end, node in source.cluster_ranges:
+            owner = target if start <= slot <= end else node
+            new_ranges.append((start, end, owner))
+        for node in nodes:
+            node.configure_cluster(new_ranges)
+        # stale client map now routes to source -> MOVED -> refresh
+        assert await client.get("mig-key") == b"v2"
+        client.close()
+    finally:
+        for n in nodes:
+            await n.stop()

@@ -1,0 +1,170 @@
+"""Real-Redis conformance suite (round-4 verdict item 4a).
+
+mini_redis is the repo's own model of Redis — a closed loop. This
+module runs the SAME client/extension machinery against a real server
+the moment one exists: set REDIS_HOST (and optionally REDIS_PORT) and
+every test here runs; unset, the module skips. Mirrors the reference's
+real-Redis harness (`/root/reference/docker-compose.yml`,
+`tests/utils/flushRedis.ts` — flush between tests) so the suite is
+ready the instant a `redis-server` lands in the image or CI gets a
+service container:
+
+    REDIS_HOST=127.0.0.1 python -m pytest tests/extensions/test_redis_real.py
+
+Covers the protocol surfaces a mini_redis quirk could mask: RESP
+replies for every command the extension issues (SET NX PX, EVAL
+scripts, PUBLISH/SUBSCRIBE), lock TTL behavior under real expiry, and
+the full two-instance fan-out.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from hocuspocus_tpu.extensions import Redis
+from hocuspocus_tpu.net.resp import RedisClient, RedisSubscriber
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("REDIS_HOST"),
+    reason="set REDIS_HOST (and optionally REDIS_PORT) to run against a real Redis",
+)
+
+HOST = os.environ.get("REDIS_HOST", "127.0.0.1")
+PORT = int(os.environ.get("REDIS_PORT", 6379))
+
+
+def _assert(cond):
+    assert cond
+
+
+@pytest.fixture(autouse=True)
+async def _flush():
+    """flushRedis.ts parity: every test starts from an empty keyspace."""
+    client = RedisClient(HOST, PORT)
+    await client.flushall()
+    client.close()
+    yield
+
+
+async def test_real_resp_command_conformance():
+    """The exact command set the extension issues, against real RESP:
+    a reply-shape quirk mini_redis doesn't model fails HERE."""
+    client = RedisClient(HOST, PORT)
+    try:
+        assert await client.ping()
+        await client.set("k", b"v")
+        assert await client.get("k") == b"v"
+        assert await client.get("missing") is None
+
+        # SET NX semantics
+        assert await client.acquire_lock("lk", "tok-1", 60_000)
+        assert not await client.acquire_lock("lk", "tok-2", 60_000)
+        # compare-and-del release: wrong token must NOT release
+        assert not await client.release_lock("lk", "tok-2")
+        assert await client.release_lock("lk", "tok-1")
+        assert await client.acquire_lock("lk", "tok-2", 60_000)
+        # extend: only the holder's token extends
+        assert await client.extend_lock("lk", "tok-2", 60_000)
+        assert not await client.extend_lock("lk", "wrong", 60_000)
+        assert await client.release_lock("lk", "tok-2")
+    finally:
+        client.close()
+
+
+async def test_real_lock_px_expiry():
+    """PX ttl is enforced by the real server clock."""
+    client = RedisClient(HOST, PORT)
+    other = RedisClient(HOST, PORT)
+    try:
+        assert await client.acquire_lock("exp", "tok", 150)
+        assert not await other.acquire_lock("exp", "tok2", 60_000)
+        # poll until the server expires the key (no wall-clock margin)
+        async def expired():
+            assert await other.acquire_lock("exp", "tok2", 60_000)
+
+        for _ in range(60):
+            try:
+                await expired()
+                break
+            except AssertionError:
+                await asyncio.sleep(0.05)
+        else:
+            raise AssertionError("PX ttl never expired on the real server")
+        assert await other.release_lock("exp", "tok2")
+    finally:
+        client.close()
+        other.close()
+
+
+async def test_real_pubsub_roundtrip_and_unsubscribe():
+    received = []
+    sub = RedisSubscriber(
+        host=HOST, port=PORT, on_message=lambda ch, data: received.append((ch, data))
+    )
+    await sub.connect()
+    await sub.subscribe("real-chan")
+    client = RedisClient(HOST, PORT)
+    try:
+        await client.publish("real-chan", b"one")
+        await retryable_assertion(lambda: _assert(received == [(b"real-chan", b"one")]))
+        await sub.unsubscribe("real-chan")
+        await client.publish("real-chan", b"after-unsub")
+        await client.publish("other", b"x")  # flush ordering marker
+        await asyncio.sleep(0.1)
+        assert received == [(b"real-chan", b"one")], "received after unsubscribe"
+    finally:
+        sub.close()
+        client.close()
+
+
+async def test_real_two_instance_fanout_and_store_lock():
+    """The headline topology on a real Redis: fan-out + single storer."""
+    stores = []
+    from hocuspocus_tpu.extensions import Database
+
+    def make_ext(ident):
+        return Redis(
+            host=HOST, port=PORT, identifier=ident, disconnect_delay=100,
+            lock_timeout=5000,
+        )
+
+    async def store(data):
+        stores.append(1)
+
+    server_a = await new_hocuspocus(
+        extensions=[make_ext("real-a"), Database(store=store)], debounce=100
+    )
+    server_b = await new_hocuspocus(
+        extensions=[make_ext("real-b"), Database(store=store)], debounce=100
+    )
+    provider_a = new_provider(server_a, name="real-doc")
+    provider_b = new_provider(server_b, name="real-doc")
+    try:
+        await wait_synced(provider_a, provider_b)
+        provider_a.document.get_text("t").insert(0, "over real redis")
+        await retryable_assertion(
+            lambda: _assert(
+                provider_b.document.get_text("t").to_string() == "over real redis"
+            )
+        )
+        # awareness crosses too
+        provider_a.awareness.set_local_state({"user": {"name": "real"}})
+        await retryable_assertion(
+            lambda: _assert(
+                any(
+                    s.get("user", {}).get("name") == "real"
+                    for s in provider_b.awareness.get_states().values()
+                )
+            )
+        )
+        # the store lock elects a single storer per debounce window
+        await retryable_assertion(lambda: _assert(len(stores) >= 1))
+        await asyncio.sleep(0.4)
+        assert len(stores) == 1, f"double store on real redis: {stores}"
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server_a.destroy()
+        await server_b.destroy()
