@@ -2,20 +2,23 @@
 
 examples/browser/index.html speaks the wire protocol with a hand-rolled
 client (lib0 frames, auth submessage, SyncStep1/2/Update, a per-unit
-YATA text CRDT). No JS runtime exists in this image, so this test
-translates that client 1:1 (same frame layout, same single-struct
-update encoding, same ds-only deletes, same stored-origin full-state
-reply to the server's SyncStep1) and drives it over a raw websocket —
-pinning every protocol interaction the page performs against the real
-server, alongside a standard provider.
+YATA rich-text CRDT with ContentFormat markers, y-awareness cursor
+states). No JS runtime exists in this image, so this test translates
+that client 1:1 (same frame layout, same single-struct update encoding,
+same ds-only deletes, same stored-origin full-state reply to the
+server's SyncStep1, same awareness payloads) and drives it over a raw
+websocket — pinning every protocol interaction the page performs
+against the real server, alongside a standard provider.
 
 Reference counterpart: the playground frontend's provider traffic
-(`/root/reference/playground/frontend`) through
+(`/root/reference/playground/frontend`, Tiptap bold/italic marks +
+collaboration-cursor) through
 `packages/server/src/ClientConnection.ts:279-343` (auth queueing) and
-`MessageReceiver.ts:137-213` (sync handshake).
+`MessageReceiver.ts:137-213` (sync handshake, awareness fan-out).
 """
 
 import asyncio
+import json
 import random
 
 import aiohttp
@@ -24,7 +27,8 @@ from hocuspocus_tpu.crdt.encoding import Decoder, Encoder
 from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
 
 ROOT = "body"
-MSG_SYNC, MSG_AUTH, MSG_SYNC_REPLY, MSG_SYNC_STATUS = 0, 2, 4, 8
+MSG_SYNC, MSG_AWARENESS, MSG_AUTH, MSG_QUERY_AWARENESS = 0, 1, 2, 3
+MSG_SYNC_REPLY, MSG_SYNC_STATUS = 4, 8
 STEP1, STEP2, UPDATE = 0, 1, 2
 
 
@@ -33,11 +37,12 @@ def _assert(cond):
 
 
 class _Unit:
-    __slots__ = ("c", "k", "ch", "deleted", "oc", "ok")
+    __slots__ = ("c", "k", "kind", "ch", "fk", "fv", "deleted", "oc", "ok")
 
-    def __init__(self, c, k, ch, oc, ok):
+    def __init__(self, c, k, ch, oc, ok, kind="ch", fk=None, fv=None):
         self.c, self.k, self.ch = c, k, ch
         self.oc, self.ok = oc, ok
+        self.kind, self.fk, self.fv = kind, fk, fv
         self.deleted = False
 
 
@@ -53,6 +58,9 @@ class BrowserMirrorClient:
         self.pending: list = []
         self.pending_deletes: list = []  # (client, clock, len) awaiting targets
         self.synced = False
+        self.aw_clock = 0
+        # awareness: clientId -> {"clock": int, "state": dict}
+        self.remote_states: dict[int, dict] = {}
         self._session = None
         self._ws = None
         self._reader_task = None
@@ -97,17 +105,18 @@ class BrowserMirrorClient:
                 break
         inserted = []
         for j in range(off, length):
-            inserted.append(
-                _Unit(
-                    c,
-                    k + j,
-                    0 if text is None else ord(text[j]),
-                    oc if j == 0 else c,
-                    ok if j == 0 else k + j - 1,
+            j_oc = oc if j == 0 else c
+            j_ok = ok if j == 0 else k + j - 1
+            if isinstance(text, tuple):  # ("fmt", key, value) marker
+                inserted.append(
+                    _Unit(c, k + j, 0, j_oc, j_ok, kind="fmt", fk=text[1], fv=text[2])
                 )
-            )
-            if text is None:
-                inserted[-1].deleted = True
+            else:
+                inserted.append(
+                    _Unit(c, k + j, 0 if text is None else ord(text[j]), j_oc, j_ok)
+                )
+                if text is None:
+                    inserted[-1].deleted = True
         self.units[dest:dest] = inserted
         self.known[c] = k + length
         return True
@@ -134,7 +143,76 @@ class BrowserMirrorClient:
                 self.pending_deletes.remove(entry)
 
     def text(self) -> str:
-        return "".join(chr(u.ch) for u in self.units if not u.deleted)
+        return "".join(
+            chr(u.ch) for u in self.units if not u.deleted and u.kind == "ch"
+        )
+
+    def rich_chars(self) -> list:
+        """Visible chars with accumulated format attributes (mirrors
+        the page's richChars(): a live ContentFormat marker flips the
+        attribute for everything after it)."""
+        out, attrs = [], {}
+        for u in self.units:
+            if u.deleted:
+                continue
+            if u.kind == "fmt":
+                if u.fv is None:
+                    attrs.pop(u.fk, None)
+                else:
+                    attrs[u.fk] = u.fv
+            else:
+                out.append((chr(u.ch), dict(attrs), u))
+        return out
+
+    def rich_spans(self) -> list:
+        """Coalesced (text, attrs) runs — comparable to YText.to_delta()."""
+        spans = []
+        for ch, attrs, _u in self.rich_chars():
+            if spans and spans[-1][1] == attrs:
+                spans[-1][0] += ch
+            else:
+                spans.append([ch, attrs])
+        return [(s, a) for s, a in spans]
+
+    def attrs_at_boundary(self, pos: int) -> dict:
+        """Attributes active for the char AT visible index pos (markers
+        between char pos-1 and char pos included)."""
+        attrs, seen = {}, 0
+        for u in self.units:
+            if u.deleted:
+                continue
+            if u.kind == "fmt":
+                if u.fv is None:
+                    attrs.pop(u.fk, None)
+                else:
+                    attrs[u.fk] = u.fv
+                continue
+            if seen == pos:
+                break
+            seen += 1
+        return attrs
+
+    def _unit_index_of_visible(self, pos: int) -> int:
+        seen = 0
+        for i, u in enumerate(self.units):
+            if u.deleted or u.kind == "fmt":
+                continue
+            if seen == pos:
+                return i
+            seen += 1
+        return len(self.units)
+
+    def rel_of_offset(self, pos: int):
+        chars = self.rich_chars()
+        return [chars[pos][2].c, chars[pos][2].k] if pos < len(chars) else None
+
+    def offset_of_rel(self, rel):
+        if rel is None:
+            return len(self.rich_chars())
+        for i, (_ch, _attrs, u) in enumerate(self.rich_chars()):
+            if u.c == rel[0] and u.k == rel[1]:
+                return i
+        return None
 
     # -- v1 codec (mirrors decodeUpdateAndApply / encodeRun / full state) ----
 
@@ -172,6 +250,10 @@ class BrowserMirrorClient:
                     length = len(text)
                 elif ref == 1:
                     text, length = None, d.read_var_uint()
+                elif ref == 6:  # ContentFormat: key + JSON value, 1 clock
+                    key = d.read_var_string()
+                    value = json.loads(d.read_var_string())
+                    text, length = ("fmt", key, value), 1
                 else:
                     raise AssertionError(f"unsupported ref {ref}")
                 run = (client, clock, text, length, oc, ok, rc, rk)
@@ -188,13 +270,13 @@ class BrowserMirrorClient:
         self._drain_pending()
 
     @staticmethod
-    def _encode_run(e: Encoder, run):
-        c, k, text, _length, oc, ok, rc, rk = run
-        e.write_var_uint(1)
-        e.write_var_uint(1)
-        e.write_var_uint(c)
-        e.write_var_uint(k)
-        info = 0x04 | (0x80 if oc is not None else 0) | (0x40 if rc is not None else 0)
+    def _write_content(e: Encoder, oc, ok, rc, rk, text):
+        """Info byte + origins + (root parent when originless) + payload;
+        shared by _encode_run and _encode_full_state (mirrors the page's
+        writeContent). `text` is a str (ContentString) or a
+        ("fmt", key, value) tuple (ContentFormat)."""
+        ref = 0x06 if isinstance(text, tuple) else 0x04
+        info = ref | (0x80 if oc is not None else 0) | (0x40 if rc is not None else 0)
         e.write_uint8(info)
         if oc is not None:
             e.write_var_uint(oc), e.write_var_uint(ok)
@@ -203,7 +285,20 @@ class BrowserMirrorClient:
         if oc is None and rc is None:
             e.write_var_uint(1)
             e.write_var_string(ROOT)
-        e.write_var_string(text)
+        if isinstance(text, tuple):
+            e.write_var_string(text[1])
+            e.write_var_string(json.dumps(text[2], separators=(",", ":")))
+        else:
+            e.write_var_string(text)
+
+    @staticmethod
+    def _encode_run(e: Encoder, run):
+        c, k, text, _length, oc, ok, rc, rk = run
+        e.write_var_uint(1)
+        e.write_var_uint(1)
+        e.write_var_uint(c)
+        e.write_var_uint(k)
+        BrowserMirrorClient._write_content(e, oc, ok, rc, rk, text)
 
     def _encode_full_state(self, sv: dict) -> bytes:
         e = Encoder()
@@ -219,14 +314,8 @@ class BrowserMirrorClient:
             e.write_var_uint(c)
             e.write_var_uint(row[0].k)
             for u in row:
-                info = 0x04 | (0x80 if u.oc is not None else 0)
-                e.write_uint8(info)
-                if u.oc is not None:
-                    e.write_var_uint(u.oc), e.write_var_uint(u.ok)
-                else:
-                    e.write_var_uint(1)
-                    e.write_var_string(ROOT)
-                e.write_var_string(chr(u.ch))
+                text = ("fmt", u.fk, u.fv) if u.kind == "fmt" else chr(u.ch)
+                self._write_content(e, u.oc, u.ok, None, 0, text)
         ds: dict[int, list] = {}
         for u in self.units:
             if u.deleted:
@@ -292,12 +381,40 @@ class BrowserMirrorClient:
                     self._apply_update(bytes(d.read_var_uint8_array()))
                     if sub == STEP2:
                         self.synced = True
+            elif msg_type == MSG_AWARENESS:
+                aw = Decoder(bytes(d.read_var_uint8_array()))
+                for _ in range(aw.read_var_uint()):
+                    cid = aw.read_var_uint()
+                    clock = aw.read_var_uint()
+                    state = json.loads(aw.read_var_string())
+                    if cid == self.client_id:
+                        continue
+                    prev = self.remote_states.get(cid)
+                    if prev is not None and clock < prev["clock"]:
+                        continue
+                    if state is None:
+                        self.remote_states.pop(cid, None)
+                    else:
+                        self.remote_states[cid] = {"clock": clock, "state": state}
+
+    async def _send_run(self, run):
+        assert self._integrate(run)
+        e = Encoder()
+        e.write_var_uint(UPDATE)
+        body = Encoder()
+        self._encode_run(body, run)
+        body.write_var_uint(0)  # trailing (empty) delete set
+        e.write_var_uint8_array(body.to_bytes())
+        await self._ws.send_bytes(self._frame(MSG_SYNC, e.to_bytes()))
 
     async def insert(self, pos: int, text: str):
-        """Insert at VISIBLE position pos, like the page's splice diff."""
-        visible = [u for u in self.units if not u.deleted]
-        left = visible[pos - 1] if pos > 0 else None
-        right = visible[pos] if pos < len(visible) else None
+        """Insert at VISIBLE position pos, like the page's
+        insertVisibleAt: boundaries are the unit-order neighbors of the
+        pos'th visible char (format markers and tombstones at the
+        boundary count — typing after a close-marker stays unstyled)."""
+        ia = self._unit_index_of_visible(pos)
+        left = self.units[ia - 1] if ia > 0 else None
+        right = self.units[ia] if ia < len(self.units) else None
         run = (
             self.client_id,
             self.clock,
@@ -309,17 +426,53 @@ class BrowserMirrorClient:
             right.k if right else 0,
         )
         self.clock += len(text)
-        assert self._integrate(run)
+        await self._send_run(run)
+
+    async def format_range(self, a: int, b: int, key: str, value):
+        """Mirror of the page's toggleFormat with an explicit value:
+        an opening marker {key: value} before visible char a and a
+        closing marker restoring the boundary state before char b."""
+        after_val = self.attrs_at_boundary(b).get(key)
+        ia = self._unit_index_of_visible(a)
+        ib = self._unit_index_of_visible(b)
+        left1 = self.units[ia - 1] if ia > 0 else None
+        right1 = self.units[ia] if ia < len(self.units) else None
+        left2 = self.units[ib - 1] if ib > 0 else None
+        right2 = self.units[ib] if ib < len(self.units) else None
+        markers = [(left1, right1, value)]
+        if json.dumps(after_val) != json.dumps(value):
+            markers.append((left2, right2, after_val))
+        for left, right, val in markers:
+            run = (
+                self.client_id,
+                self.clock,
+                ("fmt", key, val),
+                1,
+                left.c if left else None,
+                left.k if left else 0,
+                right.c if right else None,
+                right.k if right else 0,
+            )
+            self.clock += 1
+            await self._send_run(run)
+
+    async def send_awareness(self, state):
+        """One-client awareness update (protocol/awareness.py layout)."""
+        self.aw_clock += 1
+        aw = Encoder()
+        aw.write_var_uint(1)
+        aw.write_var_uint(self.client_id)
+        aw.write_var_uint(self.aw_clock)
+        aw.write_var_string(json.dumps(state, separators=(",", ":")))
         e = Encoder()
-        e.write_var_uint(UPDATE)
-        body = Encoder()
-        self._encode_run(body, run)
-        body.write_var_uint(0)  # trailing (empty) delete set
-        e.write_var_uint8_array(body.to_bytes())
-        await self._ws.send_bytes(self._frame(MSG_SYNC, e.to_bytes()))
+        e.write_var_uint8_array(aw.to_bytes())
+        await self._ws.send_bytes(self._frame(MSG_AWARENESS, e.to_bytes()))
+
+    async def query_awareness(self):
+        await self._ws.send_bytes(self._frame(MSG_QUERY_AWARENESS))
 
     async def delete(self, pos: int, length: int):
-        visible = [u for u in self.units if not u.deleted]
+        visible = [u for u in self.units if not u.deleted and u.kind == "ch"]
         doomed = visible[pos : pos + length]
         for u in doomed:
             u.deleted = True
@@ -472,4 +625,190 @@ async def test_cold_sync_with_cross_section_delete_and_tombstones():
     finally:
         for c in (high, low, late):
             await c.close()
+        await server.destroy()
+
+
+async def test_rich_format_roundtrip_with_provider():
+    """The page's toggleFormat markers land as real ContentFormat in the
+    server's YText (to_delta sees attributes), and a provider-side
+    YText.format comes back as markers the page's span model renders."""
+    server = await new_hocuspocus()
+    browser = BrowserMirrorClient()
+    provider = new_provider(server, name="browser-demo")
+    try:
+        await wait_synced(provider)
+        await browser.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(browser.synced))
+
+        await browser.insert(0, "hello world")
+        await browser.format_range(0, 5, "bold", True)
+
+        def _delta_has_bold():
+            delta = provider.document.get_text(ROOT).to_delta()
+            _assert(
+                delta
+                == [
+                    {"insert": "hello", "attributes": {"bold": True}},
+                    {"insert": " world"},
+                ]
+            )
+
+        await retryable_assertion(_delta_has_bold)
+
+        # provider styles through the real YText API; the page's
+        # accumulated-attrs span model must agree
+        provider.document.get_text(ROOT).format(6, 5, {"italic": True})
+        await retryable_assertion(
+            lambda: _assert(
+                browser.rich_spans()
+                == [
+                    ("hello", {"bold": True}),
+                    (" ", {}),
+                    ("world", {"italic": True}),
+                ]
+            )
+        )
+
+        # toggling OFF: a null-valued marker clears the attribute.
+        # (compare COALESCED spans: to_delta legitimately splits ops at
+        # every marker boundary, styled or not)
+        def _spans(delta):
+            spans = []
+            for op in delta:
+                attrs = op.get("attributes", {})
+                if spans and spans[-1][1] == attrs:
+                    spans[-1][0] += op["insert"]
+                else:
+                    spans.append([op["insert"], attrs])
+            return [(s, a) for s, a in spans]
+
+        await browser.format_range(0, 5, "bold", None)
+        await retryable_assertion(
+            lambda: _assert(
+                _spans(provider.document.get_text(ROOT).to_delta())
+                == [
+                    ("hello ", {}),
+                    ("world", {"italic": True}),
+                ]
+            )
+        )
+    finally:
+        await browser.close()
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_two_tabs_rich_formatting_converges():
+    """Two 'tabs' agree on styled spans; typing inside a bold range
+    inherits bold, typing after the close marker stays unstyled."""
+    server = await new_hocuspocus()
+    tab_a = BrowserMirrorClient()
+    tab_b = BrowserMirrorClient()
+    try:
+        await tab_a.connect(server.web_socket_url)
+        await tab_b.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(tab_a.synced and tab_b.synced))
+
+        await tab_a.insert(0, "fat text")
+        await retryable_assertion(lambda: _assert(tab_b.text() == "fat text"))
+        await tab_a.format_range(0, 3, "bold", True)
+        await retryable_assertion(
+            lambda: _assert(
+                tab_b.rich_spans() == [("fat", {"bold": True}), (" text", {})]
+            )
+        )
+
+        # tab B types INSIDE the bold range -> inherits bold everywhere
+        await tab_b.insert(1, "l")
+        await retryable_assertion(
+            lambda: _assert(
+                tab_a.rich_spans()
+                == tab_b.rich_spans()
+                == [("flat", {"bold": True}), (" text", {})]
+            )
+        )
+
+        # typing at the right edge lands AFTER the close marker (the
+        # unit-order boundary) -> unstyled in both tabs
+        await tab_a.insert(4, "X")
+        await retryable_assertion(
+            lambda: _assert(
+                tab_a.rich_spans()
+                == tab_b.rich_spans()
+                == [("flat", {"bold": True}), ("X text", {})]
+            )
+        )
+    finally:
+        await tab_a.close()
+        await tab_b.close()
+        await server.destroy()
+
+
+async def test_awareness_cursors_roundtrip():
+    """The page's awareness frames (user chip + relative-ref cursor)
+    reach a standard provider, the provider's state reaches the page,
+    and a late tab discovers everyone via QueryAwareness."""
+    server = await new_hocuspocus()
+    browser = BrowserMirrorClient()
+    provider = new_provider(server, name="browser-demo")
+    late = BrowserMirrorClient()
+    try:
+        await wait_synced(provider)
+        await browser.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(browser.synced))
+
+        await browser.insert(0, "abc")
+        cursor = {"a": browser.rel_of_offset(1), "h": browser.rel_of_offset(1)}
+        await browser.send_awareness(
+            {"user": {"name": "pearl-7", "color": "#123456"}, "cursor": cursor}
+        )
+
+        def _provider_sees_browser():
+            states = provider.awareness.get_states()
+            state = states.get(browser.client_id)
+            _assert(state is not None)
+            _assert(state["user"]["name"] == "pearl-7")
+            # the relative ref survives verbatim (opaque JSON to the server)
+            _assert(state["cursor"]["h"] == [browser.client_id, 1])
+
+        await retryable_assertion(_provider_sees_browser)
+
+        provider.awareness.set_local_state(
+            {"user": {"name": "prov", "color": "#654321"}, "cursor": None}
+        )
+        await retryable_assertion(
+            lambda: _assert(
+                any(
+                    s["state"].get("user", {}).get("name") == "prov"
+                    for s in browser.remote_states.values()
+                )
+            )
+        )
+
+        # a late tab pulls the room roster with QueryAwareness
+        await late.connect(server.web_socket_url)
+        await retryable_assertion(lambda: _assert(late.synced))
+        await late.query_awareness()
+        await retryable_assertion(
+            lambda: _assert(
+                {
+                    s["state"]["user"]["name"]
+                    for s in late.remote_states.values()
+                    if s["state"].get("user")
+                }
+                >= {"pearl-7", "prov"}
+            )
+        )
+
+        # the cursor's relative ref resolves to the right offset even
+        # after concurrent edits shifted absolute positions
+        await late.insert(0, "xxx")
+        await retryable_assertion(lambda: _assert(browser.text() == "xxxabc"))
+        state = provider.awareness.get_states()[browser.client_id]
+        resolved = browser.offset_of_rel(state["cursor"]["h"])
+        assert resolved == 4, f"relative cursor drifted: {resolved}"
+    finally:
+        await late.close()
+        await browser.close()
+        provider.destroy()
         await server.destroy()
