@@ -156,7 +156,13 @@ def main() -> None:
         # inherit first (normal plugin path), then JAX_PLATFORMS='' (the
         # retry the JAX init error itself suggests); two probe rounds to
         # ride out transient tunnel flakes
+        # BENCH_MAX_TPU_ATTEMPTS trims the retry ladder: under a
+        # FLAPPING tunnel (alive probe, hung execution — observed
+        # round 5) each doomed attempt eats a full ATTEMPT_TIMEOUT, so
+        # the watcher loop caps attempts per invocation and re-probes
+        # on its own cadence instead
         candidates = [None, "", None, ""]
+        candidates = candidates[: int(os.environ.get("BENCH_MAX_TPU_ATTEMPTS", 4))]
     for platforms in candidates:
         backend = _probe(platforms)
         if backend is None or backend == "cpu":
@@ -488,6 +494,10 @@ def run_bench() -> None:
         """
         return int(np.asarray(st.length).sum())
 
+    # stage logs (stderr): a hung tunnel call must be localizable from
+    # the watcher log — "timed out after 900s" alone cost a round-5
+    # alive-window; these lines say which device call ate it
+    _log(f"inner: start docs={num_docs} capacity={capacity} backend={jax.default_backend()}")
     key = jax.random.PRNGKey(0)
     state = make_empty_state(num_docs, capacity)
     next_clock = jnp.zeros((num_docs,), jnp.int32)
@@ -497,14 +507,17 @@ def run_bench() -> None:
     seed_slots = max(capacity // 4 // MAX_RUN, 1)
     key, sub = jax.random.split(key)
     next_clock, seed_ops = build_ops(sub, next_clock, seed_slots)
+    _log("inner: seed phase (first compile) ...")
     state, seed_count = integrate_op_slots_fast(state, seed_ops)
     sync(state)
+    _log("inner: seed done")
 
     # warmup/compile at the timed shape
     key, sub = jax.random.split(key)
     next_clock, ops = build_ops(sub, next_clock, k)
     state, count = integrate_op_slots_fast(state, ops)
     sync(state)
+    _log("inner: warmup compiled; timed loop ...")
 
     op_batches = []
     for _ in range(steps):
@@ -521,6 +534,7 @@ def run_bench() -> None:
     sync(state)
     elapsed = time.perf_counter() - start
     total_ops = int(sum(int(c) for c in counts))
+    _log(f"inner: timed loop done ({total_ops} ops in {elapsed:.2f}s); latency probes ...")
 
     # latency: individually timed 8-slot micro-batches, each synced to
     # host-visible results (= merge-to-broadcast readiness)
@@ -545,6 +559,7 @@ def run_bench() -> None:
     server_p99_extra = None
     server_p99_err = None
     if os.environ.get("BENCH_SERVER_P99", "1") != "0":
+        _log("inner: server p99 pass ...")
         try:
             server_p99_ms, server_p99_extra = _measure_server_p99()
         except Exception as error:  # never lose the headline number to this
@@ -554,6 +569,7 @@ def run_bench() -> None:
     # cold/stale SyncStep2s served from plane state + host logs
     catchup = None
     if os.environ.get("BENCH_CATCHUP", "1") != "0":
+        _log("inner: catch-up serving pass ...")
         try:
             catchup = _measure_catchup_serving()
         except Exception as error:
@@ -562,10 +578,12 @@ def run_bench() -> None:
     # run-length arena microbatch at the same population
     rle = None
     if os.environ.get("BENCH_RLE", "1") != "0":
+        _log("inner: RLE microbatch pass ...")
         try:
             rle = _measure_rle_microbatch(num_docs)
         except Exception as error:
             rle = {"error": repr(error)[:300]}
+    _log("inner: all passes done")
 
     merges_per_sec = total_ops / elapsed
     p99_ms = float(np.percentile(np.array(latencies) * 1000, 99))

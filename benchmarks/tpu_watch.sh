@@ -18,7 +18,9 @@ while true; do
     # budget covers every side-pass: inner 900 + scale 300 + sharded 600
     # + served-100k 1200, with slack (a timeout kill loses the whole
     # JSON — bench.py prints only at the end)
-    if timeout -k 30 3900 python bench.py > "benchmarks/results/bench_tpu_watch_${STAMP}.json" 2>>"$LOG"; then
+    # cap the retry ladder at 2: on a FLAPPING tunnel each doomed
+    # attempt eats a full 900s — this loop re-probes anyway
+    if BENCH_MAX_TPU_ATTEMPTS=2 timeout -k 30 3900 python bench.py > "benchmarks/results/bench_tpu_watch_${STAMP}.json" 2>>"$LOG"; then
       echo "[watch] bench captured: bench_tpu_watch_${STAMP}.json" >> "$LOG"
       # only keep captures that really landed on-chip THIS run — a
       # stale-capture fallback re-emits an old on-chip artifact and
