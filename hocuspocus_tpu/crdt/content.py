@@ -298,6 +298,16 @@ class ContentFormat(Content):
         parent = item.parent
         if parent is not None:
             parent._has_formatting = True
+            # search anchors are position caches for UNFORMATTED walks;
+            # once formatting exists they are never consulted again —
+            # unset the items' anchor flags and drop the list so edits
+            # stop maintaining it (yjs ContentFormat.integrate nulls
+            # _searchMarker the same way). Lazy import: content.py sits
+            # below types/ in the module graph.
+            from .types.base import clear_search_markers
+
+            clear_search_markers(parent)
+            parent._search_markers = None
 
     def write(self, encoder: Encoder, offset: int) -> None:
         encoder.write_var_string(self.key)

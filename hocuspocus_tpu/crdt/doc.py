@@ -16,6 +16,8 @@ from .delete_set import DeleteSet
 from .encoding import Encoder
 from .ids import ID
 from .structs import GC, Item, StructStore
+from .types.base import clear_search_markers
+from .update import transaction_changed, write_update_message_from_transaction
 
 
 class Observable:
@@ -168,6 +170,12 @@ def _cleanup_transactions(cleanups: list[Transaction], i: int) -> None:
     try:
         ds.sort_and_merge()
         transaction.after_state = store.get_state_vector()
+        if not transaction.local:
+            # remote structs land via integrate, not the marker-aware
+            # list ops — cached index anchors are stale wholesale
+            # (yjs AbstractType._callObserver does the same)
+            for ytype in transaction.changed:
+                clear_search_markers(ytype)
         doc.emit("beforeObserverCalls", transaction, doc)
         for ytype, subs in list(transaction.changed.items()):
             if ytype._item is None or not ytype._item.deleted:
@@ -211,8 +219,6 @@ def _cleanup_transactions(cleanups: list[Transaction], i: int) -> None:
             doc.client_id = generate_new_client_id()
         doc.emit("afterTransactionCleanup", transaction, doc)
         if doc.has_listeners("update"):
-            from .update import transaction_changed, write_update_message_from_transaction
-
             wire = transaction.meta.get("wire_update")
             if wire is not None and transaction_changed(transaction):
                 # clean remote apply (see update.apply_update): the

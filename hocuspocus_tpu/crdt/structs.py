@@ -93,6 +93,7 @@ class Item:
         "deleted",
         "keep",
         "redone",
+        "marker",  # a types.base.SearchMarker anchors here
     )
 
     def __init__(
@@ -122,6 +123,7 @@ class Item:
         self.deleted = False
         self.keep = False
         self.redone: Optional[ID] = None
+        self.marker = False
 
     @property
     def countable(self) -> bool:
@@ -344,6 +346,17 @@ class Item:
             and type(self.content) is type(right.content)
             and self.content.merge_with(right.content)
         ):
+            if right.marker:
+                # search anchors on the absorbed item rebase onto the
+                # survivor (yjs Item.mergeWith does the same)
+                markers = getattr(self.parent, "_search_markers", None)
+                if markers:
+                    for m in markers:
+                        if m.item is right:
+                            m.item = self
+                            self.marker = True
+                            if not self.deleted and self.countable:
+                                m.index -= self.length
             if right.keep:
                 self.keep = True
             self.length += right.length

@@ -42,6 +42,10 @@ class AbstractType:
         self._handlers: list[Callable] = []
         self._deep_handlers: list[Callable] = []
         self._has_formatting = False
+        # sequence types (YText/YArray/YXmlFragment) set this to [] —
+        # cached (item, visible-index) anchors that turn index->position
+        # walks from O(doc) into O(distance); None = markers disabled
+        self._search_markers: "Optional[list[SearchMarker]]" = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -93,6 +97,136 @@ class AbstractType:
 
     def __len__(self) -> int:
         return self._length
+
+
+# -- search markers --------------------------------------------------------
+#
+# Index->position lookups on the item list are linear from _start; on a
+# busy document (config1: 14M chars by the end of one bench run) every
+# local edit paid an O(doc) walk. Markers cache (item, visible-index)
+# anchors near recent edit positions, yjs ArraySearchMarker semantics
+# (vendored yjs in this image: rx/rT/rM around `maxSearchMarker`):
+# nearest-anchor lookup, refresh-or-LRU replacement, left-normalization
+# to mergeable-run starts so transaction-cleanup merges keep anchors
+# valid, incremental shifts on local edits, wholesale invalidation on
+# remote transactions and undo/redo pops (doc.py / undo.py).
+
+MAX_SEARCH_MARKERS = 16
+
+_marker_clock = 0
+
+
+class SearchMarker:
+    __slots__ = ("item", "index", "timestamp")
+
+    def __init__(self, item: Item, index: int) -> None:
+        global _marker_clock
+        _marker_clock += 1
+        item.marker = True
+        self.item = item
+        self.index = index
+        self.timestamp = _marker_clock
+
+
+def _refresh_marker(marker: SearchMarker, item: Item, index: int) -> None:
+    global _marker_clock
+    _marker_clock += 1
+    marker.item.marker = False
+    item.marker = True
+    marker.item = item
+    marker.index = index
+    marker.timestamp = _marker_clock
+
+
+def find_search_marker(parent: AbstractType, index: int) -> Optional[SearchMarker]:
+    """Anchor at (or left of) visible position `index`, or None.
+
+    The returned marker's item CONTAINS the target position with
+    marker.index <= index being the item's first visible unit; callers
+    finish with a short forward walk of (index - marker.index).
+    """
+    markers = parent._search_markers
+    if parent._start is None or index == 0 or markers is None:
+        return None
+    marker = (
+        min(markers, key=lambda m: abs(index - m.index)) if markers else None
+    )
+    item: Item = parent._start
+    idx = 0
+    if marker is not None:
+        item = marker.item
+        idx = marker.index
+        global _marker_clock
+        _marker_clock += 1
+        marker.timestamp = _marker_clock  # keep the hot anchor alive
+    while item.right is not None and idx < index:
+        if not item.deleted and item.countable:
+            if index < idx + item.length:
+                break
+            idx += item.length
+        item = item.right
+    while item.left is not None and idx > index:
+        item = item.left
+        if not item.deleted and item.countable:
+            idx -= item.length
+    # normalize to the start of the same-client run: cleanup merges
+    # absorb right halves INTO the run head, so only run-head anchors
+    # survive a merge
+    while (
+        item.left is not None
+        and item.left.id.client == item.id.client
+        and item.left.id.clock + item.left.length == item.id.clock
+    ):
+        item = item.left
+        if not item.deleted and item.countable:
+            idx -= item.length
+    if (
+        marker is not None
+        and abs(marker.index - idx) < (parent._length / MAX_SEARCH_MARKERS)
+    ):
+        _refresh_marker(marker, item, idx)
+        return marker
+    if len(markers) >= MAX_SEARCH_MARKERS:
+        oldest = min(markers, key=lambda m: m.timestamp)
+        _refresh_marker(oldest, item, idx)
+        return oldest
+    fresh = SearchMarker(item, idx)
+    markers.append(fresh)
+    return fresh
+
+
+def update_search_markers(parent: AbstractType, index: int, delta: int) -> None:
+    """Shift anchors after a LOCAL list change: `delta` visible units
+    inserted (+) or deleted (-) at visible position `index`."""
+    markers = parent._search_markers
+    if not markers:
+        return
+    for i in range(len(markers) - 1, -1, -1):
+        marker = markers[i]
+        if delta > 0:
+            # an insert may have split/tombstoned the anchored item:
+            # rebind to the nearest live countable item to the left
+            item: Optional[Item] = marker.item
+            item.marker = False
+            while item is not None and (item.deleted or not item.countable):
+                item = item.left
+                if item is not None and not item.deleted and item.countable:
+                    marker.index -= item.length
+            if item is None or item.marker:
+                del markers[i]  # dead end, or another anchor owns it
+                continue
+            marker.item = item
+            item.marker = True
+        if index < marker.index or (delta > 0 and index == marker.index):
+            marker.index = max(index, marker.index + delta)
+
+
+def clear_search_markers(parent: AbstractType) -> None:
+    markers = parent._search_markers
+    if markers:
+        for marker in markers:
+            marker.item.marker = False
+        markers.clear()
 
 
 def call_type_observers(ytype: AbstractType, transaction: "Transaction", event: Any) -> None:
@@ -277,7 +411,11 @@ def type_list_slice(ytype: AbstractType, start: int, end: int) -> list:
 
 
 def type_list_get(ytype: AbstractType, index: int) -> Any:
+    marker = find_search_marker(ytype, index)
     item = ytype._start
+    if marker is not None:
+        item = marker.item
+        index -= marker.index
     while item is not None:
         if item.countable and not item.deleted:
             if index < item.length:
@@ -370,10 +508,26 @@ def type_list_insert_generics(
     if index > parent._length:
         raise IndexError("index out of range")
     if index == 0:
+        if parent._search_markers is not None:
+            update_search_markers(parent, 0, len(contents))
         type_list_insert_generics_after(transaction, parent, None, contents)
         return
+    orig_index = index
     store = transaction.doc.store
+    marker = find_search_marker(parent, index)
     item = parent._start
+    if marker is not None:
+        item = marker.item
+        index -= marker.index
+        if index == 0:
+            # boundary: step to the previous LIVE item so the insert
+            # lands BEFORE the marked item, not after it (yjs rH's
+            # `l = l.prev` dance)
+            item = item.left
+            while item is not None and item.deleted:
+                item = item.left
+            if item is not None and item.countable:
+                index += item.length
     while item is not None:
         if not item.deleted and item.countable:
             if index <= item.length:
@@ -384,12 +538,19 @@ def type_list_insert_generics(
                 break
             index -= item.length
         item = item.right
+    if parent._search_markers is not None:
+        update_search_markers(parent, orig_index, len(contents))
     type_list_insert_generics_after(transaction, parent, item, contents)
 
 
 def type_list_push_generics(transaction: "Transaction", parent: AbstractType, contents: list) -> None:
-    # walk to the last item
+    # start from the furthest-right anchor instead of _start (appends
+    # into a long list were an O(doc) walk per push)
     item = parent._start
+    markers = parent._search_markers
+    if markers:
+        best = max(markers, key=lambda m: m.index)
+        item = best.item
     last = None
     while item is not None:
         last = item
@@ -401,8 +562,13 @@ def type_list_delete(transaction: "Transaction", parent: AbstractType, index: in
     if length == 0:
         return
     start_length = length
+    orig_index = index
     store = transaction.doc.store
+    marker = find_search_marker(parent, index)
     item = parent._start
+    if marker is not None:
+        item = marker.item
+        index -= marker.index
     while item is not None and index > 0:
         if not item.deleted and item.countable:
             if index < item.length:
@@ -418,6 +584,8 @@ def type_list_delete(transaction: "Transaction", parent: AbstractType, index: in
         item = item.right
     if length > 0:
         raise IndexError(f"delete length exceeded (missing {length} of {start_length})")
+    if parent._search_markers is not None:
+        update_search_markers(parent, orig_index, -start_length)
 
 
 # -- map primitives --------------------------------------------------------

@@ -28,6 +28,7 @@ class YArray(AbstractType):
 
     def __init__(self, initial: Optional[Iterable[Any]] = None) -> None:
         super().__init__()
+        self._search_markers = []
         self._prelim: Optional[list] = list(initial) if initial is not None else []
 
     def _integrate(self, doc, item: Optional[Item]) -> None:

@@ -15,7 +15,14 @@ from ..content import ContentEmbed, ContentFormat, ContentString, ContentType
 from ..encoding import UNDEFINED
 from ..ids import ID
 from ..structs import Item
-from .base import AbstractType, YTEXT_REF, YEvent, call_type_observers
+from .base import (
+    AbstractType,
+    YTEXT_REF,
+    YEvent,
+    call_type_observers,
+    find_search_marker,
+    update_search_markers,
+)
 
 
 def equal_attrs(a: Any, b: Any) -> bool:
@@ -73,6 +80,14 @@ def _find_next_position(transaction, pos: ItemTextListPosition, count: int) -> I
 
 
 def _find_position(transaction, parent: "YText", index: int) -> ItemTextListPosition:
+    # anchor-based fast path, UNFORMATTED text only: current_attributes
+    # must accumulate from the document start once ContentFormat items
+    # exist, so a mid-document anchor would lose formatting context
+    if parent._search_markers is not None and not parent._has_formatting:
+        marker = find_search_marker(parent, index)
+        if marker is not None:
+            pos = ItemTextListPosition(marker.item.left, marker.item, marker.index, {})
+            return _find_next_position(transaction, pos, index - marker.index)
     pos = ItemTextListPosition(None, parent._start, 0, {})
     return _find_next_position(transaction, pos, index)
 
@@ -144,6 +159,8 @@ def _insert_text(transaction, parent, pos: ItemTextListPosition, text: Any, attr
         content = ContentType(text)
     else:
         content = ContentEmbed(text)
+    if parent._search_markers is not None:
+        update_search_markers(parent, pos.index, content.get_length())
     pos.right = _make_item(transaction, parent, pos.left, pos.right, content)
     pos.forward()
     _insert_negated_attributes(transaction, parent, pos, negated)
@@ -184,6 +201,8 @@ def _format_text(transaction, parent, pos: ItemTextListPosition, length: int, at
 
 
 def _delete_text(transaction, pos: ItemTextListPosition, length: int) -> ItemTextListPosition:
+    start_length = length
+    start_index = pos.index
     store = transaction.doc.store
     while length > 0 and pos.right is not None:
         right = pos.right
@@ -193,6 +212,9 @@ def _delete_text(transaction, pos: ItemTextListPosition, length: int) -> ItemTex
             length -= right.length
             right.delete(transaction)
         pos.forward()
+    parent = (pos.left or pos.right)
+    if parent is not None and parent.parent._search_markers is not None:
+        update_search_markers(parent.parent, start_index, -start_length + length)
     return pos
 
 
@@ -352,6 +374,7 @@ class YText(AbstractType):
 
     def __init__(self, initial: Optional[str] = None) -> None:
         super().__init__()
+        self._search_markers = []
         self._pending: Optional[list] = []
         if initial:
             self._pending.append(lambda: self.insert(0, initial))

@@ -20,7 +20,7 @@ from .delete_set import DeleteSet
 from .doc import Observable, Transaction
 from .ids import ID
 from .structs import GC, Item, StructStore
-from .types.base import AbstractType
+from .types.base import AbstractType, clear_search_markers
 
 
 class StackItem:
@@ -297,6 +297,13 @@ class UndoManager(Observable):
                         item.delete(transaction)
                         performed = True
                 result = stack_item if performed else None
+            # undo manipulates items directly (redo copies, deletes),
+            # bypassing the marker-aware list ops — structurally changed
+            # types must drop their cached index anchors (yjs does the
+            # same at the end of its pop transaction)
+            for ytype, subs in transaction.changed.items():
+                if None in subs:
+                    clear_search_markers(ytype)
 
         self.doc.transact(run, origin=self)
         if result is not None:

@@ -45,6 +45,11 @@ class Document(Doc):
         # same-tick awareness coalescing (see _handle_awareness_update)
         self._pending_awareness: set[int] = set()
         self._awareness_scheduled = False
+        # same-tick UPDATE coalescing (see _handle_update): concurrent
+        # senders whose updates land in one loop iteration fan out as
+        # ONE merged frame instead of one frame each
+        self._pending_update_broadcast: list[bytes] = []
+        self._update_broadcast_scheduled = False
         self.awareness.on("update", self._handle_awareness_update)
         self.on("update", self._handle_update)
 
@@ -168,8 +173,40 @@ class Document(Doc):
                 _logger_mod.log_error(
                     f"plane capture failed for {self.name!r}; broadcasting via CPU"
                 )
-        # broadcast fan-out (reference Document.ts:228-240) — frame built
-        # once by the native codec, sent to every connection
+        # broadcast fan-out (reference Document.ts:228-240 fans out per
+        # update; here bursts within one event-loop iteration coalesce
+        # into ONE merged frame — same latency via call_soon, 1/N the
+        # frame builds + websocket sends + receiver applies)
+        self._pending_update_broadcast.append(update)
+        if self._update_broadcast_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._flush_update_broadcast()  # no loop (direct/test use)
+            return
+        self._update_broadcast_scheduled = True
+        loop.call_soon(self._flush_update_broadcast)
+
+    def _flush_update_broadcast(self) -> None:
+        self._update_broadcast_scheduled = False
+        pending = self._pending_update_broadcast
+        if not pending:
+            return
+        self._pending_update_broadcast = []
+        if len(pending) == 1:
+            update = pending[0]
+        else:
+            from ..crdt.update import merge_updates
+
+            try:
+                update = merge_updates(pending)
+            except Exception:
+                # a merge failure must not lose updates: fall back to
+                # the per-update fan-out
+                for u in pending:
+                    self.broadcast_update_frame(u)
+                return
         self.broadcast_update_frame(update)
 
     def broadcast_update_frame(self, update: bytes) -> None:

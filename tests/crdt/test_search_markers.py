@@ -1,0 +1,194 @@
+"""Search-marker differential fuzz.
+
+Index->position anchors (crdt/types/base.py SearchMarker, yjs
+ArraySearchMarker semantics) are a pure optimization: every operation
+must produce byte-identical results with markers enabled and disabled.
+These tests drive IDENTICAL op sequences through a marker doc and a
+markers-disabled doc and compare state at every step — positional
+inserts/deletes on big docs, remote-apply interleaving (remote
+transactions invalidate anchors wholesale), formatting transitions
+(markers stop being used once ContentFormat lands), undo/redo pops
+(direct item manipulation bypasses the marker-aware ops), and the
+YArray path.
+"""
+
+import os
+import random
+
+from hocuspocus_tpu.crdt import Doc
+from hocuspocus_tpu.crdt.undo import UndoManager
+from hocuspocus_tpu.crdt.update import apply_update, encode_state_as_update
+
+SEEDS = int(os.environ.get("FUZZ_MARKER_SEEDS", 30))
+
+
+def _pair():
+    """(marker doc, oracle doc with markers disabled) text pair."""
+    a = Doc()
+    b = Doc()
+    ta = a.get_text("t")
+    tb = b.get_text("t")
+    tb._search_markers = None  # oracle: always walks from _start
+    return a, b, ta, tb
+
+
+def test_text_positional_ops_match_oracle():
+    for seed in range(SEEDS):
+        rng = random.Random(1000 + seed)
+        _a, _b, ta, tb = _pair()
+        model = ""
+        for step in range(300):
+            if model and rng.random() < 0.35:
+                pos = rng.randrange(len(model) + 1)
+                length = min(rng.randrange(1, 20), len(model) - pos)
+                if length > 0:
+                    ta.delete(pos, length)
+                    tb.delete(pos, length)
+                    model = model[:pos] + model[pos + length:]
+                    continue
+            pos = rng.randrange(len(model) + 1)
+            chunk = f"<{seed}.{step}>" + "x" * rng.randrange(0, 30)
+            ta.insert(pos, chunk)
+            tb.insert(pos, chunk)
+            model = model[:pos] + chunk + model[pos:]
+            if step % 37 == 0:
+                assert ta.to_string() == tb.to_string() == model, (seed, step)
+        assert ta.to_string() == tb.to_string() == model
+
+
+def test_text_with_remote_interleaving_matches_oracle():
+    """Remote applies land via integrate (no marker-aware ops) and must
+    invalidate anchors; local editing continues correctly after."""
+    for seed in range(max(SEEDS // 3, 5)):
+        rng = random.Random(7000 + seed)
+        a = Doc()
+        b = Doc()  # the "peer" producing remote updates
+        ta = a.get_text("t")
+        tb = b.get_text("t")
+        oracle = Doc()
+        # identical client id: YATA ties vs the peer's concurrent
+        # inserts must resolve the same way in both docs (a and oracle
+        # never talk to each other, so the shared id is safe)
+        oracle.client_id = a.client_id
+        to = oracle.get_text("t")
+        to._search_markers = None
+        for _round in range(20):
+            # local burst on A (and the oracle, identically)
+            for _ in range(rng.randrange(1, 6)):
+                vis = len(ta.to_string())
+                pos = rng.randrange(vis + 1)
+                chunk = "a%03d" % rng.randrange(1000)
+                ta.insert(pos, chunk)
+                to.insert(pos, chunk)
+            # peer edits concurrently and its update arrives REMOTELY
+            tb.insert(rng.randrange(len(tb.to_string()) + 1), "B%02d" % rng.randrange(100))
+            upd = encode_state_as_update(b)
+            apply_update(a, upd, "remote")
+            apply_update(oracle, upd, "remote")
+            # positional edit right after the remote apply: stale
+            # anchors would land this in the wrong place
+            vis = len(ta.to_string())
+            pos = rng.randrange(vis + 1)
+            ta.insert(pos, "!")
+            to.insert(pos, "!")
+            assert ta.to_string() == to.to_string(), seed
+
+
+def test_text_formatting_transition_matches_oracle():
+    """Anchors serve the doc while plain; the first ContentFormat
+    flips _has_formatting and positions must stay exact through and
+    after the transition."""
+    for seed in range(max(SEEDS // 3, 5)):
+        rng = random.Random(3000 + seed)
+        _a, _b, ta, tb = _pair()
+        for _ in range(60):
+            vis = len(ta.to_string())
+            pos = rng.randrange(vis + 1)
+            chunk = "p%02d" % rng.randrange(100)
+            ta.insert(pos, chunk)
+            tb.insert(pos, chunk)
+        # transition: format a random range
+        vis = len(ta.to_string())
+        start = rng.randrange(vis - 10)
+        ta.format(start, 10, {"bold": True})
+        tb.format(start, 10, {"bold": True})
+        # post-transition positional ops (markers now unused on A)
+        for _ in range(40):
+            vis = len(ta.to_string())
+            pos = rng.randrange(vis + 1)
+            if vis and rng.random() < 0.3:
+                length = min(3, vis - pos)
+                if length:
+                    ta.delete(pos, length)
+                    tb.delete(pos, length)
+                    continue
+            ta.insert(pos, "z")
+            tb.insert(pos, "z")
+        assert ta.to_string() == tb.to_string()
+        assert ta.to_delta() == tb.to_delta()
+
+
+def test_text_undo_interleaving_matches_oracle():
+    for seed in range(max(SEEDS // 3, 5)):
+        rng = random.Random(5000 + seed)
+        a, b, ta, tb = _pair()
+        ua = UndoManager(ta, capture_timeout=0)
+        ub = UndoManager(tb, capture_timeout=0)
+        for _round in range(15):
+            for _ in range(rng.randrange(1, 4)):
+                vis = len(ta.to_string())
+                pos = rng.randrange(vis + 1)
+                chunk = "u%02d" % rng.randrange(100)
+                ta.insert(pos, chunk)
+                tb.insert(pos, chunk)
+            if rng.random() < 0.5:
+                ua.undo()
+                ub.undo()
+            if rng.random() < 0.3:
+                ua.redo()
+                ub.redo()
+            # positional edit after the pop: stale anchors would diverge
+            vis = len(ta.to_string())
+            pos = rng.randrange(vis + 1)
+            ta.insert(pos, ".")
+            tb.insert(pos, ".")
+            assert ta.to_string() == tb.to_string(), (seed, _round)
+
+
+def test_array_positional_ops_match_oracle():
+    for seed in range(SEEDS):
+        rng = random.Random(9000 + seed)
+        a = Doc()
+        b = Doc()
+        aa = a.get_array("a")
+        ab = b.get_array("a")
+        ab._search_markers = None
+        model: list = []
+        for step in range(200):
+            if model and rng.random() < 0.35:
+                pos = rng.randrange(len(model))
+                length = min(rng.randrange(1, 4), len(model) - pos)
+                aa.delete(pos, length)
+                ab.delete(pos, length)
+                del model[pos : pos + length]
+                continue
+            pos = rng.randrange(len(model) + 1)
+            values = [rng.randrange(10_000) for _ in range(rng.randrange(1, 4))]
+            aa.insert(pos, values)
+            ab.insert(pos, values)
+            model[pos:pos] = values
+            if step % 29 == 0:
+                assert aa.to_json() == ab.to_json() == model, (seed, step)
+        assert aa.to_json() == ab.to_json() == model
+        # indexed reads ride markers too
+        for _ in range(20):
+            i = rng.randrange(len(model))
+            assert aa.get(i) == model[i]
+
+
+def test_array_push_appends_via_highest_anchor():
+    a = Doc()
+    arr = a.get_array("a")
+    for i in range(500):
+        arr.push([i])
+    assert arr.to_json() == list(range(500))
