@@ -17,6 +17,7 @@ from .encoding import Encoder
 from .ids import ID
 from .structs import GC, Item, StructStore
 from .types.base import clear_search_markers
+from .types.ytext import cleanup_ytext_after_transaction
 from .update import transaction_changed, write_update_message_from_transaction
 
 
@@ -191,6 +192,8 @@ def _cleanup_transactions(cleanups: list[Transaction], i: int) -> None:
                 for fn in list(ytype._deep_handlers):
                     fn(live, transaction)
         doc.emit("afterTransaction", transaction, doc)
+        if transaction._need_formatting_cleanup:
+            cleanup_ytext_after_transaction(transaction)
     finally:
         if doc.gc:
             _try_gc_delete_set(ds, store, doc.gc_filter)
@@ -333,6 +336,10 @@ class Doc(Observable):
                     node.parent = upgraded
                     node = node.right
                 upgraded._length = ytype._length
+                # state observed while the root was still generic must
+                # survive the retype (ContentFormat integrates set this
+                # before anyone called get_text)
+                upgraded._has_formatting = ytype._has_formatting
                 self.share[name] = upgraded
                 upgraded._integrate(self, None)
                 return upgraded

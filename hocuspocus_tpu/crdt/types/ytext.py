@@ -1,10 +1,14 @@
 """YText — shared rich text type (Y.js-compatible).
 
 Implements the YATA text algorithm with formatting attributes
-(ContentFormat begin/negate pairs), Quill-style deltas and incremental
-text events. The aggressive formatting-cleanup passes yjs runs after
-transactions are not yet ported — they reduce tombstone counts but do not
-affect convergence or rendered content.
+(ContentFormat begin/negate pairs), Quill-style deltas, incremental
+text events, and the yjs formatting-cleanup passes: every local delete
+dedups markers across the tombstone gap it opens, and remote
+transactions touching formatted texts trigger the per-transaction
+hygiene pass (`cleanup_ytext_after_transaction`) — contextless gap
+dedup for pure deletions, the full-document sweep when a live
+ContentFormat arrived. Cleanup deletions are ordinary CRDT deletes, so
+peers converge through normal delete-set propagation.
 """
 
 from __future__ import annotations
@@ -200,9 +204,152 @@ def _format_text(transaction, parent, pos: ItemTextListPosition, length: int, at
     _insert_negated_attributes(transaction, parent, pos, negated)
 
 
+def _cleanup_formatting_gap(transaction, start, curr, start_attributes: dict, curr_attributes: dict) -> int:
+    """Delete format markers made redundant across a tombstone gap.
+
+    Mirrors yjs cleanupFormattingGap: `start`..`curr` brackets a gap of
+    deleted/non-countable items; a ContentFormat inside it is redundant
+    when no LIVE content to the gap's right depends on it (it is not
+    the gap-end's winning marker for its key) or it restates the
+    attribute already active at the gap's start. Deleting markers here
+    is an ordinary CRDT delete — peers converge through the usual
+    delete-set propagation, no special casing."""
+    # walk from START to the first live countable item: the formats
+    # collected on the way are the gap's right-edge context, keyed so
+    # the LAST per key wins (earlier ones are shadowed)
+    end = start
+    end_formats: dict = {}
+    while end is not None and (not end.countable or end.deleted):
+        if not end.deleted and isinstance(end.content, ContentFormat):
+            end_formats[end.content.key] = end.content
+        end = end.right
+    cleanups = 0
+    reached_curr = False
+    while start is not end:
+        if curr is start:
+            reached_curr = True
+        if not start.deleted:
+            content = start.content
+            if isinstance(content, ContentFormat):
+                key, value = content.key, content.value
+                start_attr = start_attributes.get(key)
+                if end_formats.get(key) is not content or equal_attrs(start_attr, value):
+                    start.delete(transaction)
+                    cleanups += 1
+                    if (
+                        not reached_curr
+                        and equal_attrs(curr_attributes.get(key), value)
+                        and not equal_attrs(start_attr, value)
+                    ):
+                        if start_attr is None:
+                            curr_attributes.pop(key, None)
+                        else:
+                            curr_attributes[key] = start_attr
+                if not reached_curr and not start.deleted:
+                    _update_current_attributes(curr_attributes, content)
+        start = start.right
+    return cleanups
+
+
+def _cleanup_contextless_formatting_gap(transaction, item) -> None:
+    """Tombstone-gap marker dedup without attribute context (yjs
+    cleanupContextlessFormattingGap): within one run of deleted /
+    non-countable items, only the RIGHTMOST live marker per key can
+    matter — earlier ones in the gap are shadowed and deletable."""
+    while item is not None and item.right is not None and (
+        item.right.deleted or not item.right.countable
+    ):
+        item = item.right
+    seen: set = set()
+    while item is not None and (item.deleted or not item.countable):
+        if not item.deleted and isinstance(item.content, ContentFormat):
+            key = item.content.key
+            if key in seen:
+                item.delete(transaction)
+            else:
+                seen.add(key)
+        item = item.left
+
+
+def cleanup_ytext_after_transaction(transaction) -> None:
+    """Post-transaction marker hygiene for every flagged YText (yjs
+    cleanupYTextAfterTransaction). Texts that RECEIVED a live
+    ContentFormat get the full-document sweep; texts that only saw
+    deletions get the cheap contextless gap dedup per deleted run."""
+    need_full: set = set()
+    doc = transaction.doc
+    store = doc.store
+
+    def scan(struct) -> None:
+        if (
+            isinstance(struct, Item)
+            and not struct.deleted
+            and isinstance(struct.content, ContentFormat)
+        ):
+            need_full.add(struct.parent)
+
+    for client, after_clock in transaction.after_state.items():
+        start_clock = transaction.before_state.get(client, 0)
+        if after_clock != start_clock:
+            store.iterate_structs(
+                transaction, client, start_clock, after_clock - start_clock, scan
+            )
+
+    def run(nested) -> None:
+        def visit(struct) -> None:
+            if not isinstance(struct, Item):
+                return
+            parent = struct.parent
+            if (
+                parent is None
+                or not getattr(parent, "_has_formatting", False)
+                or parent in need_full
+            ):
+                return
+            if isinstance(struct.content, ContentFormat):
+                need_full.add(parent)
+            else:
+                _cleanup_contextless_formatting_gap(nested, struct)
+
+        for client, clock, length in list(transaction.delete_set.iterate()):
+            store.iterate_structs(transaction, client, clock, length, visit)
+        for ytext in need_full:
+            cleanup_ytext_formatting(ytext)
+
+    doc.transact(run)
+
+
+def cleanup_ytext_formatting(ytype: "YText") -> int:
+    """Full-document redundant-marker sweep (yjs cleanupYTextFormatting)."""
+    removed = 0
+
+    def run(transaction) -> None:
+        nonlocal removed
+        start = ytype._start
+        curr = ytype._start
+        start_attributes: dict = {}
+        curr_attributes: dict = {}
+        while curr is not None:
+            if curr.deleted is False:
+                if isinstance(curr.content, ContentFormat):
+                    _update_current_attributes(curr_attributes, curr.content)
+                else:
+                    removed += _cleanup_formatting_gap(
+                        transaction, start, curr, start_attributes, curr_attributes
+                    )
+                    start_attributes = dict(curr_attributes)
+                    start = curr
+            curr = curr.right
+    if ytype.doc is not None:
+        ytype._transact(run)
+    return removed
+
+
 def _delete_text(transaction, pos: ItemTextListPosition, length: int) -> ItemTextListPosition:
     start_length = length
     start_index = pos.index
+    start_attrs = dict(pos.current_attributes)
+    start_right = pos.right
     store = transaction.doc.store
     while length > 0 and pos.right is not None:
         right = pos.right
@@ -212,6 +359,12 @@ def _delete_text(transaction, pos: ItemTextListPosition, length: int) -> ItemTex
             length -= right.length
             right.delete(transaction)
         pos.forward()
+    # the deletion opened a tombstone gap: markers inside it may now be
+    # redundant (yjs deleteText runs the same pass)
+    if start_right is not None:
+        _cleanup_formatting_gap(
+            transaction, start_right, pos.right, start_attrs, pos.current_attributes
+        )
     parent = (pos.left or pos.right)
     if parent is not None and parent.parent._search_markers is not None:
         update_search_markers(parent.parent, start_index, -start_length + length)
@@ -390,6 +543,13 @@ class YText(AbstractType):
     def _call_observer(self, transaction, parent_subs) -> None:
         event = YTextEvent(self, transaction, parent_subs)
         call_type_observers(self, transaction, event)
+        # remote changes can leave redundant format markers (each side
+        # closed a range the other reopened, etc.) — flag the
+        # transaction; doc cleanup runs ONE pass for all flagged texts
+        # (yjs 13.6 _needFormattingCleanup design: zero cost for
+        # unformatted docs)
+        if not transaction.local and self._has_formatting:
+            transaction._need_formatting_cleanup = True
 
     @property
     def length(self) -> int:
