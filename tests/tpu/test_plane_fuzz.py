@@ -233,18 +233,27 @@ def test_plane_fuzz_concurrent_editors_converge(seed, arena):
     plane.register("conc")
 
     def cross_deliver():
-        """Randomly flush pending updates between replicas + the plane."""
-        # the plane sees BOTH clients' updates in arbitrary interleave
-        pending = out_a + out_b
-        rng.shuffle(pending)
-        for update in pending:
-            plane.enqueue_update("conc", update)
-        for update in out_a:
-            apply_update(b, update)
-        for update in out_b:
-            apply_update(a, update)
-        out_a.clear()
-        out_b.clear()
+        """Flush pending updates between replicas + the plane, DRAINING
+        cascades: applying a remote update can itself emit a new update
+        (the formatting-hygiene pass deletes redundant markers in a
+        nested transaction) — a real provider broadcasts those, so the
+        relay must not snapshot-and-drop them."""
+        for _ in range(8):
+            if not out_a and not out_b:
+                break
+            batch_a, batch_b = out_a[:], out_b[:]
+            out_a.clear()
+            out_b.clear()
+            # the plane sees BOTH clients' updates in arbitrary interleave
+            pending = batch_a + batch_b
+            rng.shuffle(pending)
+            for update in pending:
+                plane.enqueue_update("conc", update)
+            for update in batch_a:
+                apply_update(b, update)
+            for update in batch_b:
+                apply_update(a, update)
+        assert not out_a and not out_b, "cleanup cascade did not settle"
 
     for round_no in range(12):
         # each round: both editors make a few INDEPENDENT edits (true
