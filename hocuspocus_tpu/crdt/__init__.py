@@ -25,6 +25,15 @@ from .types import (
     YXmlHook,
     YXmlText,
 )
+from .relative_position import (
+    AbsolutePosition,
+    RelativePosition,
+    compare_relative_positions,
+    create_absolute_position_from_relative_position,
+    create_relative_position_from_type_index,
+    decode_relative_position,
+    encode_relative_position,
+)
 from .update import (
     Snapshot,
     apply_update,
@@ -75,5 +84,12 @@ __all__ = [
     "encode_state_vector_from_update",
     "merge_updates",
     "snapshot",
+    "AbsolutePosition",
+    "RelativePosition",
+    "compare_relative_positions",
+    "create_absolute_position_from_relative_position",
+    "create_relative_position_from_type_index",
+    "decode_relative_position",
+    "encode_relative_position",
     "snapshot_contains_update",
 ]

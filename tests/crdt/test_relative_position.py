@@ -1,0 +1,171 @@
+"""Relative positions: cursor anchors stable under concurrent editing.
+
+Mirrors yjs's RelativePosition semantics (crdt/relative_position.py):
+anchors pin to struct IDs, survive inserts/deletes/undo around them,
+round-trip through the lib0 byte encoding, and resolve across
+replicas that exchanged updates.
+"""
+
+import random
+
+from hocuspocus_tpu.crdt import (
+    Doc,
+    compare_relative_positions,
+    create_absolute_position_from_relative_position,
+    create_relative_position_from_type_index,
+    decode_relative_position,
+    encode_relative_position,
+)
+from hocuspocus_tpu.crdt.relative_position import RelativePosition
+from hocuspocus_tpu.crdt.undo import UndoManager
+from hocuspocus_tpu.crdt.update import apply_update, encode_state_as_update
+
+
+def _resolve(rpos, doc):
+    pos = create_absolute_position_from_relative_position(rpos, doc)
+    assert pos is not None
+    return pos.index
+
+
+def test_anchor_shifts_with_surrounding_edits():
+    d = Doc()
+    t = d.get_text("t")
+    t.insert(0, "hello world")
+    anchor = create_relative_position_from_type_index(t, 6)  # before 'w'
+    t.insert(0, ">>> ")        # shift right
+    assert _resolve(anchor, d) == 10
+    t.delete(0, 4)             # shift back
+    assert _resolve(anchor, d) == 6
+    t.insert(6, "brave ")      # insert AT the anchor: assoc>=0 stays left
+    assert _resolve(anchor, d) == 12
+    assert t.to_string()[_resolve(anchor, d):] == "world"
+
+
+def test_assoc_negative_sticks_to_preceding_char():
+    d = Doc()
+    t = d.get_text("t")
+    t.insert(0, "ab")
+    before = create_relative_position_from_type_index(t, 1, assoc=-1)
+    at = create_relative_position_from_type_index(t, 1, assoc=0)
+    t.insert(1, "XY")  # insert at position 1
+    # assoc=-1 pins to 'a' (stays at 1); assoc>=0 pins to 'b' (shifts)
+    assert _resolve(before, d) == 1
+    assert _resolve(at, d) == 3
+
+
+def test_boundaries_and_empty_type():
+    d = Doc()
+    t = d.get_text("t")
+    start = create_relative_position_from_type_index(t, 0)
+    end_assoc = create_relative_position_from_type_index(t, 0, assoc=-1)
+    assert _resolve(start, d) == 0
+    assert _resolve(end_assoc, d) == 0
+    t.insert(0, "abc")
+    # type-anchored end (no item): assoc>=0 resolves to length
+    assert _resolve(start, d) == 3  # tname anchor, end-of-type semantics
+    tail = create_relative_position_from_type_index(t, 3)
+    assert _resolve(tail, d) == 3
+    t.insert(3, "d")
+    # index==length with assoc>=0 is a TYPE anchor: it tracks the
+    # moving end (yjs semantics); pin-to-last-char needs assoc=-1
+    assert _resolve(tail, d) == 4
+    pinned = create_relative_position_from_type_index(t, 4, assoc=-1)
+    t.insert(4, "e")
+    assert _resolve(pinned, d) == 4
+
+
+def test_deleted_anchor_resolves_to_collapse_point():
+    d = Doc()
+    t = d.get_text("t")
+    t.insert(0, "abcdef")
+    mid = create_relative_position_from_type_index(t, 3)  # on 'd'
+    t.delete(2, 3)  # deletes c,d,e — the anchor char is a tombstone
+    assert t.to_string() == "abf"
+    assert _resolve(mid, d) == 2  # collapses to the gap position
+
+
+def test_roundtrip_and_cross_replica_resolution():
+    a = Doc()
+    ta = a.get_text("t")
+    ta.insert(0, "shared content")
+    anchor = create_relative_position_from_type_index(ta, 7)
+    raw = encode_relative_position(anchor)
+    b = Doc()
+    apply_update(b, encode_state_as_update(a), "remote")
+    decoded = decode_relative_position(raw)
+    # the wire format carries only the winning anchor (item beats
+    # tname), so compare decoded-to-decoded, and by byte fixpoint
+    assert compare_relative_positions(decoded, decode_relative_position(raw))
+    assert encode_relative_position(decoded) == raw
+    # resolves on the replica, then stays correct as B edits
+    tb = b.get_text("t")
+    assert _resolve(decoded, b) == 7
+    tb.insert(0, "B: ")
+    assert _resolve(decoded, b) == 10
+
+    # an anchor minted by B on its OWN new content is a future ID for A
+    tb.insert(0, "zz")
+    newer = create_relative_position_from_type_index(tb, 1)
+    assert create_absolute_position_from_relative_position(newer, a) is None
+
+
+def test_decode_without_assoc_suffix_defaults_to_zero():
+    # pre-13.5 encodings end right after the anchor
+    d = Doc()
+    t = d.get_text("t")
+    t.insert(0, "xy")
+    anchor = create_relative_position_from_type_index(t, 1)
+    raw = encode_relative_position(anchor)
+    legacy = raw[:-1]  # strip the trailing assoc varint
+    decoded = decode_relative_position(legacy)
+    assert decoded.assoc == 0
+    assert _resolve(decoded, d) == 1
+
+
+def test_survives_undo_redo_via_redone_chain():
+    d = Doc()
+    t = d.get_text("t")
+    t.insert(0, "keep me around")
+    um = UndoManager(t, capture_timeout=0)
+    t.insert(5, "X")
+    # anchor ON a char of the original text (index 8 = 'a' of "around"
+    # after the X insert: "keep Xme around")
+    target = t.to_string()[8]
+    anchor = create_relative_position_from_type_index(t, 8)
+    um.undo()   # removes the X; the anchored char shifts left
+    p1 = _resolve(anchor, d)
+    assert t.to_string()[p1] == target
+    um.redo()   # re-inserts a REDONE copy of X with a new ID
+    p2 = _resolve(anchor, d)
+    assert t.to_string()[p2] == target
+    assert p2 == p1 + 1
+
+
+def test_fuzz_anchor_tracks_character_identity():
+    for seed in range(10):
+        rng = random.Random(6000 + seed)
+        d = Doc()
+        t = d.get_text("t")
+        t.insert(0, "0123456789")
+        idx = rng.randrange(10)
+        target_char = t.to_string()[idx]
+        anchor = create_relative_position_from_type_index(t, idx)
+        for _ in range(120):
+            vis = len(t.to_string())
+            pos_r = create_absolute_position_from_relative_position(anchor, d)
+            assert pos_r is not None
+            p = pos_r.index
+            op = rng.random()
+            if op < 0.55:
+                at = rng.randrange(vis + 1)
+                t.insert(at, chr(97 + rng.randrange(26)))
+            elif vis > 1:
+                at = rng.randrange(vis - 1)
+                if at <= p < at + 1:
+                    continue  # don't delete the anchored char itself
+                t.delete(at, 1)
+            new_p = _resolve(anchor, d)
+            s = t.to_string()
+            if new_p < len(s):
+                # the anchored character keeps its identity while alive
+                assert s[new_p] == target_char, (seed, s, new_p, target_char)
