@@ -37,14 +37,17 @@ from .relative_position import (
 from .update import (
     Snapshot,
     apply_update,
+    create_doc_from_snapshot,
     decode_state_vector,
     diff_update,
     encode_state_as_update,
     encode_state_vector,
     encode_state_vector_from_update,
+    is_visible,
     merge_updates,
     snapshot,
     snapshot_contains_update,
+    split_snapshot_affected_structs,
 )
 
 __all__ = [
@@ -84,6 +87,9 @@ __all__ = [
     "encode_state_vector_from_update",
     "merge_updates",
     "snapshot",
+    "create_doc_from_snapshot",
+    "is_visible",
+    "split_snapshot_affected_structs",
     "AbsolutePosition",
     "RelativePosition",
     "compare_relative_positions",

@@ -112,7 +112,9 @@ def _follow_redone(store: StructStore, sid: ID) -> "tuple[Optional[Any], int]":
             next_id = ID(next_id.client, next_id.clock + diff)
         try:
             item = store.find(next_id)
-        except (KeyError, IndexError):
+        except (KeyError, IndexError, RuntimeError):
+            # unknown client (KeyError) or in-range client with a clock
+            # no struct covers (find_index raises RuntimeError)
             return None, 0
         if item is None:
             return None, 0

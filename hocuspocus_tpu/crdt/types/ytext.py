@@ -648,7 +648,19 @@ class YText(AbstractType):
     def to_json(self) -> str:
         return self.to_string()
 
-    def to_delta(self) -> list[dict]:
+    def to_delta(
+        self,
+        snapshot=None,
+        prev_snapshot=None,
+        compute_ychange=None,
+    ) -> list[dict]:
+        """Quill-style delta; with `snapshot` renders the text AS OF
+        that version, and with `prev_snapshot` additionally attributes
+        the differences with `ychange` marks ({"type": "added" |
+        "removed", ...}) — yjs YText.toDelta's version-preview mode.
+        `compute_ychange(type, id)` customizes the mark payload."""
+        from ..update import is_visible, split_snapshot_affected_structs
+
         ops: list[dict] = []
         current_attributes: dict = {}
         buf: list[str] = []
@@ -661,23 +673,69 @@ class YText(AbstractType):
                 ops.append(op)
                 buf.clear()
 
-        item = self._start
-        while item is not None:
-            if not item.deleted:
-                content = item.content
-                if isinstance(content, ContentString):
-                    buf.append(content.s)
-                elif isinstance(content, (ContentType, ContentEmbed)):
-                    pack()
-                    op = {"insert": content.get_content()[0]}
-                    if current_attributes:
-                        op["attributes"] = dict(current_attributes)
-                    ops.append(op)
-                elif isinstance(content, ContentFormat):
-                    pack()
-                    _update_current_attributes(current_attributes, content)
-            item = item.right
-        pack()
+        def mark_ychange(kind: str, item) -> None:
+            # yjs op granularity: a new op whenever the marking user or
+            # kind changes (default payloads carry no user, so every
+            # struct item starts its own op — interop-identical deltas)
+            cur = current_attributes.get("ychange")
+            if (
+                cur is None
+                or cur.get("user") != item.id.client
+                or cur.get("type") != kind
+            ):
+                pack()
+                current_attributes["ychange"] = (
+                    compute_ychange(kind, item.id)
+                    if compute_ychange is not None
+                    else {"type": kind}
+                )
+
+        def compute_delta() -> None:
+            item = self._start
+            while item is not None:
+                visible_now = is_visible(item, snapshot)
+                visible_prev = prev_snapshot is not None and is_visible(
+                    item, prev_snapshot
+                )
+                if visible_now or visible_prev:
+                    content = item.content
+                    if isinstance(content, ContentString):
+                        if snapshot is not None and not visible_now:
+                            mark_ychange("removed", item)
+                        elif prev_snapshot is not None and not visible_prev:
+                            mark_ychange("added", item)
+                        elif current_attributes.get("ychange") is not None:
+                            pack()
+                            current_attributes.pop("ychange", None)
+                        buf.append(content.s)
+                    elif isinstance(content, (ContentType, ContentEmbed)):
+                        pack()
+                        op = {"insert": content.get_content()[0]}
+                        if current_attributes:
+                            op["attributes"] = dict(current_attributes)
+                        ops.append(op)
+                    elif isinstance(content, ContentFormat):
+                        if visible_now:
+                            pack()
+                            _update_current_attributes(current_attributes, content)
+                item = item.right
+            pack()
+
+        if snapshot is not None or prev_snapshot is not None:
+            # split AND walk inside ONE transaction: cleanup re-merges
+            # the split halves on exit, which would erase the snapshot
+            # boundaries mid-walk (yjs toDelta computes inside the
+            # 'cleanup' transact for the same reason)
+            def run(transaction) -> None:
+                if snapshot is not None:
+                    split_snapshot_affected_structs(transaction, snapshot)
+                if prev_snapshot is not None:
+                    split_snapshot_affected_structs(transaction, prev_snapshot)
+                compute_delta()
+
+            self._transact(run)
+        else:
+            compute_delta()
         return ops
 
     def get_attributes(self) -> dict:

@@ -544,6 +544,72 @@ def snapshot(doc: "Doc") -> Snapshot:
     return Snapshot(create_delete_set_from_struct_store(doc.store), doc.store.get_state_vector())
 
 
+def is_visible(item: "Item", snap: "Optional[Snapshot]") -> bool:
+    """Was this item's content visible at snapshot time? (yjs isVisible:
+    created before the snapshot's state vector and not in its delete
+    set; None means 'now' — simply not deleted.)"""
+    if snap is None:
+        return not item.deleted
+    return (
+        item.id.client in snap.sv
+        and snap.sv.get(item.id.client, 0) > item.id.clock
+        and not snap.ds.is_deleted(item.id.client, item.id.clock)
+    )
+
+
+def split_snapshot_affected_structs(transaction: "Transaction", snap: Snapshot) -> None:
+    """Split structs at the snapshot's SV and delete-set boundaries so
+    is_visible answers per whole item (yjs splitSnapshotAffectedStructs;
+    memoized per transaction)."""
+    # memoize the OBJECTS (not ids): an id() key outlives its object
+    # and a recycled address would falsely skip a different snapshot
+    seen = transaction.meta.setdefault("split_snapshots", set())
+    if snap in seen:
+        return
+    store = transaction.doc.store
+    for client, clock in snap.sv.items():
+        if clock < store.get_state(client):
+            store.get_item_clean_start(transaction, ID(client, clock))
+    for client, clock, length in list(snap.ds.iterate()):
+        store.iterate_structs(transaction, client, clock, length, lambda _s: None)
+    seen.add(snap)
+
+
+def create_doc_from_snapshot(origin: "Doc", snap: Snapshot, new_doc: "Optional[Doc]" = None) -> "Doc":
+    """Materialize a NEW doc holding `origin` as of `snap` (yjs
+    createDocFromSnapshot). Requires gc disabled on the origin —
+    collected tombstones make historic states unreconstructable."""
+    if origin.gc:
+        raise ValueError(
+            "createDocFromSnapshot requires Doc(gc=False) on the origin "
+            "(collected structs cannot be restored)"
+        )
+    from .doc import Doc as _Doc
+
+    if new_doc is None:
+        new_doc = _Doc()
+    encoder = Encoder()
+
+    def run(transaction) -> None:
+        active = [(c, clk) for c, clk in snap.sv.items() if clk > 0]
+        encoder.write_var_uint(len(active))
+        for client, clk in sorted(active, reverse=True):
+            if clk < origin.store.get_state(client):
+                origin.store.get_item_clean_start(transaction, ID(client, clk))
+            structs = origin.store.clients[client]
+            last = StructStore.find_index(structs, clk - 1)
+            encoder.write_var_uint(last + 1)
+            encoder.write_var_uint(client)
+            encoder.write_var_uint(0)
+            for i in range(last + 1):
+                structs[i].write(encoder, 0)
+        snap.ds.write(encoder)
+
+    origin.transact(run)
+    apply_update(new_doc, encoder.to_bytes(), "snapshot")
+    return new_doc
+
+
 def snapshot_contains_update(snap: Snapshot, update: bytes) -> bool:
     """True iff the snapshot already covers everything in `update`.
 

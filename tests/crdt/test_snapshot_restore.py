@@ -1,0 +1,116 @@
+"""Snapshot restore + version previews (yjs createDocFromSnapshot /
+YText.toDelta(snapshot, prevSnapshot) parity).
+
+A snapshot (delete set + state vector) must reconstruct the document
+as of that moment — as a standalone doc, and as an attributed delta
+('ychange' added/removed marks) for version-history UIs like the
+reference ecosystem's diff viewers.
+"""
+
+import pytest
+
+from hocuspocus_tpu.crdt import (
+    Doc,
+    apply_update,
+    create_doc_from_snapshot,
+    encode_state_as_update,
+    snapshot,
+)
+from hocuspocus_tpu.crdt.update import Snapshot
+
+
+def test_restore_text_doc_at_snapshot():
+    d = Doc(gc=False)
+    t = d.get_text("t")
+    t.insert(0, "version one")
+    snap = snapshot(d)
+    t.insert(11, " plus later edits")
+    t.delete(0, 8)
+    assert t.to_string() == "one plus later edits"
+
+    restored = create_doc_from_snapshot(d, snap)
+    assert restored.get_text("t").to_string() == "version one"
+    # the restored doc is a normal live doc
+    restored.get_text("t").insert(0, "! ")
+    assert restored.get_text("t").to_string() == "! version one"
+
+
+def test_restore_requires_gc_disabled():
+    d = Doc()  # gc on
+    d.get_text("t").insert(0, "x")
+    with pytest.raises(ValueError, match="gc"):
+        create_doc_from_snapshot(d, snapshot(d))
+
+
+def test_restore_includes_deletions_before_snapshot():
+    d = Doc(gc=False)
+    t = d.get_text("t")
+    t.insert(0, "abcdef")
+    t.delete(1, 2)  # "adef"
+    snap = snapshot(d)
+    t.insert(0, "zz")
+    restored = create_doc_from_snapshot(d, snap)
+    assert restored.get_text("t").to_string() == "adef"
+
+
+def test_snapshot_bytes_roundtrip_restores_identically():
+    d = Doc(gc=False)
+    t = d.get_text("t")
+    t.insert(0, "roundtrip me")
+    t.delete(0, 6)
+    snap = snapshot(d)
+    decoded = Snapshot.decode(snap.encode())
+    assert snap.equals(decoded)
+    t.insert(0, "post-snapshot ")
+    assert (
+        create_doc_from_snapshot(d, decoded).get_text("t").to_string()
+        == "rip me"
+    )
+
+
+def test_to_delta_at_snapshot_renders_old_content():
+    d = Doc(gc=False)
+    t = d.get_text("t")
+    t.insert(0, "hello world")
+    t.format(0, 5, {"bold": True})
+    snap = snapshot(d)
+    t.delete(0, 6)
+    t.insert(0, "goodbye ")
+    assert t.to_delta(snap) == [
+        {"insert": "hello", "attributes": {"bold": True}},
+        {"insert": " world"},
+    ]
+
+
+def test_to_delta_with_prev_snapshot_marks_changes():
+    d = Doc(gc=False)
+    t = d.get_text("t")
+    t.insert(0, "stable ")
+    prev = snapshot(d)
+    t.insert(7, "added ")
+    t.delete(0, 2)  # removes "st"
+    cur = snapshot(d)
+    delta = t.to_delta(cur, prev)
+    assert delta == [
+        {"insert": "st", "attributes": {"ychange": {"type": "removed"}}},
+        {"insert": "able "},
+        {"insert": "added ", "attributes": {"ychange": {"type": "added"}}},
+    ]
+    # custom mark payloads (yjs computeYChange)
+    delta2 = t.to_delta(cur, prev, compute_ychange=lambda kind, _id: {"type": kind, "who": "me"})
+    assert delta2[0]["attributes"]["ychange"] == {"type": "removed", "who": "me"}
+
+
+def test_restore_from_replica_snapshot():
+    """A snapshot minted on one replica restores on another that holds
+    the same history."""
+    a = Doc(gc=False)
+    ta = a.get_text("t")
+    ta.insert(0, "replicated state")
+    snap_bytes = snapshot(a).encode()
+    ta.insert(0, "later: ")
+
+    b = Doc(gc=False)
+    apply_update(b, encode_state_as_update(a), "remote")
+    restored = create_doc_from_snapshot(b, Snapshot.decode(snap_bytes))
+    assert restored.get_text("t").to_string() == "replicated state"
