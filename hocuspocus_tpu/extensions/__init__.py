@@ -1,4 +1,5 @@
 from .database import Database
+from .history import History
 from .incremental import IncrementalSQLite
 from .logger import Logger
 from .redis import Redis
@@ -9,6 +10,7 @@ from .webhook import Events, Webhook
 
 __all__ = [
     "Database",
+    "History",
     "IncrementalSQLite",
     "Logger",
     "Redis",

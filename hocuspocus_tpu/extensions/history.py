@@ -1,0 +1,280 @@
+"""Version history — snapshot checkpoints with preview and restore.
+
+Beyond the reference's surface (the reference ecosystem ships document
+versioning as a paid Tiptap add-on built on the same yjs snapshot
+machinery this extension uses): each loaded document gets a
+GC-disabled archive replica fed by its update stream, checkpoints are
+minted on demand (or on every store), and clients drive everything
+over the existing stateless channel — no new wire messages.
+
+Client -> server (JSON over a Stateless message):
+    {"action": "history.checkpoint", "label": "before cleanup"?}
+    {"action": "history.list"}
+    {"action": "history.preview", "id": 3}
+    {"action": "history.restore", "id": 3}
+
+Server -> client:
+    {"event": "history.checkpointed", "id", "label", "ts"}   (broadcast)
+    {"event": "history.versions", "versions": [{id,label,ts}]}
+    {"event": "history.preview", "id", "update": "<base64>"}  (reconstruct
+        with Doc() + apply_update on the client)
+    {"event": "history.restored", "id"}                       (broadcast)
+    {"event": "history.error", "error"}
+
+Restore rewrites the LIVE document's root types to the checkpointed
+content as ordinary edits (delete + reinsert in one transaction), so it
+propagates to every client and remains undoable. Text roots keep their
+formatting via delta re-application; map/array roots restore to their
+JSON content; XML roots are preview-only for now (restore answers
+history.error for them).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Any, Optional
+
+from ..crdt import Doc, apply_update, create_doc_from_snapshot, encode_state_as_update, snapshot
+from ..crdt.content import ContentFormat, ContentString, ContentType
+from ..crdt.types.base import AbstractType
+from ..crdt.types.ymap import YMap
+from ..crdt.types.ytext import YText
+from ..crdt.types.yarray import YArray
+from ..crdt.update import Snapshot
+from ..server.types import Extension, Payload
+
+
+class _DocHistory:
+    __slots__ = ("archive", "versions", "next_id", "listener", "document")
+
+    def __init__(self) -> None:
+        self.archive = Doc(gc=False)
+        self.versions: list[dict] = []
+        self.next_id = 1
+        self.listener = None
+        # the LIVE doc the listener is attached to: the unload hook's
+        # payload carries only the name (the doc is already torn down)
+        self.document = None
+
+
+class History(Extension):
+    """In-memory version history. `max_versions` caps retained
+    checkpoints per document (oldest dropped); `checkpoint_on_store`
+    also mints one whenever the store hooks run (debounced saves)."""
+
+    def __init__(self, max_versions: int = 50, checkpoint_on_store: bool = False) -> None:
+        self.max_versions = max_versions
+        self.checkpoint_on_store = checkpoint_on_store
+        self._docs: dict[str, _DocHistory] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def after_load_document(self, data: Payload) -> None:
+        name = data.document_name
+        if name in self._docs:
+            return
+        hist = _DocHistory()
+        apply_update(hist.archive, encode_state_as_update(data.document), "history")
+
+        def on_update(update: bytes, _origin: Any, *_rest: Any) -> None:
+            apply_update(hist.archive, update, "history")
+
+        hist.listener = on_update
+        hist.document = data.document
+        data.document.on("update", on_update)
+        self._docs[name] = hist
+
+    async def after_unload_document(self, data: Payload) -> None:
+        # the unload payload carries only the NAME (the doc is already
+        # torn down) — detach from the reference captured at load
+        hist = self._docs.pop(data.document_name, None)
+        if hist is not None and hist.listener is not None and hist.document is not None:
+            try:
+                hist.document.off("update", hist.listener)
+            except Exception:
+                pass  # the doc is being destroyed either way
+
+    async def after_store_document(self, data: Payload) -> None:
+        if self.checkpoint_on_store:
+            self._checkpoint(data.document_name, label="store")
+
+    # -- the stateless protocol --------------------------------------------
+
+    async def on_stateless(self, data: Payload) -> None:
+        try:
+            request = json.loads(data.payload)
+        except (TypeError, ValueError):
+            return
+        action = request.get("action", "") if isinstance(request, dict) else ""
+        if not action.startswith("history."):
+            return
+        name = data.document_name
+        document = data.document
+        reply = data.connection.send_stateless
+
+        if action in ("history.checkpoint", "history.restore") and getattr(
+            data.connection, "read_only", False
+        ):
+            # the sync path refuses read-only updates; a restore that
+            # rewrites every root (or minting checkpoints) must not be
+            # a side door around that permission
+            reply(json.dumps({"event": "history.error", "error": "read-only connection"}))
+            return
+
+        if action == "history.checkpoint":
+            version = self._checkpoint(name, request.get("label"))
+            if version is None:
+                reply(json.dumps({"event": "history.error", "error": "no history for document"}))
+                return
+            document.broadcast_stateless(
+                json.dumps({"event": "history.checkpointed", **version})
+            )
+        elif action == "history.list":
+            versions = [
+                {"id": v["id"], "label": v["label"], "ts": v["ts"]}
+                for v in self._versions(name)
+            ]
+            reply(json.dumps({"event": "history.versions", "versions": versions}))
+        elif action == "history.preview":
+            restored = self._restore_doc(name, request.get("id"))
+            if restored is None:
+                reply(json.dumps({"event": "history.error", "error": "unknown version"}))
+                return
+            update = base64.b64encode(encode_state_as_update(restored)).decode()
+            reply(
+                json.dumps(
+                    {"event": "history.preview", "id": request.get("id"), "update": update}
+                )
+            )
+        elif action == "history.restore":
+            restored = self._restore_doc(name, request.get("id"))
+            if restored is None:
+                reply(json.dumps({"event": "history.error", "error": "unknown version"}))
+                return
+            try:
+                _rewrite_live_doc(document, restored)
+            except _UnsupportedRestore as error:
+                reply(json.dumps({"event": "history.error", "error": str(error)}))
+                return
+            document.broadcast_stateless(
+                json.dumps({"event": "history.restored", "id": request.get("id")})
+            )
+        else:
+            reply(json.dumps({"event": "history.error", "error": f"unknown action {action!r}"}))
+
+    # -- internals ---------------------------------------------------------
+
+    def _versions(self, name: str) -> list[dict]:
+        hist = self._docs.get(name)
+        return hist.versions if hist is not None else []
+
+    def _checkpoint(self, name: str, label: Optional[str] = None) -> Optional[dict]:
+        hist = self._docs.get(name)
+        if hist is None:
+            return None
+        snap = snapshot(hist.archive)
+        version = {
+            "id": hist.next_id,
+            "label": label or f"version {hist.next_id}",
+            "ts": time.time(),
+            "snapshot": base64.b64encode(snap.encode()).decode(),
+        }
+        hist.next_id += 1
+        hist.versions.append(version)
+        if len(hist.versions) > self.max_versions:
+            hist.versions.pop(0)
+        return {k: version[k] for k in ("id", "label", "ts")}
+
+    def _restore_doc(self, name: str, version_id) -> Optional[Doc]:
+        hist = self._docs.get(name)
+        if hist is None:
+            return None
+        version = next((v for v in hist.versions if v["id"] == version_id), None)
+        if version is None:
+            return None
+        snap = Snapshot.decode(base64.b64decode(version["snapshot"]))
+        return create_doc_from_snapshot(hist.archive, snap)
+
+
+class _UnsupportedRestore(Exception):
+    pass
+
+
+def _classify_root(ytype) -> str:
+    """Best-effort root-type classification: roots created by remote
+    integrates are GENERIC AbstractType instances until typed access."""
+    if isinstance(ytype, YText):
+        return "text"
+    if isinstance(ytype, YMap):
+        return "map"
+    if isinstance(ytype, YArray):
+        return "array"
+    if ytype._map and ytype._start is None:
+        return "map"
+    item = ytype._start
+    while item is not None:
+        if isinstance(item.content, (ContentString, ContentFormat)):
+            return "text"
+        if isinstance(item.content, ContentType):
+            return "xml"
+        if not item.deleted:
+            return "array"
+        item = item.right
+    return "text" if not ytype._map else "map"
+
+
+def _rewrite_live_doc(document, restored: Doc) -> None:
+    """Make the live doc render the restored version, as ordinary edits
+    (one transaction -> one broadcastable update; undoable)."""
+    names = set(document.share.keys()) | set(restored.share.keys())
+    plan: list = []
+    # validate EVERYTHING before mutating: a mid-transaction refusal
+    # would leave the live doc half-rewritten
+    for name in sorted(names):
+        target = restored.share.get(name)
+        kind = _classify_root(
+            target if target is not None else document.share[name]
+        )
+        if kind == "xml":
+            raise _UnsupportedRestore(
+                f"root {name!r} is an XML tree: preview-only (restore is "
+                "supported for text/map/array roots)"
+            )
+        delta = None
+        if kind == "text" and target is not None:
+            delta = restored.get_text(name).to_delta()
+            for op in delta:
+                if isinstance(op.get("insert"), AbstractType):
+                    # a nested Y type from the RESTORED doc must not be
+                    # re-integrated into the live doc (one instance
+                    # cannot belong to two docs)
+                    raise _UnsupportedRestore(
+                        f"text root {name!r} embeds a Y type: preview-only"
+                    )
+        plan.append((name, kind, target, delta))
+
+    def run(_transaction) -> None:
+        for name, kind, target, delta in plan:
+            if kind == "text":
+                live = document.get_text(name)
+                live.delete(0, len(live))
+                if delta:
+                    live.apply_delta(delta)
+            elif kind == "map":
+                live = document.get_map(name)
+                old = restored.get_map(name).to_json() if target is not None else {}
+                for key in list(live.keys()):
+                    if key not in old:
+                        live.delete(key)
+                for key, value in old.items():
+                    live.set(key, value)
+            elif kind == "array":
+                live = document.get_array(name)
+                live.delete(0, len(live))
+                old = restored.get_array(name).to_json() if target is not None else []
+                if old:
+                    live.insert(0, old)
+
+    document.transact(run, origin="history.restore")

@@ -1,0 +1,267 @@
+"""Version-history extension: checkpoint / list / preview / restore
+driven by real providers over the stateless channel."""
+
+import base64
+import json
+
+from hocuspocus_tpu.crdt import Doc, apply_update
+from hocuspocus_tpu.extensions import History
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+def _collect(provider, into):
+    provider.on("stateless", lambda data: into.append(json.loads(data["payload"])))
+
+
+async def test_checkpoint_list_preview_restore_roundtrip():
+    history = History()
+    server = await new_hocuspocus(extensions=[history])
+    a = new_provider(server, name="versioned")
+    b = new_provider(server, name="versioned")
+    a_events: list = []
+    b_events: list = []
+    _collect(a, a_events)
+    _collect(b, b_events)
+    try:
+        await wait_synced(a, b)
+        ta = a.document.get_text("t")
+        ta.insert(0, "first draft")
+        ta.format(0, 5, {"bold": True})
+        a.document.get_map("meta").set("stage", "draft")
+        await retryable_assertion(
+            lambda: _assert(b.document.get_text("t").to_string() == "first draft")
+        )
+
+        a.send_stateless(json.dumps({"action": "history.checkpoint", "label": "v1"}))
+        # checkpoint broadcasts to EVERY client
+        await retryable_assertion(
+            lambda: _assert(
+                any(e.get("event") == "history.checkpointed" for e in b_events)
+            )
+        )
+        checkpointed = next(e for e in b_events if e["event"] == "history.checkpointed")
+        assert checkpointed["label"] == "v1"
+        vid = checkpointed["id"]
+
+        # keep editing past the checkpoint
+        ta.delete(0, 6)
+        ta.insert(0, "second ")
+        a.document.get_map("meta").set("stage", "final")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "second draft"
+            )
+        )
+
+        # list
+        a.send_stateless(json.dumps({"action": "history.list"}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.versions" for e in a_events))
+        )
+        versions = next(e for e in a_events if e["event"] == "history.versions")
+        assert [v["id"] for v in versions["versions"]] == [vid]
+
+        # preview: client reconstructs the version from update bytes
+        a.send_stateless(json.dumps({"action": "history.preview", "id": vid}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.preview" for e in a_events))
+        )
+        preview = next(e for e in a_events if e["event"] == "history.preview")
+        pdoc = Doc()
+        apply_update(pdoc, base64.b64decode(preview["update"]), "preview")
+        assert pdoc.get_text("t").to_string() == "first draft"
+        assert pdoc.get_text("t").to_delta()[0] == {
+            "insert": "first",
+            "attributes": {"bold": True},
+        }
+        assert pdoc.get_map("meta").get("stage") == "draft"
+
+        # restore: BOTH live clients converge back to v1, formatting intact
+        b.send_stateless(json.dumps({"action": "history.restore", "id": vid}))
+        await retryable_assertion(
+            lambda: _assert(
+                a.document.get_text("t").to_string() == "first draft"
+                and b.document.get_text("t").to_string() == "first draft"
+                and a.document.get_map("meta").get("stage") == "draft"
+            ),
+            timeout=15,
+        )
+        assert a.document.get_text("t").to_delta()[0] == {
+            "insert": "first",
+            "attributes": {"bold": True},
+        }
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.restored" for e in a_events))
+        )
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+async def test_unknown_version_and_action_answer_errors():
+    server = await new_hocuspocus(extensions=[History()])
+    p = new_provider(server, name="errs")
+    events: list = []
+    _collect(p, events)
+    try:
+        await wait_synced(p)
+        p.send_stateless(json.dumps({"action": "history.restore", "id": 999}))
+        p.send_stateless(json.dumps({"action": "history.bogus"}))
+        await retryable_assertion(
+            lambda: _assert(
+                sum(1 for e in events if e.get("event") == "history.error") >= 2
+            )
+        )
+    finally:
+        p.destroy()
+        await server.destroy()
+
+
+async def test_array_roots_restore_and_version_cap():
+    history = History(max_versions=2)
+    server = await new_hocuspocus(extensions=[history])
+    p = new_provider(server, name="arr")
+    events: list = []
+    _collect(p, events)
+    try:
+        await wait_synced(p)
+        arr = p.document.get_array("items")
+        arr.insert(0, [1, 2, 3])
+        await retryable_assertion(lambda: _assert(len(history._docs["arr"].archive.get_array("items")) == 3))
+        for label in ("one", "two", "three"):  # cap 2: 'one' evicted
+            p.send_stateless(json.dumps({"action": "history.checkpoint", "label": label}))
+        await retryable_assertion(
+            lambda: _assert(
+                sum(1 for e in events if e.get("event") == "history.checkpointed") == 3
+            )
+        )
+        ids = [e["id"] for e in events if e.get("event") == "history.checkpointed"]
+        p.send_stateless(json.dumps({"action": "history.list"}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.versions" for e in events))
+        )
+        versions = next(e for e in events if e["event"] == "history.versions")
+        assert [v["label"] for v in versions["versions"]] == ["two", "three"]
+
+        arr.delete(0, 3)
+        arr.insert(0, ["changed"])
+        p.send_stateless(json.dumps({"action": "history.restore", "id": ids[-1]}))
+        await retryable_assertion(
+            lambda: _assert(p.document.get_array("items").to_json() == [1, 2, 3]),
+            timeout=15,
+        )
+    finally:
+        p.destroy()
+        await server.destroy()
+
+
+async def test_xml_roots_are_preview_only():
+    server = await new_hocuspocus(extensions=[History()])
+    p = new_provider(server, name="xmldoc")
+    events: list = []
+    _collect(p, events)
+    try:
+        await wait_synced(p)
+        from hocuspocus_tpu.crdt import YXmlElement
+
+        p.document.get_xml_fragment("x").push([YXmlElement("p")])
+        p.send_stateless(json.dumps({"action": "history.checkpoint"}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.checkpointed" for e in events))
+        )
+        vid = next(e["id"] for e in events if e["event"] == "history.checkpointed")
+        p.send_stateless(json.dumps({"action": "history.restore", "id": vid}))
+        await retryable_assertion(
+            lambda: _assert(
+                any(
+                    e.get("event") == "history.error" and "XML" in e.get("error", "")
+                    for e in events
+                )
+            )
+        )
+        # preview still works for XML docs
+        p.send_stateless(json.dumps({"action": "history.preview", "id": vid}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.preview" for e in events))
+        )
+    finally:
+        p.destroy()
+        await server.destroy()
+
+
+async def test_read_only_connection_cannot_checkpoint_or_restore():
+    """Stateless messages reach hooks regardless of permissions — the
+    extension itself must refuse writes from read-only connections."""
+
+    async def on_authenticate(data):
+        data.connection_config.read_only = True
+
+    server = await new_hocuspocus(
+        extensions=[History()], on_authenticate=on_authenticate
+    )
+    p = new_provider(server, name="ro", token="t")
+    events: list = []
+    _collect(p, events)
+    try:
+        await wait_synced(p)
+        p.send_stateless(json.dumps({"action": "history.checkpoint"}))
+        p.send_stateless(json.dumps({"action": "history.restore", "id": 1}))
+        await retryable_assertion(
+            lambda: _assert(
+                sum(
+                    1
+                    for e in events
+                    if e.get("event") == "history.error"
+                    and "read-only" in e.get("error", "")
+                )
+                == 2
+            )
+        )
+        # reads still work
+        p.send_stateless(json.dumps({"action": "history.list"}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.versions" for e in events))
+        )
+    finally:
+        p.destroy()
+        await server.destroy()
+
+
+async def test_unload_and_reload_with_history_installed():
+    """The unload payload carries only the document name; the extension
+    must detach its update listener from the reference captured at load
+    (and a reloaded doc starts a fresh archive)."""
+    history = History()
+    server = await new_hocuspocus(extensions=[history], debounce=10)
+    p = new_provider(server, name="transient")
+    try:
+        await wait_synced(p)
+        p.document.get_text("t").insert(0, "before unload")
+        await retryable_assertion(
+            lambda: _assert(
+                history._docs["transient"].archive.get_text("t").to_string()
+                == "before unload"
+            )
+        )
+    finally:
+        p.destroy()
+    # unload happens after the last connection drops
+    await retryable_assertion(
+        lambda: _assert("transient" not in server.documents)
+    )
+    assert "transient" not in history._docs
+
+    # reconnect: fresh archive seeded from whatever persisted/loaded
+    q = new_provider(server, name="transient")
+    try:
+        await wait_synced(q)
+        await retryable_assertion(lambda: _assert("transient" in history._docs))
+    finally:
+        q.destroy()
+        await server.destroy()
