@@ -1,0 +1,84 @@
+"""Document version history: checkpoint, preview, and restore.
+
+Drives the History extension end-to-end in one process: a server with
+`History(checkpoint_on_store=True)`, a writer making edits across
+checkpoints, and a reviewer client listing versions, previewing an old
+one (client-side reconstruction from update bytes), and restoring it —
+the restore propagates to every connected client as ordinary edits.
+
+Run: python examples/version_history.py
+"""
+
+import asyncio
+import base64
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hocuspocus_tpu import Configuration, Server  # noqa: E402
+from hocuspocus_tpu.crdt import Doc, apply_update  # noqa: E402
+from hocuspocus_tpu.extensions import History  # noqa: E402
+from hocuspocus_tpu.provider import HocuspocusProvider  # noqa: E402
+
+
+async def wait(predicate, timeout=10.0):
+    for _ in range(int(timeout / 0.05)):
+        if predicate():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError
+
+
+async def main() -> None:
+    server = Server(Configuration(quiet=True, extensions=[History()]))
+    await server.listen(port=0)
+    url = server.web_socket_url
+
+    writer = HocuspocusProvider(name="article", url=url)
+    reviewer = HocuspocusProvider(name="article", url=url)
+    events: list = []
+    reviewer.on("stateless", lambda d: events.append(json.loads(d["payload"])))
+    await wait(lambda: writer.synced and reviewer.synced)
+
+    def checkpoint(label: str) -> None:
+        writer.send_stateless(json.dumps({"action": "history.checkpoint", "label": label}))
+
+    text = writer.document.get_text("body")
+    text.insert(0, "Draft: collaborative editing on TPUs.")
+    checkpoint("first draft")
+    await wait(lambda: any(e.get("event") == "history.checkpointed" for e in events))
+
+    text.delete(0, 6)
+    text.insert(0, "Final:")
+    text.format(0, 6, {"bold": True})
+    checkpoint("final")
+    await wait(
+        lambda: sum(1 for e in events if e.get("event") == "history.checkpointed") >= 2
+    )
+
+    reviewer.send_stateless(json.dumps({"action": "history.list"}))
+    await wait(lambda: any(e.get("event") == "history.versions" for e in events))
+    versions = next(e for e in events if e["event"] == "history.versions")["versions"]
+    print("versions:", [(v["id"], v["label"]) for v in versions])
+
+    first = versions[0]
+    reviewer.send_stateless(json.dumps({"action": "history.preview", "id": first["id"]}))
+    await wait(lambda: any(e.get("event") == "history.preview" for e in events))
+    preview = next(e for e in events if e["event"] == "history.preview")
+    pdoc = Doc()
+    apply_update(pdoc, base64.b64decode(preview["update"]), "preview")
+    print("preview of", first["label"], "->", pdoc.get_text("body").to_string()[:40])
+
+    reviewer.send_stateless(json.dumps({"action": "history.restore", "id": first["id"]}))
+    await wait(lambda: writer.document.get_text("body").to_string().startswith("Draft:"))
+    print("restored; writer now sees:", writer.document.get_text("body").to_string()[:40])
+
+    writer.destroy()
+    reviewer.destroy()
+    await server.destroy()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
