@@ -1,10 +1,12 @@
 """Document version history: checkpoint, preview, and restore.
 
-Drives the History extension end-to-end in one process: a server with
-`History(checkpoint_on_store=True)`, a writer making edits across
-checkpoints, and a reviewer client listing versions, previewing an old
-one (client-side reconstruction from update bytes), and restoring it —
-the restore propagates to every connected client as ordinary edits.
+Drives the History extension end-to-end in one process: a writer
+minting explicit checkpoints across edits, and a reviewer client
+listing versions, previewing an old one (client-side reconstruction
+from update bytes), and restoring it — the restore propagates to every
+connected client as ordinary edits. (Pass
+`History(checkpoint_on_store=True)` to ALSO mint one per debounced
+store.)
 
 Run: python examples/version_history.py
 """

@@ -25,6 +25,7 @@ from .types import (
     YXmlHook,
     YXmlText,
 )
+from .permanent_user_data import PermanentUserData
 from .relative_position import (
     AbsolutePosition,
     RelativePosition,
@@ -91,6 +92,7 @@ __all__ = [
     "is_visible",
     "split_snapshot_affected_structs",
     "AbsolutePosition",
+    "PermanentUserData",
     "RelativePosition",
     "compare_relative_positions",
     "create_absolute_position_from_relative_position",
