@@ -40,6 +40,18 @@ def _decode_ds(data: bytes) -> DeleteSet:
     return DeleteSet.read(Decoder(bytes(data)))
 
 
+def _decode_ds_safe(data: Any) -> Optional[DeleteSet]:
+    """The registry replicates from UNTRUSTED peers; junk bytes must
+    not crash the observer (which can run inside another client's
+    update emit on a server archive)."""
+    if not isinstance(data, (bytes, bytearray)):
+        return None
+    try:
+        return _decode_ds(data)
+    except Exception:
+        return None
+
+
 def _encode_ds(ds: DeleteSet) -> bytes:
     encoder = Encoder()
     ds.write(encoder)
@@ -53,29 +65,38 @@ class PermanentUserData:
         self.clients: dict[int, str] = {}
         self.dss: dict[str, DeleteSet] = {}
 
-        def init_user(user: YMap, description: str) -> None:
+        def init_user(user: Any, description: str) -> None:
+            # the registry replicates from peers: a malformed entry
+            # (plain value, missing arrays) is IGNORED, never raised —
+            # this observer can fire inside another client's update
+            # emit on a server-side archive
+            if not isinstance(user, YMap):
+                return
             ds = user.get("ds")
             ids = user.get("ids")
+            if not isinstance(ds, YArray) or not isinstance(ids, YArray):
+                return
 
             def add_client_id(client_id: Any) -> None:
-                self.clients[int(client_id)] = description
+                if isinstance(client_id, int) or (
+                    isinstance(client_id, float) and client_id.is_integer()
+                ):
+                    self.clients[int(client_id)] = description
 
             def on_ds(event, _transaction) -> None:
                 for item in event.changes["added"]:
                     for encoded in item.content.get_content():
-                        if isinstance(encoded, (bytes, bytearray)):
+                        decoded = _decode_ds_safe(encoded)
+                        if decoded is not None:
                             self.dss[description] = merge_delete_sets(
-                                [
-                                    self.dss.get(description, DeleteSet()),
-                                    _decode_ds(encoded),
-                                ]
+                                [self.dss.get(description, DeleteSet()), decoded]
                             )
 
             ds.observe(on_ds)
-            self.dss[description] = merge_delete_sets(
-                [_decode_ds(encoded) for encoded in ds.to_array()]
-                or [DeleteSet()]
-            )
+            decoded_all = [
+                d for d in (_decode_ds_safe(e) for e in ds.to_array()) if d is not None
+            ]
+            self.dss[description] = merge_delete_sets(decoded_all or [DeleteSet()])
 
             def on_ids(event, _transaction) -> None:
                 for item in event.changes["added"]:

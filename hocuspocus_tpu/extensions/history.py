@@ -47,7 +47,7 @@ from ..server.types import Extension, Payload
 
 
 class _DocHistory:
-    __slots__ = ("archive", "versions", "next_id", "listener", "document")
+    __slots__ = ("archive", "versions", "next_id", "listener", "document", "pud")
 
     def __init__(self) -> None:
         self.archive = Doc(gc=False)
@@ -57,6 +57,9 @@ class _DocHistory:
         # the LIVE doc the listener is attached to: the unload hook's
         # payload carries only the name (the doc is already torn down)
         self.document = None
+        # lazily-created PermanentUserData over the archive (one per
+        # doc — each instance registers observers on the users arrays)
+        self.pud = None
 
 
 class History(Extension):
@@ -148,6 +151,60 @@ class History(Extension):
                     {"event": "history.preview", "id": request.get("id"), "update": update}
                 )
             )
+        elif action == "history.diff":
+            # attributed diff of a TEXT root between a version and now
+            # (or between two versions): ychange added/removed runs,
+            # with author names when a PermanentUserData registry is
+            # replicated in the doc (root "users")
+            hist = self._docs.get(name)
+            if hist is None:
+                reply(json.dumps({"event": "history.error", "error": "no history for document"}))
+                return
+            base = self._find_version(name, request.get("id"))
+            if base is None:
+                reply(json.dumps({"event": "history.error", "error": "unknown version"}))
+                return
+            if request.get("until") is not None:
+                until = self._find_version(name, request.get("until"))
+                if until is None:
+                    reply(json.dumps({"event": "history.error", "error": "unknown 'until' version"}))
+                    return
+            else:
+                # "until now" needs a CONCRETE snapshot: removed-run
+                # marking compares visibility against it (a None
+                # snapshot renders plain current text, yjs semantics)
+                until = snapshot(hist.archive)
+            root = request.get("root", "default")
+            target = hist.archive.share.get(root)
+            if target is None or _classify_root(target) != "text":
+                # never get_text() an unvalidated client-supplied name:
+                # it would CREATE a missing root or raise retyping an
+                # existing non-text one (e.g. the "users" registry)
+                reply(
+                    json.dumps(
+                        {"event": "history.error", "error": f"root {root!r} is not a text root"}
+                    )
+                )
+                return
+            compute = self._ychange_resolver(hist)
+            delta = hist.archive.get_text(root).to_delta(
+                until, base, compute_ychange=compute
+            )
+            for op in delta:
+                if isinstance(op.get("insert"), AbstractType):
+                    # embedded Y types are not JSON: ship their snapshot
+                    op["insert"] = op["insert"].to_json()
+            reply(
+                json.dumps(
+                    {
+                        "event": "history.diff",
+                        "id": request.get("id"),
+                        "until": request.get("until"),
+                        "root": root,
+                        "delta": delta,
+                    }
+                )
+            )
         elif action == "history.restore":
             restored = self._restore_doc(name, request.get("id"))
             if restored is None:
@@ -187,15 +244,44 @@ class History(Extension):
             hist.versions.pop(0)
         return {k: version[k] for k in ("id", "label", "ts")}
 
-    def _restore_doc(self, name: str, version_id) -> Optional[Doc]:
+    def _find_version(self, name: str, version_id) -> Optional[Snapshot]:
         hist = self._docs.get(name)
         if hist is None:
             return None
         version = next((v for v in hist.versions if v["id"] == version_id), None)
         if version is None:
             return None
-        snap = Snapshot.decode(base64.b64decode(version["snapshot"]))
-        return create_doc_from_snapshot(hist.archive, snap)
+        return Snapshot.decode(base64.b64decode(version["snapshot"]))
+
+    def _restore_doc(self, name: str, version_id) -> Optional[Doc]:
+        snap = self._find_version(name, version_id)
+        if snap is None:
+            return None
+        return create_doc_from_snapshot(self._docs[name].archive, snap)
+
+    def _ychange_resolver(self, hist: _DocHistory):
+        """compute_ychange backed by the doc's replicated user registry
+        (root "users", PermanentUserData layout); plain marks when the
+        doc has none."""
+        if "users" not in hist.archive.share:
+            return None
+        if hist.pud is None:
+            from ..crdt import PermanentUserData
+
+            hist.pud = PermanentUserData(hist.archive)
+
+        def compute(kind: str, struct_id) -> dict:
+            user = (
+                hist.pud.get_user_by_deleted_id(struct_id)
+                if kind == "removed"
+                else hist.pud.get_user_by_client_id(struct_id.client)
+            )
+            out = {"type": kind}
+            if user is not None:
+                out["user"] = user
+            return out
+
+        return compute
 
 
 class _UnsupportedRestore(Exception):

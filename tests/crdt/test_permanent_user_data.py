@@ -108,3 +108,33 @@ def test_concurrent_mapping_for_same_description_converges():
     for pud in (p1, p2):
         assert pud.get_user_by_client_id(d1.client_id) == "carol"
         assert pud.get_user_by_client_id(d2.client_id) == "carol"
+
+
+def test_malformed_registry_entries_are_ignored():
+    """Peers can write junk into the replicated registry; observers run
+    inside OTHER clients' update emits and must never raise."""
+    bad = Doc()
+    users = bad.get_map("users")
+    users.set("plain-value", "not a map")
+    from hocuspocus_tpu.crdt.types.ymap import YMap as _YMap
+
+    entry = _YMap()
+    entry.set("ids", "not an array")
+    users.set("missing-arrays", entry)
+    entry2 = _YMap()
+    from hocuspocus_tpu.crdt.types.yarray import YArray as _YArray
+
+    ds_arr = _YArray()
+    entry2.set("ids", _YArray())
+    entry2.set("ds", ds_arr)
+    users.set("junk-ds", entry2)
+    ds_arr.push([b"\xff\xff\xff garbage"])
+    entry2.get("ids").push(["not-an-int"])
+
+    good = Doc()
+    pud = PermanentUserData(good)  # registry replicates INTO this doc
+    pud.set_user_mapping(good, good.client_id, "real-user")
+    _sync(bad, good)  # applying the junk must not raise
+
+    assert pud.get_user_by_client_id(good.client_id) == "real-user"
+    assert pud.get_user_by_client_id(999) is None

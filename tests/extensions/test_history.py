@@ -265,3 +265,66 @@ async def test_unload_and_reload_with_history_installed():
     finally:
         q.destroy()
         await server.destroy()
+
+
+async def test_history_diff_attributes_authors():
+    """history.diff renders an attributed version diff: ychange
+    added/removed runs carry user names when the doc replicates a
+    PermanentUserData registry."""
+    from hocuspocus_tpu.crdt import PermanentUserData
+
+    server = await new_hocuspocus(extensions=[History()])
+    alice = new_provider(server, name="attributed")
+    bob = new_provider(server, name="attributed")
+    events: list = []
+    _collect(alice, events)
+    try:
+        await wait_synced(alice, bob)
+        pud_a = PermanentUserData(alice.document)
+        pud_b = PermanentUserData(bob.document)
+        pud_a.set_user_mapping(alice.document, alice.document.client_id, "alice")
+        pud_b.set_user_mapping(bob.document, bob.document.client_id, "bob")
+
+        ta = alice.document.get_text("t")
+        ta.insert(0, "alice wrote everything")
+        await retryable_assertion(
+            lambda: _assert(
+                bob.document.get_text("t").to_string() == "alice wrote everything"
+            )
+        )
+        alice.send_stateless(json.dumps({"action": "history.checkpoint", "label": "base"}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.checkpointed" for e in events))
+        )
+        vid = next(e["id"] for e in events if e["event"] == "history.checkpointed")
+
+        # bob removes alice's words and adds his own
+        tb = bob.document.get_text("t")
+        tb.delete(0, 6)
+        tb.insert(0, "bob says: ")
+        await retryable_assertion(
+            lambda: _assert(
+                alice.document.get_text("t").to_string()
+                == "bob says: wrote everything"
+            )
+        )
+
+        alice.send_stateless(
+            json.dumps({"action": "history.diff", "id": vid, "root": "t"})
+        )
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.diff" for e in events)),
+            timeout=15,
+        )
+        delta = next(e for e in events if e["event"] == "history.diff")["delta"]
+        marks = {
+            (op["attributes"]["ychange"]["type"], op["attributes"]["ychange"].get("user")): op["insert"]
+            for op in delta
+            if "attributes" in op and "ychange" in op["attributes"]
+        }
+        assert marks.get(("added", "bob")) == "bob says: ", delta
+        assert marks.get(("removed", "bob")) == "alice ", delta
+    finally:
+        alice.destroy()
+        bob.destroy()
+        await server.destroy()
