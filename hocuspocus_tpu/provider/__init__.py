@@ -1,9 +1,12 @@
+from .history import HistoryClient, HistoryError
 from .inprocess import InProcessProviderSocket
 from .message_receiver import MessageReceiver
 from .provider import AwarenessError, HocuspocusProvider
 from .websocket import HocuspocusProviderWebsocket, WebSocketStatus
 
 __all__ = [
+    "HistoryClient",
+    "HistoryError",
     "InProcessProviderSocket",
     "MessageReceiver",
     "AwarenessError",

@@ -1,0 +1,134 @@
+"""HistoryClient — awaitable client API over the History extension.
+
+Wraps the stateless JSON protocol (extensions/history.py) into
+futures: requests correlate to their replies by event kind, broadcast
+events (`history.checkpointed` / `history.restored`) surface through
+the provider's observable interface, and previews come back as a
+reconstructed `Doc`.
+
+    history = HistoryClient(provider)
+    version = await history.checkpoint("before cleanup")
+    versions = await history.list()
+    old_doc = await history.preview(versions[0]["id"])
+    delta = await history.diff(versions[0]["id"], root="t")
+    await history.restore(versions[0]["id"])
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+from typing import Any, Optional
+
+from ..crdt import Doc, apply_update
+
+
+class HistoryError(Exception):
+    pass
+
+
+# reply event each request resolves on
+_REPLY_EVENT = {
+    "history.checkpoint": "history.checkpointed",
+    "history.list": "history.versions",
+    "history.preview": "history.preview",
+    "history.restore": "history.restored",
+    "history.diff": "history.diff",
+}
+
+
+class HistoryClient:
+    """Note on correlation: replies are matched by event KIND in send
+    order (the server answers a connection's requests in order).
+    `history.checkpointed` / `history.restored` are broadcasts — if
+    ANOTHER client performs the same action while yours is in flight,
+    its broadcast may resolve your waiter one action early; both
+    actions did succeed, so this only blurs which id you get back."""
+
+    def __init__(self, provider: Any, timeout: float = 10.0) -> None:
+        self.provider = provider
+        self.timeout = timeout
+        self._pending: list = []  # (reply_kind, future), send order
+        provider.on("stateless", self._on_stateless)
+
+    def _on_stateless(self, data: dict) -> None:
+        try:
+            event = json.loads(data["payload"])
+        except (TypeError, ValueError, KeyError):
+            return
+        if not isinstance(event, dict):
+            return
+        kind = event.get("event", "")
+        if not kind.startswith("history."):
+            return
+        if kind == "history.error":
+            # replies are ordered per connection: the failing request
+            # is the OLDEST one still outstanding
+            if self._pending:
+                _kind, future = self._pending.pop(0)
+                if not future.done():
+                    future.set_exception(HistoryError(event.get("error", "unknown")))
+            return
+        for i, (want, future) in enumerate(self._pending):
+            if want == kind:
+                del self._pending[i]
+                if not future.done():
+                    future.set_result(event)
+                return
+
+    async def _request(self, action: str, **fields: Any) -> dict:
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        entry = (_REPLY_EVENT[action], future)
+        self._pending.append(entry)
+        self.provider.send_stateless(json.dumps({"action": action, **fields}))
+        try:
+            return await asyncio.wait_for(future, self.timeout)
+        finally:
+            # a timed-out request must unregister, or its dead entry
+            # swallows the next same-kind reply (and error routing)
+            if entry in self._pending:
+                self._pending.remove(entry)
+
+    async def checkpoint(self, label: Optional[str] = None) -> dict:
+        """Mint a version; resolves with {id, label, ts} (the broadcast
+        every client receives)."""
+        fields = {"label": label} if label is not None else {}
+        event = await self._request("history.checkpoint", **fields)
+        return {k: event[k] for k in ("id", "label", "ts")}
+
+    async def list(self) -> list[dict]:
+        event = await self._request("history.list")
+        return event["versions"]
+
+    async def preview(self, version_id: int) -> Doc:
+        """The checkpointed document, reconstructed client-side."""
+        event = await self._request("history.preview", id=version_id)
+        doc = Doc()
+        apply_update(doc, base64.b64decode(event["update"]), "history.preview")
+        return doc
+
+    async def diff(
+        self,
+        version_id: int,
+        root: str = "default",
+        until: Optional[int] = None,
+    ) -> list[dict]:
+        """ychange-marked delta of `root` between a version and now (or
+        `until`), author-attributed when the doc replicates a
+        PermanentUserData registry."""
+        fields: dict = {"id": version_id, "root": root}
+        if until is not None:
+            fields["until"] = until
+        event = await self._request("history.diff", **fields)
+        return event["delta"]
+
+    async def restore(self, version_id: int) -> None:
+        await self._request("history.restore", id=version_id)
+
+    def destroy(self) -> None:
+        self.provider.off("stateless", self._on_stateless)
+        for _kind, future in self._pending:
+            if not future.done():
+                future.cancel()
+        self._pending.clear()

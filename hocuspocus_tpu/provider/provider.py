@@ -297,6 +297,62 @@ class HocuspocusProvider(Observable):
             )
         self.awareness.set_local_state_field(key, value)
 
+    def set_awareness_cursor(
+        self,
+        ytype: Any,
+        anchor: int,
+        head: "Optional[int]" = None,
+        field: str = "cursor",
+    ) -> None:
+        """Publish a caret/selection as RELATIVE positions — anchors
+        that keep pointing at the same characters through concurrent
+        edits (the collaboration-cursor convention; peers resolve with
+        `resolve_awareness_cursor`)."""
+        from ..crdt import (
+            create_relative_position_from_type_index,
+            encode_relative_position,
+        )
+
+        head = anchor if head is None else head
+        self.set_awareness_field(
+            field,
+            {
+                "anchor": encode_relative_position(
+                    create_relative_position_from_type_index(ytype, anchor)
+                ).hex(),
+                "head": encode_relative_position(
+                    create_relative_position_from_type_index(ytype, head)
+                ).hex(),
+            },
+        )
+
+    @staticmethod
+    def resolve_awareness_cursor(state_field: Any, doc: Any) -> "Optional[dict]":
+        """Resolve a peer's cursor field (as published by
+        `set_awareness_cursor`) against MY copy of the doc; None when
+        the field is malformed or the anchors are unknown here."""
+        from ..crdt import (
+            create_absolute_position_from_relative_position,
+            decode_relative_position,
+        )
+
+        if not isinstance(state_field, dict):
+            return None
+        out = {}
+        for key in ("anchor", "head"):
+            raw = state_field.get(key)
+            if not isinstance(raw, str):
+                return None
+            try:
+                rpos = decode_relative_position(bytes.fromhex(raw))
+            except Exception:
+                return None
+            pos = create_absolute_position_from_relative_position(rpos, doc)
+            if pos is None:
+                return None
+            out[key] = pos.index
+        return out
+
     def connect(self):
         if self.manage_socket:
             self.websocket_provider.connect()

@@ -1,0 +1,112 @@
+"""HistoryClient + awareness-cursor helpers: the client-side DX layer
+over the History extension and relative positions."""
+
+import pytest
+
+from hocuspocus_tpu.extensions import History
+from hocuspocus_tpu.provider import HistoryClient, HistoryError
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+async def test_history_client_full_flow():
+    server = await new_hocuspocus(extensions=[History()])
+    writer = new_provider(server, name="hc-doc")
+    reviewer = new_provider(server, name="hc-doc")
+    history = HistoryClient(reviewer)
+    try:
+        await wait_synced(writer, reviewer)
+        text = writer.document.get_text("t")
+        text.insert(0, "checkpoint me")
+        await retryable_assertion(
+            lambda: _assert(reviewer.document.get_text("t").to_string() == "checkpoint me")
+        )
+
+        version = await history.checkpoint("v1")
+        assert version["label"] == "v1"
+
+        text.insert(0, "NEW: ")
+        await retryable_assertion(
+            lambda: _assert(
+                reviewer.document.get_text("t").to_string() == "NEW: checkpoint me"
+            )
+        )
+
+        versions = await history.list()
+        assert [v["label"] for v in versions] == ["v1"]
+
+        old = await history.preview(version["id"])
+        assert old.get_text("t").to_string() == "checkpoint me"
+
+        delta = await history.diff(version["id"], root="t")
+        added = [
+            op["insert"]
+            for op in delta
+            if op.get("attributes", {}).get("ychange", {}).get("type") == "added"
+        ]
+        assert added == ["NEW: "]
+
+        await history.restore(version["id"])
+        await retryable_assertion(
+            lambda: _assert(
+                writer.document.get_text("t").to_string() == "checkpoint me"
+            )
+        )
+
+        with pytest.raises(HistoryError):
+            await history.preview(99999)
+    finally:
+        history.destroy()
+        writer.destroy()
+        reviewer.destroy()
+        await server.destroy()
+
+
+async def test_awareness_cursor_helpers_roundtrip():
+    server = await new_hocuspocus()
+    a = new_provider(server, name="cursor-doc")
+    b = new_provider(server, name="cursor-doc")
+    try:
+        await wait_synced(a, b)
+        ta = a.document.get_text("t")
+        ta.insert(0, "the quick brown fox")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "the quick brown fox"
+            )
+        )
+
+        # A selects "quick" (4..9); B resolves it against ITS doc copy
+        a.set_awareness_cursor(ta, 4, 9)
+
+        def _b_sees_cursor():
+            states = b.awareness.get_states()
+            state = states.get(a.document.client_id)
+            _assert(state is not None and "cursor" in state)
+            resolved = b.resolve_awareness_cursor(state["cursor"], b.document)
+            _assert(resolved == {"anchor": 4, "head": 9})
+
+        await retryable_assertion(_b_sees_cursor)
+
+        # concurrent edits shift the selection but not its TARGET text
+        b.document.get_text("t").insert(0, ">>> ")
+        await retryable_assertion(
+            lambda: _assert(ta.to_string().startswith(">>> "))
+        )
+        state = b.awareness.get_states()[a.document.client_id]
+        resolved = b.resolve_awareness_cursor(state["cursor"], b.document)
+        assert resolved == {"anchor": 8, "head": 13}
+        text = b.document.get_text("t").to_string()
+        assert text[resolved["anchor"]:resolved["head"]] == "quick"
+
+        # malformed fields resolve to None, never raise
+        assert b.resolve_awareness_cursor("junk", b.document) is None
+        assert b.resolve_awareness_cursor({"anchor": "zz"}, b.document) is None
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
