@@ -25,13 +25,16 @@ Restore rewrites the LIVE document's root types to the checkpointed
 content as ordinary edits (delete + reinsert in one transaction), so it
 propagates to every client and remains undoable. Text roots keep their
 formatting via delta re-application; map/array roots restore to their
-JSON content; XML roots are preview-only for now (restore answers
-history.error for them).
+JSON content; XML trees restore via deep prelim clones (elements keep
+attributes and children, text keeps its formatted delta). Y-type
+embeds inside text remain preview-only (one type instance cannot
+belong to two docs).
 """
 
 from __future__ import annotations
 
 import base64
+import copy
 import json
 import time
 from typing import Any, Optional
@@ -311,6 +314,42 @@ def _classify_root(ytype) -> str:
     return "text" if not ytype._map else "map"
 
 
+def _clone_xml_node(node):
+    """Deep-copy a restored-doc XML node into a FRESH prelim node the
+    live doc can integrate (one type instance cannot belong to two
+    docs). Elements keep attributes and children; text keeps its
+    formatted delta."""
+    from ..crdt.types.yxml import YXmlElement, YXmlText
+
+    if isinstance(node, YXmlText):
+        fresh = YXmlText()
+        delta = node.to_delta()
+        for op in delta:
+            if isinstance(op.get("insert"), AbstractType):
+                raise _UnsupportedRestore("XML text embeds a Y type: preview-only")
+        if delta:
+            fresh.apply_delta(delta)
+        return fresh
+    if isinstance(node, YXmlElement):
+        fresh = YXmlElement(node.node_name)
+        for key, value in node.get_attributes().items():
+            if isinstance(value, AbstractType):
+                raise _UnsupportedRestore(
+                    "XML attribute holds a Y type: preview-only"
+                )
+            fresh.set_attribute(key, value)
+        kids = [_clone_xml_node(child) for child in node.to_array()]
+        if kids:
+            fresh.push(kids)
+        return fresh
+    if isinstance(node, AbstractType):
+        raise _UnsupportedRestore(
+            f"unsupported XML child {type(node).__name__}: preview-only"
+        )
+    # plain values (strings, numbers, json) are legal fragment children
+    return copy.deepcopy(node)
+
+
 def _rewrite_live_doc(document, restored: Doc) -> None:
     """Make the live doc render the restored version, as ordinary edits
     (one transaction -> one broadcastable update; undoable)."""
@@ -323,15 +362,10 @@ def _rewrite_live_doc(document, restored: Doc) -> None:
         kind = _classify_root(
             target if target is not None else document.share[name]
         )
-        if kind == "xml":
-            raise _UnsupportedRestore(
-                f"root {name!r} is an XML tree: preview-only (restore is "
-                "supported for text/map/array roots)"
-            )
-        delta = None
+        payload = None
         if kind == "text" and target is not None:
-            delta = restored.get_text(name).to_delta()
-            for op in delta:
+            payload = restored.get_text(name).to_delta()
+            for op in payload:
                 if isinstance(op.get("insert"), AbstractType):
                     # a nested Y type from the RESTORED doc must not be
                     # re-integrated into the live doc (one instance
@@ -339,15 +373,26 @@ def _rewrite_live_doc(document, restored: Doc) -> None:
                     raise _UnsupportedRestore(
                         f"text root {name!r} embeds a Y type: preview-only"
                     )
-        plan.append((name, kind, target, delta))
+        elif kind == "xml" and target is not None:
+            payload = [
+                _clone_xml_node(child)
+                for child in restored.get_xml_fragment(name).to_array()
+            ]
+        plan.append((name, kind, target, payload))
 
     def run(_transaction) -> None:
-        for name, kind, target, delta in plan:
+        for name, kind, target, payload in plan:
             if kind == "text":
                 live = document.get_text(name)
                 live.delete(0, len(live))
-                if delta:
-                    live.apply_delta(delta)
+                if payload:
+                    live.apply_delta(payload)
+            elif kind == "xml":
+                live = document.get_xml_fragment(name)
+                if len(live):
+                    live.delete(0, len(live))
+                if payload:
+                    live.push(payload)
             elif kind == "map":
                 live = document.get_map(name)
                 old = restored.get_map(name).to_json() if target is not None else {}

@@ -161,37 +161,57 @@ async def test_array_roots_restore_and_version_cap():
         await server.destroy()
 
 
-async def test_xml_roots_are_preview_only():
+async def test_xml_roots_restore_via_deep_clones():
+    """XML trees restore: elements keep attributes and children, text
+    keeps its formatted delta — rebuilt as fresh prelim nodes."""
     server = await new_hocuspocus(extensions=[History()])
     p = new_provider(server, name="xmldoc")
+    q = new_provider(server, name="xmldoc")
     events: list = []
     _collect(p, events)
     try:
-        await wait_synced(p)
-        from hocuspocus_tpu.crdt import YXmlElement
+        await wait_synced(p, q)
+        from hocuspocus_tpu.crdt import YXmlElement, YXmlText
 
-        p.document.get_xml_fragment("x").push([YXmlElement("p")])
+        frag = p.document.get_xml_fragment("x")
+        para = YXmlElement("paragraph")
+        frag.push([para])
+        para.set_attribute("align", "left")
+        t = YXmlText()
+        para.push([t])
+        t.insert(0, "styled tree")
+        t.format(0, 6, {"bold": True})
+        await retryable_assertion(
+            lambda: _assert("styled" in q.document.get_xml_fragment("x").to_string())
+        )
         p.send_stateless(json.dumps({"action": "history.checkpoint"}))
         await retryable_assertion(
             lambda: _assert(any(e.get("event") == "history.checkpointed" for e in events))
         )
         vid = next(e["id"] for e in events if e["event"] == "history.checkpointed")
+        before = p.document.get_xml_fragment("x").to_string()
+
+        # mutate the tree, then restore
+        t.delete(0, 7)
+        para.set_attribute("align", "center")
+        frag.push([YXmlElement("hr")])
+        await retryable_assertion(
+            lambda: _assert("hr" in q.document.get_xml_fragment("x").to_string())
+        )
         p.send_stateless(json.dumps({"action": "history.restore", "id": vid}))
         await retryable_assertion(
             lambda: _assert(
-                any(
-                    e.get("event") == "history.error" and "XML" in e.get("error", "")
-                    for e in events
-                )
-            )
+                p.document.get_xml_fragment("x").to_string() == before
+                and q.document.get_xml_fragment("x").to_string() == before
+            ),
+            timeout=15,
         )
-        # preview still works for XML docs
-        p.send_stateless(json.dumps({"action": "history.preview", "id": vid}))
-        await retryable_assertion(
-            lambda: _assert(any(e.get("event") == "history.preview" for e in events))
-        )
+        restored_el = q.document.get_xml_fragment("x").get(0)
+        assert restored_el.get_attribute("align") == "left"
+        assert "<bold>" in before  # formatting markup survived the restore
     finally:
         p.destroy()
+        q.destroy()
         await server.destroy()
 
 
