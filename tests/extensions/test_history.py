@@ -348,3 +348,63 @@ async def test_history_diff_attributes_authors():
         alice.destroy()
         bob.destroy()
         await server.destroy()
+
+
+async def test_history_on_plane_served_docs():
+    """History must compose with the TPU serve plane: the archive feeds
+    from update events regardless of serving mode, and a restore's
+    delete-everything-reinsert transaction flows through the plane (or
+    degrades it cleanly) without losing data."""
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+
+    ext = TpuMergeExtension(num_docs=8, capacity=2048, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[History(), ext])
+    a = new_provider(server, name="plane-hist")
+    b = new_provider(server, name="plane-hist")
+    events: list = []
+    _collect(a, events)
+    try:
+        await wait_synced(a, b)
+        ta = a.document.get_text("t")
+        ta.insert(0, "plane-served history")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "plane-served history"
+            )
+        )
+        assert "plane-hist" in ext._docs  # actually plane-served
+
+        a.send_stateless(json.dumps({"action": "history.checkpoint", "label": "v1"}))
+        await retryable_assertion(
+            lambda: _assert(any(e.get("event") == "history.checkpointed" for e in events))
+        )
+        vid = next(e["id"] for e in events if e["event"] == "history.checkpointed")
+
+        ta.delete(0, 13)
+        ta.insert(0, "rewritten ")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "rewritten history"
+            )
+        )
+
+        a.send_stateless(json.dumps({"action": "history.restore", "id": vid}))
+        await retryable_assertion(
+            lambda: _assert(
+                a.document.get_text("t").to_string() == "plane-served history"
+                and b.document.get_text("t").to_string() == "plane-served history"
+            ),
+            timeout=20,
+        )
+        # steady state continues (whether still plane-served or cleanly
+        # degraded, both sides keep converging)
+        ta.insert(0, "after; ")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "after; plane-served history"
+            )
+        )
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
