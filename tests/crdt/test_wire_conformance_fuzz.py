@@ -31,6 +31,7 @@ Env: FUZZ_WIRE_SEEDS (default 1000), FUZZ_WIRE_OPS (default 28).
 
 from __future__ import annotations
 
+import json
 import os
 import random
 
@@ -70,6 +71,7 @@ REF_GC = 0
 REF_DELETED = 1
 REF_JSON = 2
 REF_STRING = 4
+REF_FORMAT = 6
 REF_ANY = 8
 REF_SKIP = 10
 
@@ -148,7 +150,8 @@ class _WireGen:
         self.clients = [rng.randrange(1, 2**30) for _ in range(n_clients)]
         self.clocks = {c: 0 for c in self.clients}
         # per-UNIT total orders: [client, clock, deleted, code_unit, role]
-        # role: 0 solo unit, 1 high half of a surrogate pair, 2 low half
+        # role: 0 solo unit, 1 high half of a surrogate pair, 2 low
+        # half, 3 invisible ContentFormat marker
         self.text_units: list[list] = []
         # item-split points between a pair's halves corrupt both halves
         # to U+FFFD (yjs ContentString.splice); keyed by the LOW half id
@@ -219,6 +222,38 @@ class _WireGen:
             for i, (cu, role) in enumerate(zip(code_units, roles))
         ]
         self.text_units[k:k] = new_units
+        self.op_index += 1
+
+    def text_format(self) -> None:
+        """A ContentFormat marker (ref 6) at a random text position —
+        one clock unit, zero visible length, key + JSON value payload
+        (yjs YText bold/italic open/close markers). Markers share the
+        text unit order: they can serve as origins for later inserts,
+        be tombstoned by delete sets, and split a surrogate pair when
+        they land between its halves."""
+        rng = self.rng
+        client = rng.choice(self.clients)
+        key = rng.choice(["bold", "italic", "em"])
+        value = rng.choice([True, None, False, "red", 7])
+        k = rng.randint(0, len(self.text_units))
+        self._mark_split(k)
+        left = self.text_units[k - 1] if k > 0 else None
+        right = self.text_units[k] if k < len(self.text_units) else None
+        clock = self._alloc(client, 1)
+        payload = bytearray()
+        _vstr(payload, key)
+        _vstr(payload, json.dumps(value, separators=(",", ":")))
+        body = _item_body(
+            REF_FORMAT,
+            (left[0], left[1]) if left is not None else None,
+            (right[0], right[1]) if right is not None else None,
+            "t",
+            None,
+            bytes(payload),
+        )
+        self.structs.append(_StructRec(client, clock, 1, body, self.op_index))
+        # role 3: a format marker — invisible, but part of the order
+        self.text_units[k:k] = [[client, clock, False, 0, 3]]
         self.op_index += 1
 
     def text_delete(self) -> None:
@@ -309,6 +344,7 @@ class _WireGen:
         moves = [
             (self.text_insert, 4),
             (self.text_delete, 2),
+            (self.text_format, 2),
             (self.array_insert, 2),
             (self.map_set, 2),
             (self.map_delete, 1),
@@ -520,20 +556,28 @@ class _WireGen:
         by_id = {(u[0], u[1]): u for u in self.text_units}
         out = []
         for u in self.text_units:
-            if u[2]:
+            if u[2] or u[4] == 3:  # deleted, or an invisible format marker
                 continue
             cu = u[3]
             if u[4] == 1:  # high half; partner = (client, clock+1)
                 partner = by_id.get((u[0], u[1] + 1))
+                # role check (future-proofing: with today's pool every
+                # pair is emitted whole, so partners always match)
                 if (
                     partner is None
                     or partner[2]
+                    or partner[4] != 2
                     or (u[0], u[1] + 1) in self.split_pairs
                 ):
                     cu = 0xFFFD
             elif u[4] == 2:  # low half; partner = (client, clock-1)
                 partner = by_id.get((u[0], u[1] - 1))
-                if partner is None or partner[2] or (u[0], u[1]) in self.split_pairs:
+                if (
+                    partner is None
+                    or partner[2]
+                    or partner[4] != 1
+                    or (u[0], u[1]) in self.split_pairs
+                ):
                     cu = 0xFFFD
             out.append(int(cu).to_bytes(2, "little"))
         return b"".join(out).decode("utf-16-le", "surrogatepass")
