@@ -16,6 +16,7 @@ from hocuspocus_tpu.crdt import (
     decode_relative_position,
     encode_relative_position,
 )
+from hocuspocus_tpu.crdt.ids import ID
 from hocuspocus_tpu.crdt.relative_position import RelativePosition
 from hocuspocus_tpu.crdt.undo import UndoManager
 from hocuspocus_tpu.crdt.update import apply_update, encode_state_as_update
@@ -169,3 +170,20 @@ def test_fuzz_anchor_tracks_character_identity():
             if new_p < len(s):
                 # the anchored character keeps its identity while alive
                 assert s[new_p] == target_char, (seed, s, new_p, target_char)
+
+
+def test_golden_encoding_bytes():
+    """Pin the lib0 byte layout so refactors can't silently drift it:
+    tag 0 (item) + varint client + varint clock + varint assoc."""
+    r = RelativePosition(None, None, ID(5, 7), 0)
+    assert encode_relative_position(r) == bytes([0, 5, 7, 0])
+    r_assoc = RelativePosition(None, None, None, -1)
+    r_assoc.tname = "body"
+    # tag 1 (tname) + varstring + assoc -1 (varint sign bit 0x40 | 1)
+    assert encode_relative_position(r_assoc) == bytes([1, 4]) + b"body" + bytes([0x41])
+    # big ids take multi-byte varints
+    big = RelativePosition(None, None, ID(0x3FFF, 0x80), 0)
+    assert encode_relative_position(big) == bytes([0, 0xFF, 0x7F, 0x80, 0x01, 0])
+    # decode round-trips each
+    for raw in (bytes([0, 5, 7, 0]), bytes([1, 4]) + b"body" + bytes([0x41])):
+        assert encode_relative_position(decode_relative_position(raw)) == raw
