@@ -83,6 +83,33 @@ def build_parser() -> argparse.ArgumentParser:
         "'rle' (one entry per run — survives churny long-lived docs; "
         "--tpu-capacity then counts entries)",
     )
+    # plane supervisor (docs/guides/tpu-supervisor.md): the TPU runtime
+    # is an accelerator the server may acquire, never a boot dependency
+    # — a wedged/absent runtime degrades to CPU-merge mode, the server
+    # keeps serving, and the plane hot-(re)attaches on recovery.
+    parser.add_argument(
+        "--tpu-init-timeout",
+        type=float,
+        default=30.0,
+        help="seconds to wait for TPU runtime init (device discovery + "
+        "first compile) before booting in CPU-merge fallback; the plane "
+        "hot-attaches if init completes later (default 30)",
+    )
+    parser.add_argument(
+        "--tpu-watchdog-interval",
+        type=float,
+        default=5.0,
+        help="seconds between plane watchdog canary merges; also the "
+        "half-open recovery probe cadence (default 5)",
+    )
+    parser.add_argument(
+        "--tpu-breaker-threshold",
+        type=int,
+        default=3,
+        help="consecutive canary failures/overruns that open the circuit "
+        "breaker, draining served docs to the CPU path until a recovery "
+        "probe passes (default 3; see docs/guides/tpu-supervisor.md)",
+    )
     return parser
 
 
@@ -109,23 +136,28 @@ async def run(args: argparse.Namespace) -> None:
         extensions.append(Webhook(url=args.webhook))
     if args.tpu_merge or args.tpu_serve:
         # importing .tpu pins the backend to CPU when JAX_PLATFORMS=cpu
-        # (see hocuspocus_tpu/tpu/__init__.py)
-        from .tpu import ShardedTpuMergeExtension, TpuMergeExtension
+        # (see hocuspocus_tpu/tpu/__init__.py). The supervised extension
+        # defers ALL device work (kernel imports, discovery, compiles)
+        # to a deadline-bounded worker thread: a wedged or absent TPU
+        # runtime can no longer hang boot — the server serves in
+        # CPU-merge mode and the plane hot-attaches when the runtime
+        # comes up (docs/guides/tpu-supervisor.md).
+        from .tpu import SupervisedTpuMergeExtension
 
-        plane_kwargs = dict(
-            num_docs=args.tpu_docs,
-            capacity=args.tpu_capacity,
-            serve=args.tpu_serve,
-            flush_interval_ms=args.tpu_flush_interval,
-            broadcast_interval_ms=args.tpu_broadcast_interval,
-            arena=args.tpu_arena,
-        )
-        if args.tpu_shards > 1:
-            extensions.append(
-                ShardedTpuMergeExtension(shards=args.tpu_shards, **plane_kwargs)
+        extensions.append(
+            SupervisedTpuMergeExtension(
+                shards=args.tpu_shards,
+                init_timeout=args.tpu_init_timeout,
+                watchdog_interval=args.tpu_watchdog_interval,
+                breaker_threshold=args.tpu_breaker_threshold,
+                num_docs=args.tpu_docs,
+                capacity=args.tpu_capacity,
+                serve=args.tpu_serve,
+                flush_interval_ms=args.tpu_flush_interval,
+                broadcast_interval_ms=args.tpu_broadcast_interval,
+                arena=args.tpu_arena,
             )
-        else:
-            extensions.append(TpuMergeExtension(**plane_kwargs))
+        )
 
     server = Server(Configuration(extensions=extensions, quiet=False))
     await server.listen(port=args.port, host=args.host)

@@ -7,11 +7,13 @@ GC-disabled archive replica fed by its update stream, checkpoints are
 minted on demand (or on every store), and clients drive everything
 over the existing stateless channel — no new wire messages.
 
-Client -> server (JSON over a Stateless message):
-    {"action": "history.checkpoint", "label": "before cleanup"?}
-    {"action": "history.list"}
-    {"action": "history.preview", "id": 3}
-    {"action": "history.restore", "id": 3}
+Client -> server (JSON over a Stateless message; an optional "rid"
+request id is echoed verbatim in every reply/error and in the
+broadcasts the request triggers, so clients can correlate exactly):
+    {"action": "history.checkpoint", "label": "before cleanup"?, "rid"?}
+    {"action": "history.list", "rid"?}
+    {"action": "history.preview", "id": 3, "rid"?}
+    {"action": "history.restore", "id": 3, "rid"?}
 
 Server -> client:
     {"event": "history.checkpointed", "id", "label", "ts"}   (broadcast)
@@ -104,7 +106,20 @@ class History(Extension):
 
     async def after_store_document(self, data: Payload) -> None:
         if self.checkpoint_on_store:
-            self._checkpoint(data.document_name, label="store")
+            version = self._checkpoint(data.document_name, label="store")
+            document = data.get("document")
+            if version is not None and document is not None:
+                # store-minted versions announce themselves exactly like
+                # the stateless checkpoint action does — without this,
+                # clients only discovered them by polling history.list.
+                # origin tags the broadcast as server-initiated so the
+                # HistoryClient's rid-less fallback never mistakes it
+                # for the reply to a pending checkpoint request
+                document.broadcast_stateless(
+                    json.dumps(
+                        {"event": "history.checkpointed", "origin": "store", **version}
+                    )
+                )
 
     # -- the stateless protocol --------------------------------------------
 
@@ -118,7 +133,22 @@ class History(Extension):
             return
         name = data.document_name
         document = data.document
-        reply = data.connection.send_stateless
+        send = data.connection.send_stateless
+        # request-id echo: clients may attach a "rid"; every reply,
+        # error and initiator-triggered broadcast carries it back so
+        # the provider's HistoryClient resolves the EXACT pending
+        # request instead of correlating by event kind + send order
+        rid = request.get("rid")
+
+        def reply(payload: dict) -> None:
+            if rid is not None:
+                payload = {**payload, "rid": rid}
+            send(json.dumps(payload))
+
+        def broadcast(payload: dict) -> None:
+            if rid is not None:
+                payload = {**payload, "rid": rid}
+            document.broadcast_stateless(json.dumps(payload))
 
         if action in ("history.checkpoint", "history.restore") and getattr(
             data.connection, "read_only", False
@@ -126,33 +156,29 @@ class History(Extension):
             # the sync path refuses read-only updates; a restore that
             # rewrites every root (or minting checkpoints) must not be
             # a side door around that permission
-            reply(json.dumps({"event": "history.error", "error": "read-only connection"}))
+            reply({"event": "history.error", "error": "read-only connection"})
             return
 
         if action == "history.checkpoint":
             version = self._checkpoint(name, request.get("label"))
             if version is None:
-                reply(json.dumps({"event": "history.error", "error": "no history for document"}))
+                reply({"event": "history.error", "error": "no history for document"})
                 return
-            document.broadcast_stateless(
-                json.dumps({"event": "history.checkpointed", **version})
-            )
+            broadcast({"event": "history.checkpointed", **version})
         elif action == "history.list":
             versions = [
                 {"id": v["id"], "label": v["label"], "ts": v["ts"]}
                 for v in self._versions(name)
             ]
-            reply(json.dumps({"event": "history.versions", "versions": versions}))
+            reply({"event": "history.versions", "versions": versions})
         elif action == "history.preview":
             restored = self._restore_doc(name, request.get("id"))
             if restored is None:
-                reply(json.dumps({"event": "history.error", "error": "unknown version"}))
+                reply({"event": "history.error", "error": "unknown version"})
                 return
             update = base64.b64encode(encode_state_as_update(restored)).decode()
             reply(
-                json.dumps(
-                    {"event": "history.preview", "id": request.get("id"), "update": update}
-                )
+                {"event": "history.preview", "id": request.get("id"), "update": update}
             )
         elif action == "history.diff":
             # attributed diff of a TEXT root between a version and now
@@ -161,16 +187,16 @@ class History(Extension):
             # replicated in the doc (root "users")
             hist = self._docs.get(name)
             if hist is None:
-                reply(json.dumps({"event": "history.error", "error": "no history for document"}))
+                reply({"event": "history.error", "error": "no history for document"})
                 return
             base = self._find_version(name, request.get("id"))
             if base is None:
-                reply(json.dumps({"event": "history.error", "error": "unknown version"}))
+                reply({"event": "history.error", "error": "unknown version"})
                 return
             if request.get("until") is not None:
                 until = self._find_version(name, request.get("until"))
                 if until is None:
-                    reply(json.dumps({"event": "history.error", "error": "unknown 'until' version"}))
+                    reply({"event": "history.error", "error": "unknown 'until' version"})
                     return
             else:
                 # "until now" needs a CONCRETE snapshot: removed-run
@@ -184,9 +210,7 @@ class History(Extension):
                 # it would CREATE a missing root or raise retyping an
                 # existing non-text one (e.g. the "users" registry)
                 reply(
-                    json.dumps(
-                        {"event": "history.error", "error": f"root {root!r} is not a text root"}
-                    )
+                    {"event": "history.error", "error": f"root {root!r} is not a text root"}
                 )
                 return
             compute = self._ychange_resolver(hist)
@@ -198,31 +222,27 @@ class History(Extension):
                     # embedded Y types are not JSON: ship their snapshot
                     op["insert"] = op["insert"].to_json()
             reply(
-                json.dumps(
-                    {
-                        "event": "history.diff",
-                        "id": request.get("id"),
-                        "until": request.get("until"),
-                        "root": root,
-                        "delta": delta,
-                    }
-                )
+                {
+                    "event": "history.diff",
+                    "id": request.get("id"),
+                    "until": request.get("until"),
+                    "root": root,
+                    "delta": delta,
+                }
             )
         elif action == "history.restore":
             restored = self._restore_doc(name, request.get("id"))
             if restored is None:
-                reply(json.dumps({"event": "history.error", "error": "unknown version"}))
+                reply({"event": "history.error", "error": "unknown version"})
                 return
             try:
                 _rewrite_live_doc(document, restored)
             except _UnsupportedRestore as error:
-                reply(json.dumps({"event": "history.error", "error": str(error)}))
+                reply({"event": "history.error", "error": str(error)})
                 return
-            document.broadcast_stateless(
-                json.dumps({"event": "history.restored", "id": request.get("id")})
-            )
+            broadcast({"event": "history.restored", "id": request.get("id")})
         else:
-            reply(json.dumps({"event": "history.error", "error": f"unknown action {action!r}"}))
+            reply({"event": "history.error", "error": f"unknown action {action!r}"})
 
     # -- internals ---------------------------------------------------------
 
@@ -291,15 +311,39 @@ class _UnsupportedRestore(Exception):
     pass
 
 
-def _classify_root(ytype) -> str:
-    """Best-effort root-type classification: roots created by remote
-    integrates are GENERIC AbstractType instances until typed access."""
+def _concrete_kind(ytype) -> Optional[str]:
+    """The root's kind when its Python type already pins it; None for
+    generic AbstractType roots (created by remote integrates before any
+    typed access)."""
+    from ..crdt.types.yxml import YXmlFragment
+
+    # order matters: YXmlFragment before the others (YXmlElement is a
+    # fragment; YXmlText/YXmlHook subclass YText/YMap and classify as
+    # text/map, matching how the rewrite path addresses them)
+    if isinstance(ytype, YXmlFragment):
+        return "xml"
     if isinstance(ytype, YText):
         return "text"
     if isinstance(ytype, YMap):
         return "map"
     if isinstance(ytype, YArray):
         return "array"
+    return None
+
+
+def _classify_root(ytype, live=None) -> str:
+    """Best-effort root-type classification: roots created by remote
+    integrates are GENERIC AbstractType instances until typed access.
+
+    `live`: the live document's root of the same name, if any. An
+    all-tombstoned sequence carries no content to sniff (a gc-enabled
+    restored doc collapses deleted typed content to GC ranges), so the
+    live root's concrete type is the only trustworthy signal there —
+    defaulting to 'text' mistyped emptied array/map roots and made
+    restore raise mid-transaction (ADVICE.md)."""
+    kind = _concrete_kind(ytype)
+    if kind is not None:
+        return kind
     if ytype._map and ytype._start is None:
         return "map"
     item = ytype._start
@@ -311,6 +355,14 @@ def _classify_root(ytype) -> str:
         if not item.deleted:
             return "array"
         item = item.right
+    if live is not None:
+        live_kind = _concrete_kind(live)
+        if live_kind is not None:
+            return live_kind
+        # server-side roots are usually generic too (typed access only
+        # ever happened client-side): sniff the live root's CONTENT —
+        # it holds the post-checkpoint state the tombstoned target lost
+        return _classify_root(live)
     return "text" if not ytype._map else "map"
 
 
@@ -359,9 +411,20 @@ def _rewrite_live_doc(document, restored: Doc) -> None:
     # would leave the live doc half-rewritten
     for name in sorted(names):
         target = restored.share.get(name)
-        kind = _classify_root(
-            target if target is not None else document.share[name]
-        )
+        live = document.share.get(name)
+        if target is not None:
+            kind = _classify_root(target, live)
+        else:
+            kind = _classify_root(live)
+        # the run() below addresses the LIVE root through typed getters
+        # (get_text/get_map/...), which raise mid-transaction on a
+        # differently-typed root — refuse BEFORE mutating instead
+        live_kind = _concrete_kind(live) if live is not None else None
+        if live_kind is not None and kind != live_kind:
+            raise _UnsupportedRestore(
+                f"root {name!r} is {live_kind} in the live document but "
+                f"{kind} in the checkpoint"
+            )
         payload = None
         if kind == "text" and target is not None:
             payload = restored.get_text(name).to_delta()

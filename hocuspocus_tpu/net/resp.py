@@ -137,7 +137,18 @@ class RedisCommands:
         await self.execute("FLUSHALL")
 
     async def acquire_lock(self, key: str, token: str, ttl_ms: int) -> bool:
-        return await self.set(key, token, nx=True, px=ttl_ms) == "OK"
+        if await self.set(key, token, nx=True, px=ttl_ms) == "OK":
+            return True
+        # Lost-reply self-acquisition: execute() retries a transport
+        # failure once, and the FIRST attempt may have executed
+        # server-side with its reply lost — the retry then sees the key
+        # held and reports the lock unavailable while OUR token holds it
+        # for a full TTL. Tokens are unique per acquisition attempt, so
+        # a GET matching this token proves this call acquired the lock.
+        # (One extra round trip only on the contended/failed path.)
+        current = await self.get(key)
+        want = token.encode() if isinstance(token, str) else token
+        return current == want
 
     async def release_lock(self, key: str, token: str) -> bool:
         return bool(await self.eval(RELEASE_LOCK_SCRIPT, [key], [token]))

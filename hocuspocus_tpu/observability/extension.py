@@ -94,29 +94,124 @@ class Metrics(Extension):
         # by construction: MergePlane pre-declares every counter in
         # __init__ and retire_doc uses strict key access.
         for extension in getattr(instance.configuration, "extensions", []):
-            plane = getattr(extension, "plane", None)
-            counters = getattr(plane, "counters", None)
-            if not isinstance(counters, dict):
-                continue
+            supervisor = getattr(extension, "supervisor", None)
+            if supervisor is not None and hasattr(supervisor, "snapshot"):
+                # supervised plane: the runtime (and its counters) may
+                # not exist yet — bind the supervisor surface now and
+                # the plane metrics at hot-attach time
+                self._bind_supervisor_metrics(supervisor)
+                break
+            if self._bind_plane_metrics(extension):
+                break  # one plane per server
+
+    def _bind_plane_metrics(self, owner) -> bool:
+        """Register the plane-counter gauges for `owner` (an extension
+        with `.plane`, or the sharded router with `.shards`). Returns
+        True when a plane surface was found and bound."""
+        reg = self.registry
+        plane = getattr(owner, "plane", None)
+        counters = getattr(plane, "counters", None)
+        if isinstance(counters, dict):
             for key in counters:
                 # keys like "plane_broadcasts" already carry the prefix
                 metric = f"hocuspocus_tpu_plane_{key.removeprefix('plane_')}"
-                self.registry.gauge(
+                reg.gauge(
                     metric,
                     f"TPU merge plane counter: {key}",
                     fn=(lambda c=counters, k=key: c[k]),
                 )
-            self.registry.gauge(
+            reg.gauge(
                 "hocuspocus_tpu_plane_arena_rows_in_use",
                 "Arena rows (sequences) currently allocated on the plane",
                 fn=(lambda p=plane: p.num_docs - len(p.free)),
             )
-            self.registry.gauge(
+            reg.gauge(
                 "hocuspocus_tpu_plane_ops_integrated",
                 "Ops integrated by the device since start",
                 fn=(lambda p=plane: p.total_integrated),
             )
-            break  # one plane per server
+            return True
+        shards = getattr(owner, "shards", None)
+        if shards:
+            for key in shards[0].plane.counters:
+                metric = f"hocuspocus_tpu_plane_{key.removeprefix('plane_')}"
+                reg.gauge(
+                    metric,
+                    f"TPU merge plane counter (summed over shards): {key}",
+                    fn=(lambda o=owner, k=key: o.counters.get(k, 0)),
+                )
+            reg.gauge(
+                "hocuspocus_tpu_plane_arena_rows_in_use",
+                "Arena rows (sequences) allocated, summed over shards",
+                fn=(
+                    lambda o=owner: sum(
+                        s.plane.num_docs - len(s.plane.free) for s in o.shards
+                    )
+                ),
+            )
+            reg.gauge(
+                "hocuspocus_tpu_plane_ops_integrated",
+                "Ops integrated by the device since start, summed over shards",
+                fn=(
+                    lambda o=owner: sum(s.plane.total_integrated for s in o.shards)
+                ),
+            )
+            return True
+        return False
+
+    def _bind_supervisor_metrics(self, supervisor) -> None:
+        """Plane supervisor surface (tpu/supervisor.py): state, breaker,
+        transition counters and canary latency. Bound at configure time
+        — before supervision starts at listen time — so no transition
+        or probe is ever missed."""
+        reg = self.registry
+        reg.gauge(
+            "hocuspocus_tpu_supervisor_state",
+            "Plane supervisor state (0=initializing 1=ready 2=degraded 3=broken)",
+            fn=supervisor.state_code,
+        )
+        reg.gauge(
+            "hocuspocus_tpu_supervisor_breaker_state",
+            "Plane circuit breaker state (0=closed 1=open 2=half_open)",
+            fn=supervisor.breaker_code,
+        )
+        reg.gauge(
+            "hocuspocus_tpu_supervisor_breaker_consecutive_failures",
+            "Consecutive canary failures feeding the breaker",
+            fn=(lambda b=supervisor.breaker: b.consecutive_failures),
+        )
+        reg.gauge(
+            "hocuspocus_tpu_supervisor_canary_latency_seconds",
+            "Most recent canary merge latency (0 until the first probe)",
+            fn=(lambda s=supervisor: s.last_canary_latency or 0.0),
+        )
+        canary = reg.histogram(
+            "hocuspocus_tpu_supervisor_canary_seconds",
+            "Watchdog canary merge latency",
+        )
+        supervisor.on_canary.append(canary.observe)
+        transitions = reg.counter(
+            "hocuspocus_tpu_supervisor_transitions_total",
+            "Supervisor state transitions",
+        )
+        supervisor.on_transition.append(
+            lambda frm, to: transitions.inc(from_state=frm, to_state=to)
+        )
+        breaker_transitions = reg.counter(
+            "hocuspocus_tpu_supervisor_breaker_transitions_total",
+            "Circuit breaker state transitions",
+        )
+        supervisor.breaker.on_transition.append(
+            lambda frm, to: breaker_transitions.inc(from_state=frm, to_state=to)
+        )
+        for key in supervisor.counters:
+            reg.gauge(
+                f"hocuspocus_tpu_supervisor_{key}",
+                f"Plane supervisor counter: {key}",
+                fn=(lambda c=supervisor.counters, k=key: c[k]),
+            )
+        # the plane's own counters bind the moment a runtime attaches
+        supervisor.on_attach.append(self._bind_plane_metrics)
 
     async def connected(self, data: Payload) -> None:
         self.connects.inc()

@@ -118,6 +118,14 @@ class Tracer:
         with annotation(name), self.span(name, **attributes) as sp:
             yield sp
 
+    def event(self, name: str, **attributes: Any) -> None:
+        """Record an instantaneous event as a zero-duration span (state
+        transitions, breaker trips — things with a moment, not an
+        extent). Same near-zero disabled cost as span()."""
+        if not self.enabled:
+            return
+        self._spans.append(Span(name, attributes or None).finish())
+
     def _resolve_jax_annotation(self):
         if self._jax_annotation is None:
             try:

@@ -1,10 +1,11 @@
 """HistoryClient — awaitable client API over the History extension.
 
 Wraps the stateless JSON protocol (extensions/history.py) into
-futures: requests correlate to their replies by event kind, broadcast
-events (`history.checkpointed` / `history.restored`) surface through
-the provider's observable interface, and previews come back as a
-reconstructed `Doc`.
+futures: requests correlate to their replies by a client-generated
+request id the server echoes back (kind-in-order fallback for rid-less
+events), broadcast events (`history.checkpointed` / `history.restored`)
+surface through the provider's observable interface, and previews come
+back as a reconstructed `Doc`.
 
     history = HistoryClient(provider)
     version = await history.checkpoint("before cleanup")
@@ -19,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import uuid
 from typing import Any, Optional
 
 from ..crdt import Doc, apply_update
@@ -39,17 +41,22 @@ _REPLY_EVENT = {
 
 
 class HistoryClient:
-    """Note on correlation: replies are matched by event KIND in send
-    order (the server answers a connection's requests in order).
-    `history.checkpointed` / `history.restored` are broadcasts — if
-    ANOTHER client performs the same action while yours is in flight,
-    its broadcast may resolve your waiter one action early; both
-    actions did succeed, so this only blurs which id you get back."""
+    """Correlation: every request carries a client-generated "rid" the
+    server echoes in its reply/error AND in the broadcasts the request
+    triggers (`history.checkpointed` / `history.restored`), so each
+    event resolves exactly the request that caused it — another
+    client's concurrent same-kind broadcast (a different rid) can no
+    longer resolve your waiter, and an error rejects the request that
+    actually failed instead of the oldest pending one. Events without
+    a rid (older servers, server-initiated store checkpoints) fall
+    back to the legacy kind-in-send-order match."""
 
     def __init__(self, provider: Any, timeout: float = 10.0) -> None:
         self.provider = provider
         self.timeout = timeout
-        self._pending: list = []  # (reply_kind, future), send order
+        self._pending: list = []  # (rid, reply_kind, future), send order
+        self._rid_prefix = uuid.uuid4().hex[:12]
+        self._rid_seq = 0
         provider.on("stateless", self._on_stateless)
 
     def _on_stateless(self, data: dict) -> None:
@@ -62,15 +69,41 @@ class HistoryClient:
         kind = event.get("event", "")
         if not kind.startswith("history."):
             return
+        rid = event.get("rid")
         if kind == "history.error":
-            # replies are ordered per connection: the failing request
-            # is the OLDEST one still outstanding
+            if rid is not None:
+                # exact routing: reject the request that failed
+                for i, (want_rid, _want, future) in enumerate(self._pending):
+                    if want_rid == rid:
+                        del self._pending[i]
+                        if not future.done():
+                            future.set_exception(
+                                HistoryError(event.get("error", "unknown"))
+                            )
+                        return
+                return  # someone else's failure
+            # legacy server (no rid echo): the failing request is the
+            # OLDEST one still outstanding
             if self._pending:
-                _kind, future = self._pending.pop(0)
+                _rid, _kind, future = self._pending.pop(0)
                 if not future.done():
                     future.set_exception(HistoryError(event.get("error", "unknown")))
             return
-        for i, (want, future) in enumerate(self._pending):
+        if rid is not None:
+            for i, (want_rid, want, future) in enumerate(self._pending):
+                if want_rid == rid and want == kind:
+                    del self._pending[i]
+                    if not future.done():
+                        future.set_result(event)
+                    return
+            return  # another client's action: not ours to resolve
+        if event.get("origin") == "store":
+            # server-initiated store checkpoint: a broadcast, never the
+            # reply to a pending request — resolving a waiter with it
+            # would hand back the wrong version id
+            return
+        # rid-less event (legacy server): kind-in-send-order fallback
+        for i, (_rid, want, future) in enumerate(self._pending):
             if want == kind:
                 del self._pending[i]
                 if not future.done():
@@ -79,9 +112,13 @@ class HistoryClient:
 
     async def _request(self, action: str, **fields: Any) -> dict:
         future: asyncio.Future = asyncio.get_running_loop().create_future()
-        entry = (_REPLY_EVENT[action], future)
+        self._rid_seq += 1
+        rid = f"{self._rid_prefix}-{self._rid_seq}"
+        entry = (rid, _REPLY_EVENT[action], future)
         self._pending.append(entry)
-        self.provider.send_stateless(json.dumps({"action": action, **fields}))
+        self.provider.send_stateless(
+            json.dumps({"action": action, "rid": rid, **fields})
+        )
         try:
             return await asyncio.wait_for(future, self.timeout)
         finally:
@@ -128,7 +165,7 @@ class HistoryClient:
 
     def destroy(self) -> None:
         self.provider.off("stateless", self._on_stateless)
-        for _kind, future in self._pending:
+        for _rid, _kind, future in self._pending:
             if not future.done():
                 future.cancel()
         self._pending.clear()

@@ -144,6 +144,36 @@ class Hocuspocus:
             direct += document.direct_connections_count
         return len(unique_socket_ids) + direct
 
+    def get_health(self) -> dict:
+        """Aggregate health payload for load balancers (`/healthz`).
+
+        The server itself is always "ok" while it can answer at all —
+        availability is never gated on an accelerator. Extensions
+        exposing a `health_status()` callable (e.g. the TPU plane
+        supervisor, tpu/supervisor.py) contribute a detail section; any
+        section reporting `degraded: True` downgrades the top-level
+        status to "degraded" so balancers can steer load while the
+        server keeps serving from the CPU path.
+        """
+        health: dict = {
+            "status": "ok",
+            "documents": self.get_documents_count(),
+            "connections": self.get_connections_count(),
+            "extensions": {},
+        }
+        for extension in getattr(self, "_extensions", []):
+            status_fn = getattr(extension, "health_status", None)
+            if not callable(status_fn):
+                continue
+            try:
+                status = status_fn()
+            except Exception:
+                status = {"state": "error", "degraded": True}
+            health["extensions"][type(extension).__name__] = status
+            if isinstance(status, dict) and status.get("degraded"):
+                health["status"] = "degraded"
+        return health
+
     def close_connections(self, document_name: Optional[str] = None) -> None:
         for document in list(self.documents.values()):
             if document_name is not None and document.name != document_name:

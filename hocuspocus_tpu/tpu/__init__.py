@@ -13,7 +13,34 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     except Exception:  # backend already initialized: caller's choice stands
         pass
 
-from .merge_plane import MergePlane, TpuMergeExtension
-from .sharded_extension import ShardedTpuMergeExtension
+# Lazy symbol resolution (PEP 562): importing this package must stay
+# cheap and device-free. The merge-plane modules pull in the kernel
+# stack, and a wedged TPU runtime can block device discovery forever —
+# the plane supervisor (supervisor.py) runs those imports in a worker
+# thread under a deadline, which only works if nothing here imports
+# them eagerly.
+_LAZY = {
+    "MergePlane": ("merge_plane", "MergePlane"),
+    "TpuMergeExtension": ("merge_plane", "TpuMergeExtension"),
+    "ShardedTpuMergeExtension": ("sharded_extension", "ShardedTpuMergeExtension"),
+    "PlaneSupervisor": ("supervisor", "PlaneSupervisor"),
+    "SupervisedTpuMergeExtension": ("supervisor", "SupervisedTpuMergeExtension"),
+    "CircuitBreaker": ("supervisor", "CircuitBreaker"),
+}
 
-__all__ = ["MergePlane", "ShardedTpuMergeExtension", "TpuMergeExtension"]
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+
+    value = getattr(import_module(f".{entry[0]}", __name__), entry[1])
+    globals()[name] = value  # cache: resolve each symbol once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

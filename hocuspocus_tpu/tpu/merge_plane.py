@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -623,6 +624,20 @@ class MergePlane:
                 self.state, count = step(self.state, ops)
                 int(count)  # completion barrier (data-dependent)
 
+    def canary_probe(self) -> float:
+        """One tiny no-op integrate + data-dependent readback: the plane
+        supervisor's liveness probe (tpu/supervisor.py). Returns the
+        elapsed seconds. Deliberately takes the step lock — a wedged
+        flush holding it blocks the probe, which is exactly the
+        condition the watchdog's deadline detects."""
+        started = time.perf_counter()
+        step = self._step_fn()
+        with self._step_lock:
+            ops = self._empty_batch(1)
+            self.state, count = step(self.state, ops)
+            int(count)  # completion barrier (data-dependent readback)
+        return time.perf_counter() - started
+
     def warmup_shapes(self) -> list[int]:
         shapes = []
         k = 1
@@ -1088,6 +1103,50 @@ class TpuMergeExtension(Extension):
 
     def _spawn_tracked(self, coro) -> None:
         spawn_tracked(self._flush_tasks, coro)
+
+    # -- supervisor surface (tpu/supervisor.py) ------------------------------
+
+    def planes(self) -> "list[MergePlane]":
+        return [self.plane]
+
+    def servings(self) -> list:
+        return [] if self.serving is None else [self.serving]
+
+    def is_served(self, document_name: str) -> bool:
+        return document_name in self._docs
+
+    def degrade_all(self) -> None:
+        """Drain every served doc to the CPU path (full-state fallback
+        broadcast each) — the supervisor's breaker-open action."""
+        self._degrade_all_served()
+
+    def cancel_timers(self) -> None:
+        """Teardown without touching the device (the supervisor's
+        non-READY shutdown: a wedged runtime must not hang destroy)."""
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        if self._broadcast_handle is not None:
+            self._broadcast_handle.cancel()
+            self._broadcast_handle = None
+
+    async def reonboard(self, document, instance=None) -> None:
+        """Fresh plane registration for a live document (supervisor hot
+        attach / breaker recovery): drop any previous registration and
+        run the ordinary load-time onboarding path."""
+        name = document.name
+        async with self.plane.flush_lock:
+            self._detach_serving(name, self._docs.pop(name, None))
+            if name in self.plane.docs:
+                self.plane.release(name)
+            self._recycle_declined.discard(name)
+        await self.after_load_document(
+            Payload(
+                instance=instance if instance is not None else self._instance,
+                document_name=name,
+                document=document,
+            )
+        )
 
     # -- hooks ---------------------------------------------------------------
 

@@ -112,6 +112,13 @@ class PlaneServing:
         # popped from the queues — they only survive via the full-state
         # fallback broadcast)
         self.flush_failure_handler = None
+        # supervisor drain seam (tpu/supervisor.py): while paused, every
+        # sync serve resolves to None (CPU fallback) WITHOUT touching
+        # the device — a wedged runtime must never stall a document
+        self.paused = False
+        # unresolved batched-sync futures, so abort_pending can resolve
+        # waiters stranded behind a wedged flush
+        self._inflight: set = set()
 
     # -- device readback cache ---------------------------------------------
 
@@ -654,6 +661,8 @@ class PlaneServing:
         donate the buffers mid-read nor interleave between the drain
         and the encode. The server core uses the async batched path.
         """
+        if self.paused:
+            return None  # supervisor drain: serve from the CPU document
         plane = self.plane
         with plane._step_lock:  # reentrant: flush() re-acquires
             if plane.pending_ops() > 0:
@@ -690,7 +699,11 @@ class PlaneServing:
         """
         import asyncio
 
+        if self.paused:
+            return None  # supervisor drain: serve from the CPU document
         future = asyncio.get_event_loop().create_future()
+        self._inflight.add(future)
+        future.add_done_callback(self._inflight.discard)
         self._catchup_queue.append((name, document, sv_bytes, future))
         if not self._catchup_scheduled:
             self._catchup_scheduled = True
@@ -699,6 +712,19 @@ class PlaneServing:
             self._drain_tasks.add(task)
             task.add_done_callback(self._drain_tasks.discard)
         return await future
+
+    def abort_pending(self) -> None:
+        """Resolve every outstanding batched-sync waiter to CPU fallback.
+
+        The supervisor's breaker-open drain: a wedge mid-flight leaves
+        drain tasks blocked on the flush lock with their waiters'
+        futures unresolved — clients would stall on SyncStep2 forever.
+        The drain tasks' own `future.done() or set_result(...)` guards
+        make the eventual (post-unwedge) resolution a no-op.
+        """
+        for future in list(self._inflight):
+            if not future.done():
+                future.set_result(None)
 
     async def _drain_catchup(self) -> None:
         self._catchup_scheduled = False

