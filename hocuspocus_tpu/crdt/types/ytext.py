@@ -37,6 +37,26 @@ def equal_attrs(a: Any, b: Any) -> bool:
     return a == b
 
 
+def identical_attrs(a: Any, b: Any) -> bool:
+    """yjs's `===` over attribute values: value equality for JS
+    primitives (strings, numbers, booleans, null), REFERENCE identity
+    for objects/arrays. cleanupFormattingGap compares with `===`, so a
+    marker restating an equal-but-distinct object attribute is KEPT by
+    yjs peers — using deep equality there deletes markers a yjs peer
+    retains and diverges the tombstone layout (round-5 ADVICE)."""
+    if a is b:
+        return True
+    # JS has one number type but distinct booleans: True must not
+    # compare identical to 1 (Python's == would)
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a == b
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    return False
+
+
 class ItemTextListPosition:
     __slots__ = ("left", "right", "index", "current_attributes")
 
@@ -233,13 +253,19 @@ def _cleanup_formatting_gap(transaction, start, curr, start_attributes: dict, cu
             if isinstance(content, ContentFormat):
                 key, value = content.key, content.value
                 start_attr = start_attributes.get(key)
-                if end_formats.get(key) is not content or equal_attrs(start_attr, value):
+                # identical_attrs, not equal_attrs: yjs compares these
+                # with ===, so equal-but-distinct object values keep
+                # their marker — matching that keeps tombstone layouts
+                # in agreement with yjs peers
+                if end_formats.get(key) is not content or identical_attrs(
+                    start_attr, value
+                ):
                     start.delete(transaction)
                     cleanups += 1
                     if (
                         not reached_curr
-                        and equal_attrs(curr_attributes.get(key), value)
-                        and not equal_attrs(start_attr, value)
+                        and identical_attrs(curr_attributes.get(key), value)
+                        and not identical_attrs(start_attr, value)
                     ):
                         if start_attr is None:
                             curr_attributes.pop(key, None)

@@ -130,6 +130,17 @@ class Metrics(Extension):
                 "Ops integrated by the device since start",
                 fn=(lambda p=plane: p.total_integrated),
             )
+            # flush-stage pipeline gauges (docs/guides/tpu-merge-
+            # pipeline.md): last cycle's build/upload/device times,
+            # dispatched (K, B) shape, busy width and upload volume —
+            # how an operator sees host work scale with BUSY docs, not
+            # the resident population
+            for key in getattr(plane, "flush_stats", {}):
+                reg.gauge(
+                    f"hocuspocus_tpu_plane_flush_{key}",
+                    f"TPU merge plane flush stage stat: {key} (last cycle)",
+                    fn=(lambda p=plane, k=key: p.flush_stats[k]),
+                )
             return True
         shards = getattr(owner, "shards", None)
         if shards:
@@ -156,6 +167,18 @@ class Metrics(Extension):
                     lambda o=owner: sum(s.plane.total_integrated for s in o.shards)
                 ),
             )
+            # stage times/widths aren't summable across shards: report
+            # the worst shard (the one an operator would chase)
+            for key in getattr(shards[0].plane, "flush_stats", {}):
+                reg.gauge(
+                    f"hocuspocus_tpu_plane_flush_{key}",
+                    f"TPU merge plane flush stage stat: {key} (max over shards)",
+                    fn=(
+                        lambda o=owner, k=key: max(
+                            s.plane.flush_stats[k] for s in o.shards
+                        )
+                    ),
+                )
             return True
         return False
 

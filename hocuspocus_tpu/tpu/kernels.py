@@ -226,6 +226,56 @@ def integrate_op_slots(state: DocState, ops: OpBatch) -> tuple[DocState, jax.Arr
     return state, count
 
 
+# -- sparse (busy-doc) dispatch ----------------------------------------------
+#
+# At scale almost every flush touches a small fraction of the resident
+# documents: the dense (K, D) batch pays O(K*D) host build + upload +
+# device sweep regardless. The sparse step instead takes (K, B) ops over
+# only the B busy doc slots plus an int32 (B,) slot-routing vector:
+# gather those B arena rows, integrate, scatter back in place (the full
+# state is donated, so the (D, N) arenas never copy). Padding columns
+# carry KIND_NOOP ops and the out-of-range sentinel slot `num_docs`:
+# the gather clips (reads a real row, mutates nothing — noops), and the
+# scatter drops the write, so padding can never alias a busy row.
+
+
+def gather_doc_rows(state, slots: jax.Array):
+    """Gather the doc rows `slots` from every field of a doc-major
+    state pytree (DocState or RleState). Out-of-range indices clip."""
+    return type(state)(
+        *(jnp.take(field, slots, axis=0, mode="clip") for field in state)
+    )
+
+
+def scatter_doc_rows(state, sub, slots: jax.Array):
+    """Scatter the gathered rows back; out-of-range indices drop."""
+    return type(state)(
+        *(
+            field.at[slots].set(sub_field, mode="drop")
+            for field, sub_field in zip(state, sub)
+        )
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def integrate_op_slots_sparse(
+    state: DocState, ops: OpBatch, slots: jax.Array
+) -> tuple[DocState, jax.Array]:
+    """Integrate K op slots over the B busy docs `slots` routes to.
+
+    ops fields have shape (K, B); slots is int32 (B,) mapping batch
+    column -> doc row (num_docs = padding sentinel). Work scales with
+    B, not the resident population D.
+    """
+    sub = gather_doc_rows(state, slots)
+    sub, count = integrate_op_slots.__wrapped__(sub, ops)
+    state = scatter_doc_rows(state, sub, slots)
+    # re-tie the count to the SCATTERED state so fetching it is a
+    # completion barrier for the full write-back, not just the sub-batch
+    count, _ = jax.lax.optimization_barrier((count, state.length))
+    return state, count
+
+
 @jax.jit
 def extract_live_mask(state: DocState) -> jax.Array:
     """(D, N) bool — live (non-tombstone) units, for host-side decoding."""

@@ -300,6 +300,21 @@ def integrate_op_slots_rle(state: RleState, ops: OpBatch):
     return state, count
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def integrate_op_slots_rle_sparse(state: RleState, ops: OpBatch, slots):
+    """Sparse busy-doc dispatch over the RLE arena: (K, B) op slots plus
+    an int32 (B,) slot-routing vector (see kernels.integrate_op_slots_
+    sparse — same gather/integrate/scatter contract, padding columns
+    carry noops and the out-of-range sentinel)."""
+    from .kernels import gather_doc_rows, scatter_doc_rows
+
+    sub = gather_doc_rows(state, slots)
+    sub, count = integrate_op_slots_rle.__wrapped__(sub, ops)
+    state = scatter_doc_rows(state, sub, slots)
+    count, _ = jax.lax.optimization_barrier((count, state.total_units))
+    return state, count
+
+
 # -- host-side extraction ----------------------------------------------------
 
 

@@ -75,6 +75,48 @@ class PlaneDoc:
     lane_cache_key: Optional[tuple] = None
 
 
+class _FlushStaging:
+    """One reusable host-side batch staging buffer, sized at the max
+    flush shape (K_max, D). Each batch takes a `(k, b)` view of it —
+    zero fresh numpy allocations on the flush hot path (the old builder
+    allocated 8 fresh (K, D) arrays per batch, which dominated host
+    time at the 100k-doc regime). MergePlane keeps TWO of these and
+    alternates per batch (double buffering): the host build of batch
+    i+1 must never mutate arrays whose upload for batch i may still be
+    in flight on an asynchronously-transferring runtime."""
+
+    __slots__ = ("fields", "slots")
+
+    # per-field reset value: left/right client columns default to the
+    # NONE_CLIENT sentinel, everything else to zero (KIND_NOOP)
+    _DEFAULTS = (0, 0, 0, 0, NONE_CLIENT, 0, NONE_CLIENT, 0)
+    _DTYPES = (
+        np.int32, np.uint32, np.int32, np.int32,
+        np.uint32, np.int32, np.uint32, np.int32,
+    )
+
+    def __init__(self, k_max: int, num_docs: int) -> None:
+        self.fields = tuple(
+            np.full((k_max, num_docs), default, dtype)
+            for default, dtype in zip(self._DEFAULTS, self._DTYPES)
+        )
+        self.slots = np.zeros((num_docs,), np.int32)
+
+    def views(self, k: int, b: int) -> tuple:
+        """(k, b) views of the 8 op fields, reset to noop defaults."""
+        views = tuple(field[:k, :b] for field in self.fields)
+        for view, default in zip(views, self._DEFAULTS):
+            view[...] = default
+        return views
+
+    def slot_view(self, b: int) -> np.ndarray:
+        return self.slots[:b]
+
+    def nbytes(self, k: int, b: int, with_slots: bool) -> int:
+        per_field = sum(dtype().itemsize for dtype in self._DTYPES)
+        return k * b * per_field + (b * 4 if with_slots else 0)
+
+
 class MergePlane:
     """Device-resident arenas for up to `num_docs` sequences.
 
@@ -125,14 +167,20 @@ class MergePlane:
         # it across its own flush()+reads sequence.
         self._step_lock = threading.RLock()
         self._sharded_step = None
+        self._sharded_sparse_step = None
         self._op_shardings = None
+        self._sparse_op_shardings = None
+        self._slots_sharding = None
         if mesh is not None:
             from .sharding import (
+                make_sharded_rle_sparse_step,
                 make_sharded_rle_state,
                 make_sharded_rle_step,
+                make_sharded_sparse_step,
                 make_sharded_state,
                 make_sharded_step,
                 ops_sharding,
+                sparse_ops_sharding,
             )
 
             doc_axis = mesh.shape["doc"]
@@ -146,16 +194,32 @@ class MergePlane:
             if arena == "rle":
                 self.state = make_sharded_rle_state(mesh, num_docs, capacity)
                 self._sharded_step = make_sharded_rle_step(mesh)
+                self._sharded_sparse_step = make_sharded_rle_sparse_step(mesh)
             else:
                 self.state = make_sharded_state(mesh, num_docs, capacity)
                 self._sharded_step = make_sharded_step(mesh)
+                self._sharded_sparse_step = make_sharded_sparse_step(mesh)
             self._op_shardings = ops_sharding(mesh)
+            self._sparse_op_shardings, self._slots_sharding = sparse_ops_sharding(
+                mesh
+            )
         else:
             self.state = self._make_empty(num_docs, capacity)
         self.docs: dict[str, PlaneDoc] = {}
         self.free: list[int] = list(range(num_docs - 1, -1, -1))
         self.slot_owner: dict[int, str] = {}  # slot -> doc name
         self.queues: dict[int, list[DenseOp]] = {}
+        # slots with (possibly) queued ops: per-batch bookkeeping —
+        # depth scan, drain, dispatch — walks THIS set, O(busy), never
+        # the full queue registry, O(D). Maintained lock-free under the
+        # GIL: enqueue_update adds AFTER every extend (unconditionally),
+        # so a drain-side discard that races an enqueue is always
+        # repaired by the enqueuer's own add; a stale member whose
+        # queue emptied elsewhere (retire/release also discard) is
+        # pruned at the next depth scan. Native-lane queues are not
+        # tracked here — the lane keeps its own registry of nonempty
+        # queues in C++ (lane_queue_max / lane_drain are O(lane slots)).
+        self._busy_slots: set[int] = set()
         # per-slot insert units handed to the device so far / as of the
         # last completed flush. Serve logs are written at ENQUEUE time
         # (so broadcasts never wait on the device); health checks
@@ -212,7 +276,44 @@ class MergePlane:
             "sync_serves": 0,
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
+            # flush-engine accounting: staging buffers are allocated
+            # once and reused (the regression suite pins allocs flat
+            # while reuses grow), and sparse vs dense says which
+            # dispatch layout flush cycles actually take
+            "flush_staging_allocs": 0,
+            "flush_staging_reuses": 0,
+            "flush_batches_sparse": 0,
+            "flush_batches_dense": 0,
         }
+        # last completed flush cycle's stage breakdown (exported as
+        # gauges by observability/extension.py; reported by bench.py's
+        # sparse-load pass): host build / upload / device+readback ms,
+        # the (K, B) shape dispatched, busy width and fraction, bytes
+        # shipped. Overwritten per cycle, never accumulated.
+        self.flush_stats: dict[str, float] = {
+            "build_ms": 0.0,
+            "upload_ms": 0.0,
+            "dispatch_ms": 0.0,
+            "device_sync_ms": 0.0,
+            "busy_slots": 0,
+            "busy_fraction": 0.0,
+            "batch_k": 0,
+            "batch_b": 0,
+            "batches": 0,
+            "upload_bytes": 0,
+        }
+        # double-buffered staging (see _FlushStaging): allocated on the
+        # first flush, alternated per batch so building batch i+1 never
+        # mutates arrays batch i's upload may still be reading. The
+        # alternation alone only guarantees ONE batch of separation, so
+        # _staging_inflight remembers each buffer's last uploaded device
+        # arrays and _staging_for blocks on them before handing the
+        # buffer out again — on an asynchronously-transferring runtime
+        # a 3+-batch cycle must not reset staging[0] while batch 0's
+        # transfer is still in flight (two dispatches have passed by
+        # then, so the block is ~always a no-op).
+        self._staging: "Optional[list[_FlushStaging]]" = None
+        self._staging_inflight: "list[Optional[tuple]]" = [None, None]
         # native text lane (enable_lane): the C++ host path for plain-
         # text docs. _lane_banned remembers docs that demoted (rich
         # content) so re-onboarding goes straight to the Python path.
@@ -239,6 +340,19 @@ class MergePlane:
         from .pallas_kernels import integrate_op_slots_fast
 
         return integrate_op_slots_fast
+
+    def _sparse_step_fn(self):
+        """The sparse (busy-doc) twin of _step_fn: takes (state, (K, B)
+        ops, (B,) slot routing)."""
+        if self._sharded_sparse_step is not None:
+            return self._sharded_sparse_step
+        if self.arena == "rle":
+            from .pallas_kernels_rle import integrate_op_slots_rle_sparse_fast
+
+            return integrate_op_slots_rle_sparse_fast
+        from .pallas_kernels import integrate_op_slots_sparse_fast
+
+        return integrate_op_slots_sparse_fast
 
     # -- native text lane --------------------------------------------------
 
@@ -418,6 +532,7 @@ class MergePlane:
         for slot in slots:
             self.slot_owner.pop(slot, None)
             self.queues.pop(slot, None)
+            self._busy_slots.discard(slot)
             self.unit_logs.pop(slot, None)
             self.projected_len.pop(slot, None)
             self.dispatched_units[slot] = 0
@@ -468,6 +583,7 @@ class MergePlane:
         #     holding the old list keeps a consistent snapshot.
         for slot in doc.seqs.values():
             self.queues[slot].clear()
+            self._busy_slots.discard(slot)
             self.unit_logs[slot] = []
             self.slot_live[slot] = False
             self.slot_gen[slot] += 1
@@ -544,6 +660,10 @@ class MergePlane:
                 for op in ops:
                     op.presync = True
             self.queues[slot].extend(ops)
+            # AFTER the extend, unconditionally: this ordering is what
+            # makes the busy set lock-free against the drain side (see
+            # _busy_slots in __init__)
+            self._busy_slots.add(slot)
             # log at ENQUEUE time: broadcast frames build from the host
             # log without waiting for the device flush (the device round
             # trip must never sit on the edit->broadcast critical path —
@@ -583,10 +703,14 @@ class MergePlane:
         return count
 
     def pending_ops(self) -> int:
-        # list() snapshot: the event-loop thread can insert new queues
-        # (doc load / new tree sequence) while an executor-side flush
-        # calls this — dict.values() iteration would raise
-        total = sum(len(q) for q in list(self.queues.values()))
+        # O(busy), not O(D): walk the nonempty-slot set, not the full
+        # queue registry. list() snapshot: the event-loop thread can
+        # add busy slots while an executor-side flush calls this.
+        total = 0
+        for slot in list(self._busy_slots):
+            queue = self.queues.get(slot)
+            if queue:
+                total += len(queue)
         if self._lane is not None:
             total += self._lane_codec.lane_queue_total(self._lane)
         return total
@@ -604,24 +728,31 @@ class MergePlane:
         with self._step_lock:
             return self._flush_locked(max_batches)
 
-    def warmup_compiles(self, k: Optional[int] = None) -> None:
-        """Pre-compile the integrate step at flush batch shapes.
+    def warmup_compiles(self, shape=None) -> None:
+        """Pre-compile the integrate step over the (K, B) flush grid.
 
-        The first flush at each K otherwise pays the XLA/Mosaic compile
-        (seconds on CPU, tens of seconds cold on TPU) in the serving
-        path — with the flush off the event loop that surfaced as
-        broadcasts delayed until the compile finished. A no-op batch
-        (every slot KIND_NOOP) exercises the identical jitted program
-        without touching document state. Pass k to compile one shape
-        (callers can interleave lock acquisition per shape); default
-        compiles all of them.
+        The first flush at each batch shape otherwise pays the
+        XLA/Mosaic compile (seconds on CPU, tens of seconds cold on
+        TPU) in the serving path — with the flush off the event loop
+        that surfaced as broadcasts delayed until the compile finished.
+        A no-op batch exercises the identical jitted program without
+        touching document state. Pass a (k, b) tuple from
+        warmup_shapes() to compile one shape (callers can interleave
+        lock acquisition per shape), a bare int k for the dense
+        (k, num_docs) shape, or nothing for the whole grid.
         """
-        step = self._step_fn()
-        shapes = [k] if k is not None else self.warmup_shapes()
+        shapes = [shape] if shape is not None else self.warmup_shapes()
         with self._step_lock:
-            for shape in shapes:
-                ops = self._empty_batch(shape)
-                self.state, count = step(self.state, ops)
+            for entry in shapes:
+                k, b = entry if isinstance(entry, tuple) else (entry, self.num_docs)
+                if b >= self.num_docs:
+                    ops = self._empty_batch(k)
+                    self.state, count = self._step_fn()(self.state, ops)
+                else:
+                    ops, slots = self._empty_sparse_batch(k, b)
+                    self.state, count = self._sparse_step_fn()(
+                        self.state, ops, slots
+                    )
                 int(count)  # completion barrier (data-dependent)
 
     def canary_probe(self) -> float:
@@ -629,23 +760,78 @@ class MergePlane:
         supervisor's liveness probe (tpu/supervisor.py). Returns the
         elapsed seconds. Deliberately takes the step lock — a wedged
         flush holding it blocks the probe, which is exactly the
-        condition the watchdog's deadline detects."""
+        condition the watchdog's deadline detects. Uses the smallest
+        sparse shape (K=1, B=1) so the probe's device work is O(1)
+        rows, not a full-population sweep."""
         started = time.perf_counter()
-        step = self._step_fn()
         with self._step_lock:
-            ops = self._empty_batch(1)
-            self.state, count = step(self.state, ops)
+            if self.num_docs > 1:
+                # (K_max, 1): the first entry of the warmup grid — a
+                # warmed plane's probes never pay a compile
+                ops, slots = self._empty_sparse_batch(self._k_buckets()[-1], 1)
+                self.state, count = self._sparse_step_fn()(self.state, ops, slots)
+            else:
+                ops = self._empty_batch(1)
+                self.state, count = self._step_fn()(self.state, ops)
             int(count)  # completion barrier (data-dependent readback)
         return time.perf_counter() - started
 
-    def warmup_shapes(self) -> list[int]:
-        shapes = []
+    def _k_buckets(self) -> list[int]:
+        buckets = []
         k = 1
         while True:
-            shapes.append(k)
+            buckets.append(k)
             if k >= self.max_slots_per_flush:
-                return shapes
+                return buckets
             k *= 2
+
+    def _b_buckets(self) -> list[int]:
+        """SPARSE busy-width buckets: powers of four (a subset of the
+        powers of two, so two octaves of headroom per bucket) strictly
+        below the population. A busy width above the top bucket takes
+        the dense (K, D) layout instead — so the full set of reachable
+        batch shapes is this ladder plus the dense K ladder."""
+        buckets = []
+        b = 1
+        while b < self.num_docs:
+            buckets.append(b)
+            b *= 4
+        return buckets
+
+    def warmup_shapes(self) -> "list[tuple[int, int]]":
+        """Every (K, B) batch shape a flush can dispatch.
+
+        Sparse batches PIN K to the top bucket (the op axis is cheap at
+        sparse widths, and pinning turns the compile grid from
+        |K| x |B| — measured ~1s of XLA compile per shape — into
+        |K| + |B|): one shape per sparse B bucket, plus the dense
+        (k, num_docs) ladder where the op axis does matter. The first
+        entry, (K_max, 1), is also the canary probe's shape, so a
+        supervisor warm pass covers the watchdog's program before the
+        first probe fires."""
+        k_max = self._k_buckets()[-1]
+        return [(k_max, b) for b in self._b_buckets()] + [
+            (k, self.num_docs) for k in self._k_buckets()
+        ]
+
+    def _bucket_b(self, busy: int) -> int:
+        """Round a busy width up to its sparse bucket; num_docs (the
+        dense layout) when it exceeds the top sparse bucket."""
+        b = 1
+        while b < busy:
+            b *= 4
+        return b if b < self.num_docs else self.num_docs
+
+    def _plan_batch(self, busy: int) -> "tuple[bool, int]":
+        """The flush layout decision, in ONE place: (dense, b). Sparse
+        — a compact (K, B) batch plus slot routing — while the busy
+        width buckets below the population; the dense (K, D) sweep once
+        it doesn't, where routing would only add gather/scatter
+        overhead. _flush_locked derives K from `dense` (depth ladder vs
+        pinned k_max) and _assemble_batch lays the batch out from the
+        same verdict — never recomputed separately."""
+        b = self._bucket_b(busy)
+        return b >= self.num_docs, b
 
     def _empty_batch(self, k: int) -> OpBatch:
         d = self.num_docs
@@ -661,42 +847,108 @@ class MergePlane:
         )
         return self._upload_batch(fields)
 
+    def _empty_sparse_batch(self, k: int, b: int) -> tuple:
+        """All-noop (K, B) batch with every routing entry the padding
+        sentinel (num_docs): integrates nothing, compiles/exercises the
+        exact sparse program of a real (k, b) flush batch."""
+        fields = tuple(
+            np.full((k, b), default, dtype)
+            for default, dtype in zip(
+                _FlushStaging._DEFAULTS, _FlushStaging._DTYPES
+            )
+        )
+        slots = np.full((b,), self.num_docs, np.int32)
+        return self._upload_sparse_batch(fields, slots)
+
     def _flush_locked(self, max_batches: Optional[int] = None) -> int:
         from ..observability.tracing import get_tracer
 
         tracer = get_tracer()
+        k_max = self._k_buckets()[-1]
         total = 0
         batches = 0
-        while self.pending_ops() > 0 and (max_batches is None or batches < max_batches):
-            batches += 1
-            deepest = max(
-                (len(q) for q in list(self.queues.values())), default=0
+        build_ms = upload_ms = dispatch_ms = 0.0
+        upload_bytes = 0
+        k_last = b_last = busy_last = 0
+        while max_batches is None or batches < max_batches:
+            t0 = time.perf_counter()
+            drained = self._drain_ops(k_max)
+            if drained is None:
+                break
+            built, depth = drained[5], drained[6]
+            # sparse batches pin K to the top bucket (one compiled
+            # program per B bucket — see warmup_shapes); dense batches
+            # keep the power-of-two K ladder, where the op axis
+            # multiplies a full-population sweep
+            dense, b_bucket = self._plan_batch(int(drained[4].size))
+            if dense:
+                k = 1
+                while k < depth:
+                    k *= 2
+            else:
+                k = k_max
+            staging = self._staging_for(batches, k)
+            fields, slot_view, b, b_actual = self._assemble_batch(
+                k, drained, staging, dense, b_bucket
             )
-            if self._lane is not None:
-                deepest = max(deepest, self._lane_codec.lane_queue_max(self._lane))
-            needed = min(deepest, self.max_slots_per_flush)
-            # round K up to a power of two to bound jit recompilations
-            k = 1
-            while k < needed:
-                k *= 2
-            ops, built = self._build_batch(k)
+            t1 = time.perf_counter()
+            if slot_view is None:
+                step_args = (self._upload_batch(fields),)
+                step = self._step_fn()
+                self.counters["flush_batches_dense"] += 1
+            else:
+                ops, slots_dev = self._upload_sparse_batch(fields, slot_view)
+                step_args = (ops, slots_dev)
+                step = self._sparse_step_fn()
+                self.counters["flush_batches_sparse"] += 1
+            # remember what this staging buffer fed the device:
+            # _staging_for blocks on it before the buffer's next reuse
+            # (two batches from now), so an async transfer can never
+            # still be reading views a later batch resets
+            self._staging_inflight[batches % 2] = step_args
+            t2 = time.perf_counter()
             # `built` is the host-side op count — identical to the
             # device's kind!=NOOP sum by construction, so the flush
             # needs no per-batch count readback (a full RTT each on
             # remote-attached TPUs); _sync_health below is the cycle's
             # single completion barrier (content readback — buffer
             # *readiness* of aliased Pallas outputs is not trustworthy,
-            # see bench.py sync())
-            step = self._step_fn()
+            # see bench.py sync()). The dispatch itself is ASYNC: while
+            # the device integrates batch i, the next loop iteration
+            # builds and uploads batch i+1 from the OTHER staging
+            # buffer — that alternation is the double-buffered pipeline.
             if tracer.enabled:
-                with tracer.device_span("merge_plane.integrate", slots=k) as span:
-                    self.state, _count = step(self.state, ops)
+                with tracer.device_span(
+                    "merge_plane.integrate", slots=k, busy=b
+                ) as span:
+                    self.state, _count = step(self.state, *step_args)
                     span.set("integrated", built)
             else:
-                self.state, _count = step(self.state, ops)
+                self.state, _count = step(self.state, *step_args)
             total += built
+            batches += 1
+            build_ms += (t1 - t0) * 1000.0
+            upload_ms += (t2 - t1) * 1000.0
+            # ~0 where dispatch is truly asynchronous; on synchronous
+            # backends this is the device compute the cycle pays inline
+            dispatch_ms += (time.perf_counter() - t2) * 1000.0
+            upload_bytes += staging.nbytes(k, b, slot_view is not None)
+            k_last, b_last, busy_last = k, b, b_actual
         if batches:
+            t3 = time.perf_counter()
             self._sync_health()
+            self.flush_stats.update(
+                build_ms=round(build_ms, 3),
+                upload_ms=round(upload_ms, 3),
+                dispatch_ms=round(dispatch_ms, 3),
+                device_sync_ms=round((time.perf_counter() - t3) * 1000.0, 3),
+                busy_slots=busy_last,
+                busy_fraction=round(busy_last / max(self.num_docs, 1), 6),
+                batch_k=k_last,
+                batch_b=b_last,
+                batches=batches,
+                upload_bytes=upload_bytes,
+            )
         self.total_integrated += total
         return total
 
@@ -725,21 +977,30 @@ class MergePlane:
         self.last_gen = self.slot_gen.copy()
         self.flush_epoch += 1
 
-    def _build_batch(self, k: int) -> "tuple[OpBatch, int]":
-        d = self.num_docs
-        # accumulate coordinates + per-field columns in flat Python
-        # lists and scatter once per field: per-element numpy stores
-        # cost ~8 scalar assignments per op and dominated flush host
-        # time at scale (measured 18ms for 2048 busy rows x 4 slots)
+    def _drain_ops(self, k: int):
+        """Pop up to k ops from every BUSY queue (Python + native lane)
+        into flat coordinate/value lists — O(busy), never a walk of the
+        full queue registry. Returns None when nothing was drained,
+        else (rows, slots, vals, lane, cols, built, depth): python op
+        coordinates (row-in-batch, arena slot) + 8 per-field value
+        columns, the lane's columnar drain tuple (or None), the sorted
+        unique busy slot ids, the total op count, and the deepest
+        per-queue take (the dense layout's K requirement).
+
+        The busy snapshot is taken via sorted(set) (atomic under the
+        GIL); enqueues landing after the snapshot wait for the next
+        batch, exactly like the old full-registry snapshot."""
         rows: list[int] = []
-        cols: list[int] = []
+        slots: list[int] = []
         vals: tuple[list[int], ...] = ([], [], [], [], [], [], [], [])
-        # snapshot (atomic under the GIL): enqueue on the loop thread may
-        # add queues while this runs in the executor; new queues simply
-        # wait for the next cycle
         built = 0
-        for slot, queue in list(self.queues.items()):
+        depth = 0
+        for slot in sorted(self._busy_slots):
+            queue = self.queues.get(slot)
             if not queue:
+                self._busy_slots.discard(slot)
+                if queue:  # an enqueue raced the discard: repair
+                    self._busy_slots.add(slot)
                 continue
             take = queue[:k]
             # del by len(take), not k: the loop thread may EXTEND this
@@ -750,10 +1011,14 @@ class MergePlane:
             # ops appended in that window (logged in serve_log but never
             # dispatched: permanent host/device divergence).
             del queue[: len(take)]
+            if not queue:
+                self._busy_slots.discard(slot)
+                if queue:  # an enqueue raced the discard: repair
+                    self._busy_slots.add(slot)
             dispatched = 0
             for i, op in enumerate(take):
                 rows.append(i)
-                cols.append(slot)
+                slots.append(slot)
                 vals[0].append(op.kind)
                 vals[1].append(op.client)
                 vals[2].append(op.clock)
@@ -765,51 +1030,135 @@ class MergePlane:
                 if op.kind == KIND_INSERT:
                     dispatched += op.run_len
             built += len(take)
+            if len(take) > depth:
+                depth = len(take)
             self.dispatched_units[slot] += dispatched
-        kind = np.zeros((k, d), np.int32)
-        client = np.zeros((k, d), np.uint32)
-        clock = np.zeros((k, d), np.int32)
-        run_len = np.zeros((k, d), np.int32)
-        left_client = np.full((k, d), NONE_CLIENT, np.uint32)
-        left_clock = np.zeros((k, d), np.int32)
-        right_client = np.full((k, d), NONE_CLIENT, np.uint32)
-        right_clock = np.zeros((k, d), np.int32)
-        if rows:
-            ri = np.asarray(rows, np.intp)
-            ci = np.asarray(cols, np.intp)
-            kind[ri, ci] = vals[0]
-            client[ri, ci] = np.asarray(vals[1], np.uint32)
-            clock[ri, ci] = vals[2]
-            run_len[ri, ci] = vals[3]
-            left_client[ri, ci] = np.asarray(vals[4], np.uint32)
-            left_clock[ri, ci] = vals[5]
-            right_client[ri, ci] = np.asarray(vals[6], np.uint32)
-            right_clock[ri, ci] = vals[7]
+        lane = None
         if self._lane is not None:
             # native lane drain: one C call pops up to k ops per lane
-            # slot into columnar buffers scattered here — no per-op
-            # Python at all on the hot-doc flush path
+            # slot into columnar buffers scattered by _assemble_batch —
+            # no per-op Python at all on the hot-doc flush path
+            drained = self._lane_codec.lane_drain(self._lane, k)
+            if drained[0]:
+                lane = drained
+                ds = np.frombuffer(drained[11], np.int64)
+                self.dispatched_units[ds] += np.frombuffer(drained[12], np.int64)
+                built += drained[0]
+                lane_rows = np.frombuffer(drained[1], np.int64)
+                depth = max(depth, int(lane_rows.max()) + 1)
+        if not built:
+            return None
+        py_cols = np.unique(np.asarray(slots, np.int64))
+        if lane is not None:
+            lane_cols = np.unique(np.frombuffer(lane[2], np.int64))
+            cols = np.union1d(py_cols, lane_cols)
+        else:
+            cols = py_cols
+        return rows, slots, vals, lane, cols, built, depth
+
+    def _staging_for(self, batch_index: int, k: int) -> _FlushStaging:
+        """The staging buffer for this batch (alternating between the
+        two preallocated sets), with its previous upload retired first:
+        block_until_ready on the device arrays last fed from this
+        buffer, so resetting it can never race an in-flight host->device
+        transfer (device_put pins the host views until the transfer
+        completes). Reallocation only happens when a caller asks for a
+        K beyond the bucketed grid (equivalence tests) — counted, so
+        the reuse regression suite can pin allocs flat."""
+        if self._staging is None or self._staging[0].fields[0].shape[0] < k:
+            k_max = max(self._k_buckets()[-1], k)
+            self._staging = [
+                _FlushStaging(k_max, self.num_docs) for _ in range(2)
+            ]
+            # fresh buffers: nothing uploaded from them yet (old
+            # buffers' transfers keep their own pins alive)
+            self._staging_inflight = [None, None]
+            self.counters["flush_staging_allocs"] += 2
+        else:
+            self.counters["flush_staging_reuses"] += 1
+        index = batch_index % 2
+        inflight = self._staging_inflight[index]
+        if inflight is not None:
+            import jax
+
+            jax.block_until_ready(inflight)
+            self._staging_inflight[index] = None
+        return self._staging[index]
+
+    def _assemble_batch(
+        self, k: int, drained, staging: _FlushStaging, dense: bool, b: int
+    ):
+        """Scatter drained ops into staging views.
+
+        `dense`/`b` come from _plan_batch (the single source of the
+        layout decision — this method never recomputes it). Returns
+        (fields, slot_view, b, b_actual). Sparse layout — a compact
+        (K, B) batch over the busy columns plus the int32 (B,)
+        slot-routing view; dense (K, D) layout (column = arena slot,
+        slot_view None) when every slot is effectively busy, where
+        routing would only add gather/scatter overhead."""
+        rows, slots, vals, lane, cols, _built, _depth = drained
+        b_actual = int(cols.size)
+        if dense:
+            b = self.num_docs
+            views = staging.views(k, b)
+            col_idx = np.asarray(slots, np.intp)
+            slot_view = None
+        else:
+            views = staging.views(k, b)
+            col_idx = np.searchsorted(cols, np.asarray(slots, np.int64))
+            slot_view = staging.slot_view(b)
+            slot_view[:b_actual] = cols
+            # padding columns route to the out-of-range sentinel: the
+            # device gather clips (reads some real row, applies noops),
+            # the scatter drops the write — padding can never alias a
+            # busy row (see kernels.integrate_op_slots_sparse)
+            slot_view[b_actual:] = self.num_docs
+        if rows:
+            ri = np.asarray(rows, np.intp)
+            views[0][ri, col_idx] = vals[0]
+            views[1][ri, col_idx] = np.asarray(vals[1], np.uint32)
+            views[2][ri, col_idx] = vals[2]
+            views[3][ri, col_idx] = vals[3]
+            views[4][ri, col_idx] = np.asarray(vals[4], np.uint32)
+            views[5][ri, col_idx] = vals[5]
+            views[6][ri, col_idx] = np.asarray(vals[6], np.uint32)
+            views[7][ri, col_idx] = vals[7]
+        if lane is not None:
             (
-                lane_built, l_rows, l_slots, l_kind, l_client, l_clock,
-                l_run, l_lc, l_lk, l_rc, l_rk, d_slots, d_units,
-            ) = self._lane_codec.lane_drain(self._lane, k)
-            if lane_built:
-                ri = np.frombuffer(l_rows, np.int64)
-                ci = np.frombuffer(l_slots, np.int64)
-                kind[ri, ci] = np.frombuffer(l_kind, np.int32)
-                client[ri, ci] = np.frombuffer(l_client, np.uint32)
-                clock[ri, ci] = np.frombuffer(l_clock, np.int32)
-                run_len[ri, ci] = np.frombuffer(l_run, np.int32)
-                left_client[ri, ci] = np.frombuffer(l_lc, np.uint32)
-                left_clock[ri, ci] = np.frombuffer(l_lk, np.int32)
-                right_client[ri, ci] = np.frombuffer(l_rc, np.uint32)
-                right_clock[ri, ci] = np.frombuffer(l_rk, np.int32)
-                ds = np.frombuffer(d_slots, np.int64)
-                self.dispatched_units[ds] += np.frombuffer(d_units, np.int64)
-                built += lane_built
-        fields = (kind, client, clock, run_len, left_client, left_clock,
-                  right_client, right_clock)
-        return self._upload_batch(fields), built
+                _lane_built, l_rows, l_slots, l_kind, l_client, l_clock,
+                l_run, l_lc, l_lk, l_rc, l_rk, _d_slots, _d_units,
+            ) = lane
+            ri = np.frombuffer(l_rows, np.int64)
+            lane_slots = np.frombuffer(l_slots, np.int64)
+            ci = lane_slots if dense else np.searchsorted(cols, lane_slots)
+            views[0][ri, ci] = np.frombuffer(l_kind, np.int32)
+            views[1][ri, ci] = np.frombuffer(l_client, np.uint32)
+            views[2][ri, ci] = np.frombuffer(l_clock, np.int32)
+            views[3][ri, ci] = np.frombuffer(l_run, np.int32)
+            views[4][ri, ci] = np.frombuffer(l_lc, np.uint32)
+            views[5][ri, ci] = np.frombuffer(l_lk, np.int32)
+            views[6][ri, ci] = np.frombuffer(l_rc, np.uint32)
+            views[7][ri, ci] = np.frombuffer(l_rk, np.int32)
+        return views, slot_view, b, b_actual
+
+    def _build_batch(self, k: int) -> "tuple[OpBatch, int]":
+        """Drain + assemble + upload one DENSE (K, D) batch.
+
+        Kept for callers that want the dense layout regardless of busy
+        width (lane/Python equivalence tests compare batches column by
+        column); the flush loop itself dispatches through the
+        sparse/dense pipeline in _flush_locked."""
+        drained = self._drain_ops(k)
+        if drained is None:
+            return self._empty_batch(k), 0
+        staging = self._staging_for(0, k)
+        fields, _slot_view, _b, _busy = self._assemble_batch(
+            k, drained, staging, True, self.num_docs
+        )
+        ops = self._upload_batch(fields)
+        self._staging_inflight[0] = (ops,)
+        return ops, drained[5]
 
     def _upload_batch(self, fields: tuple) -> OpBatch:
         if self._op_shardings is not None:
@@ -827,6 +1176,29 @@ class MergePlane:
         import jax.numpy as jnp
 
         return OpBatch(*(jnp.asarray(field) for field in fields))
+
+    def _upload_sparse_batch(self, fields: tuple, slots: np.ndarray) -> tuple:
+        """Upload a compact (K, B) batch + its (B,) routing vector.
+
+        On a mesh the tiny op fields replicate (sparse_ops_sharding);
+        XLA routes each busy row's gather/scatter to the shard owning
+        it. jnp.asarray/device_put COPY the staging views, so the
+        staging buffers are free to be rebuilt two batches later."""
+        if self._sparse_op_shardings is not None:
+            import jax
+
+            ops = OpBatch(
+                *(
+                    jax.device_put(field, sharding)
+                    for field, sharding in zip(fields, self._sparse_op_shardings)
+                )
+            )
+            return ops, jax.device_put(slots, self._slots_sharding)
+        import jax.numpy as jnp
+
+        return OpBatch(*(jnp.asarray(field) for field in fields)), jnp.asarray(
+            slots
+        )
 
     # -- extraction --------------------------------------------------------
 

@@ -320,3 +320,62 @@ def integrate_op_slots_fast(state: DocState, ops: OpBatch) -> tuple[DocState, ja
     if jax.default_backend() == "tpu":
         return integrate_op_slots_pallas(state, ops)
     return integrate_op_slots(state, ops)
+
+
+# -- sparse (busy-doc) dispatch ----------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def _integrate_sparse_pallas(state: DocState, ops: OpBatch, slots, interpret: bool):
+    """Gather the B busy rows, run the VMEM-resident block kernel over
+    the (B, N) sub-arena, scatter back in place — one jitted program, so
+    XLA fuses the gather into the kernel's input pipeline and aliases
+    the (D, N) arenas through the scatter (the state is donated)."""
+    from .kernels import gather_doc_rows, scatter_doc_rows
+
+    sub = gather_doc_rows(state, slots)
+    sub, count = _integrate_pallas.__wrapped__(sub, ops, interpret)
+    state = scatter_doc_rows(state, sub, slots)
+    count, _ = jax.lax.optimization_barrier((count, state.length))
+    return state, count
+
+
+def integrate_op_slots_sparse_pallas(
+    state: DocState, ops: OpBatch, slots, *, interpret: bool = False
+) -> tuple[DocState, jax.Array]:
+    """Sparse dispatch via Pallas; ops fields are (K, B), slots (B,).
+
+    Falls back to the sparse XLA scan when B has no valid doc-block
+    factor (B < 8) or — permanently per shape — when Mosaic rejects
+    the kernel."""
+    from .kernels import integrate_op_slots_sparse
+
+    b = int(slots.shape[0])
+    capacity = state.id_client.shape[1]
+    shape = (b, capacity, ops.kind.shape[0])
+    if _pick_block(b, capacity) == 0 or shape in _pallas_broken_shapes:
+        return integrate_op_slots_sparse(state, ops, slots)
+    try:
+        return _integrate_sparse_pallas(state, ops, slots, interpret)
+    except Exception as error:  # Mosaic/XLA compile or launch failure
+        _pallas_broken_shapes.add(shape)
+        import logging
+
+        logging.getLogger("hocuspocus_tpu.tpu").warning(
+            "pallas sparse integrate failed at shape %s; falling back to XLA scan: %s",
+            shape,
+            str(error)[:500],
+        )
+        return integrate_op_slots_sparse(state, ops, slots)
+
+
+def integrate_op_slots_sparse_fast(
+    state: DocState, ops: OpBatch, slots
+) -> tuple[DocState, jax.Array]:
+    """Backend dispatcher for the sparse step: Pallas on TPU, XLA scan
+    elsewhere."""
+    from .kernels import integrate_op_slots_sparse
+
+    if jax.default_backend() == "tpu":
+        return integrate_op_slots_sparse_pallas(state, ops, slots)
+    return integrate_op_slots_sparse(state, ops, slots)

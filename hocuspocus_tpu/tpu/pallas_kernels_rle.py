@@ -365,3 +365,55 @@ def integrate_op_slots_rle_fast(state: RleState, ops: OpBatch):
     if jax.default_backend() == "tpu":
         return integrate_op_slots_rle_pallas(state, ops)
     return integrate_op_slots_rle(state, ops)
+
+
+# -- sparse (busy-doc) dispatch ----------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def _integrate_sparse_pallas_rle(state: RleState, ops: OpBatch, slots, interpret: bool):
+    """RLE twin of pallas_kernels._integrate_sparse_pallas: gather the
+    B busy rows, run the block kernel over the (B, R) sub-arena,
+    scatter back into the donated full state."""
+    from .kernels import gather_doc_rows, scatter_doc_rows
+
+    sub = gather_doc_rows(state, slots)
+    sub, count = _integrate_pallas_rle.__wrapped__(sub, ops, interpret)
+    state = scatter_doc_rows(state, sub, slots)
+    count, _ = jax.lax.optimization_barrier((count, state.total_units))
+    return state, count
+
+
+def integrate_op_slots_rle_sparse_pallas(
+    state: RleState, ops: OpBatch, slots, *, interpret: bool = False
+):
+    """Sparse RLE dispatch via Pallas; falls back to the sparse XLA scan
+    when B has no valid block factor or Mosaic rejects the shape."""
+    from .kernels_rle import integrate_op_slots_rle_sparse
+
+    b = int(slots.shape[0])
+    entries = state.run_client.shape[1]
+    shape = (b, entries, ops.kind.shape[0])
+    if _pick_block_rle(b, entries) == 0 or shape in _pallas_rle_broken_shapes:
+        return integrate_op_slots_rle_sparse(state, ops, slots)
+    try:
+        return _integrate_sparse_pallas_rle(state, ops, slots, interpret)
+    except Exception as error:
+        _pallas_rle_broken_shapes.add(shape)
+        import logging
+
+        logging.getLogger("hocuspocus_tpu.tpu").warning(
+            "pallas sparse RLE integrate failed at shape %s; falling back: %s",
+            shape,
+            str(error)[:500],
+        )
+        return integrate_op_slots_rle_sparse(state, ops, slots)
+
+
+def integrate_op_slots_rle_sparse_fast(state: RleState, ops: OpBatch, slots):
+    """Backend dispatcher for the sparse RLE step."""
+    from .kernels_rle import integrate_op_slots_rle_sparse
+
+    if jax.default_backend() == "tpu":
+        return integrate_op_slots_rle_sparse_pallas(state, ops, slots)
+    return integrate_op_slots_rle_sparse(state, ops, slots)

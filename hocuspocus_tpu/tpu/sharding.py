@@ -117,6 +117,49 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None, interpret: 
     )
 
 
+def sparse_ops_sharding(mesh: Mesh) -> "tuple[OpBatch, NamedSharding]":
+    """(K, B) sparse op batches + the (B,) slot-routing vector are tiny
+    (B = busy docs, not the population) — replicate them across the
+    mesh and let XLA route each busy row's gather/scatter to the shard
+    that owns it. Returns (op shardings, slots sharding)."""
+    replicated = NamedSharding(mesh, P(None, None))
+    return OpBatch(*([replicated] * 8)), NamedSharding(mesh, P(None))
+
+
+def make_sharded_sparse_step(mesh: Mesh):
+    """Jitted multi-chip SPARSE integrate step: (K, B) ops + (B,) slot
+    routing against the doc-sharded arenas. The gather/scatter pair is
+    partitioned by XLA — each shard materializes only its own busy
+    rows' updates (collectives route rows whose owner differs from the
+    batch layout), so per-flush traffic scales with B, not D."""
+    from .kernels import integrate_op_slots_sparse
+
+    st_shard = state_sharding(mesh)
+    op_shard, slot_shard = sparse_ops_sharding(mesh)
+    count_sharding = NamedSharding(mesh, P())
+    return jax.jit(
+        integrate_op_slots_sparse.__wrapped__,
+        in_shardings=(st_shard, op_shard, slot_shard),
+        out_shardings=(st_shard, count_sharding),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_rle_sparse_step(mesh: Mesh):
+    """RLE twin of make_sharded_sparse_step."""
+    from .kernels_rle import integrate_op_slots_rle_sparse
+
+    st_shard = rle_state_sharding(mesh)
+    op_shard, slot_shard = sparse_ops_sharding(mesh)
+    count_sharding = NamedSharding(mesh, P())
+    return jax.jit(
+        integrate_op_slots_rle_sparse.__wrapped__,
+        in_shardings=(st_shard, op_shard, slot_shard),
+        out_shardings=(st_shard, count_sharding),
+        donate_argnums=(0,),
+    )
+
+
 def make_sharded_state(mesh: Mesh, num_docs: int, capacity: int) -> DocState:
     state = make_empty_state(num_docs, capacity)
     shardings = state_sharding(mesh)

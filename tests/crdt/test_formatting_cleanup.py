@@ -116,6 +116,53 @@ def test_concurrent_same_format_dedups_after_exchange():
     assert encode_state_as_update(a) == encode_state_as_update(b)
 
 
+def test_gap_cleanup_uses_identity_for_object_attrs():
+    """yjs cleanupFormattingGap compares attribute values with `===`:
+    value equality for primitives, REFERENCE identity for objects. A
+    marker restating an equal-but-DISTINCT object attribute (the normal
+    shape after a wire decode — every decode builds fresh objects) is
+    therefore KEPT by yjs peers; deleting it with deep equality
+    diverges our tombstone layout from yjs interop expectations
+    (round-5 ADVICE). Primitive values still dedup."""
+
+    def build(attr_value):
+        a = Doc()
+        ta = a.get_text("t")
+        ta.insert(0, "abcdefgh")
+        ta.format(0, 8, {"c": attr_value})
+        b = Doc()
+        b.get_text("t")
+        apply_update(b, encode_state_as_update(a), "remote")
+        # carve an unformat out of the middle: ...[c=None]def[reopen c]...
+        b.get_text("t").format(3, 3, {"c": None})
+        c = Doc()
+        tc = c.get_text("t")
+        apply_update(c, encode_state_as_update(b), "remote")
+        # tombstone "def": the gap now holds the None marker, the
+        # tombstones, and the REOPEN marker restating the start attr
+        tc.delete(3, 3)
+        return tc
+
+    tc = build({"x": 1})
+    # the reopen marker's dict is EQUAL to the start attribute but a
+    # DISTINCT decoded object: identity semantics keep it (open +
+    # reopen + close = 3 live markers), rendered content unchanged
+    assert tc.to_string() == "abcgh"
+    assert _live_format_markers(tc) == 3, _live_format_markers(tc)
+    assert all(
+        op.get("attributes") == {"c": {"x": 1}} for op in tc.to_delta()
+    ), tc.to_delta()
+
+    tc = build(True)
+    # primitives compare by value under ===: the restatement is
+    # redundant and collected
+    assert tc.to_string() == "abcgh"
+    assert _live_format_markers(tc) == 2, _live_format_markers(tc)
+    assert all(
+        op.get("attributes") == {"c": True} for op in tc.to_delta()
+    ), tc.to_delta()
+
+
 def test_cleanup_converges_under_random_format_churn():
     """Random concurrent format/insert/delete churn with relays: marker
     populations stay bounded and the peers always converge."""

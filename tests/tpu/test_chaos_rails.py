@@ -56,13 +56,15 @@ async def test_device_fault_mid_flush_degrades_all_without_loss():
         fallbacks_before = ext.plane.counters["cpu_fallbacks"]
 
         # kill the device: every step from here raises mid-flush
+        # (both entry points — dense sweeps and sparse busy-doc batches)
         def dead_step_factory():
-            def dead_step(state, ops):
+            def dead_step(state, ops, slots=None):
                 raise RuntimeError("XlaRuntimeError: DEVICE_FAULT (injected)")
 
             return dead_step
 
         ext.plane._step_fn = dead_step_factory
+        ext.plane._sparse_step_fn = dead_step_factory
 
         # edits DURING the fault window — their queued ops ride the
         # flush that dies

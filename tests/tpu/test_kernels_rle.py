@@ -275,6 +275,7 @@ def test_delete_splits_do_not_flag_overflow_at_tight_capacity():
     assert delete_ranges(rle, 0) == [(7, 5, 4)]
 
 
+@pytest.mark.slow  # ~35s of incremental fuzz: outside the tier-1 gate
 @pytest.mark.parametrize("seed", [21, 22])
 def test_incremental_batches_match_unit_kernel(seed):
     """Serving feeds ops incrementally across flush batches, not as one

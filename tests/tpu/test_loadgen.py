@@ -11,6 +11,10 @@ import pytest
 
 from hocuspocus_tpu.loadgen import run_served_load
 
+# ~70s of served-load topology runs: excluded from the tier-1 gate
+# (-m 'not slow'); the full suite still runs wherever slow tests do
+pytestmark = pytest.mark.slow
+
 
 async def test_loadgen_single_instance():
     result = await run_served_load(

@@ -1,0 +1,355 @@
+"""Sparse busy-doc dispatch: differential + regression coverage.
+
+The flush engine ships compact (K, B) batches over only the busy doc
+slots, routed by an int32 slot vector, instead of dense (K, D) sweeps
+(docs/guides/tpu-merge-pipeline.md). These suites pin:
+
+- kernel equivalence: sparse gather/integrate/scatter == the dense
+  sweep, padding sentinel included (unit + RLE arenas);
+- the live plane path: random busy subsets with interleaved flushes
+  serve state equal to CPU ground-truth docs;
+- staging reuse: per-flush staging buffers are reused, not
+  re-allocated;
+- the (K, B) warmup grid + the sparse canary probe;
+- a CPU-backend flush-pipeline smoke (tier-1): sparse and dense cycles
+  through the server-facing flush() API.
+"""
+
+import numpy as np
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.tpu.kernels import (
+    KIND_INSERT,
+    NONE_CLIENT,
+    OpBatch,
+    integrate_op_slots,
+    integrate_op_slots_sparse,
+    make_empty_state,
+)
+from hocuspocus_tpu.tpu.merge_plane import MergePlane
+from hocuspocus_tpu.tpu.serving import PlaneServing
+
+D, N, K = 16, 128, 4
+
+
+def _append_ops(rng, clocks, busy):
+    """Dense (K, D) field arrays holding a K-deep append run for each
+    busy doc (id-chained inserts, the typing-burst shape)."""
+    kind = np.zeros((K, D), np.int32)
+    client = np.zeros((K, D), np.uint32)
+    clock = np.zeros((K, D), np.int32)
+    run = np.zeros((K, D), np.int32)
+    lc = np.full((K, D), NONE_CLIENT, np.uint32)
+    lk = np.zeros((K, D), np.int32)
+    rc = np.full((K, D), NONE_CLIENT, np.uint32)
+    rk = np.zeros((K, D), np.int32)
+    for d in busy:
+        for k in range(K):
+            kind[k, d] = KIND_INSERT
+            client[k, d] = 7
+            clock[k, d] = clocks[d]
+            run[k, d] = 3
+            if clocks[d] > 0:
+                lc[k, d] = 7
+                lk[k, d] = clocks[d] - 1
+            clocks[d] += 3
+    return (kind, client, clock, run, lc, lk, rc, rk)
+
+
+def _sparse_view(fields, busy):
+    """Slice the busy columns out and pad to the power-of-two bucket
+    with noops + the out-of-range sentinel slot."""
+    b = 1
+    while b < len(busy):
+        b *= 2
+    pad = b - len(busy)
+    sparse = []
+    for i, field in enumerate(fields):
+        pad_value = NONE_CLIENT if i in (4, 6) else 0
+        sparse.append(
+            np.concatenate(
+                [field[:, busy], np.full((K, pad), pad_value, field.dtype)], axis=1
+            )
+        )
+    slots = np.asarray(list(busy) + [D] * pad, np.int32)
+    return OpBatch(*sparse), slots
+
+
+def test_sparse_kernel_matches_dense_unit_arena():
+    rng = np.random.default_rng(2)
+    clocks = np.zeros(D, np.int64)
+    dense_state = make_empty_state(D, N)
+    sparse_state = make_empty_state(D, N)
+    for _round in range(4):
+        busy = sorted(rng.choice(D, size=int(rng.integers(1, 6)), replace=False))
+        fields = _append_ops(rng, clocks, busy)
+        dense_state, dense_count = integrate_op_slots(
+            dense_state, OpBatch(*fields)
+        )
+        ops, slots = _sparse_view(fields, busy)
+        sparse_state, sparse_count = integrate_op_slots_sparse(
+            sparse_state, ops, slots
+        )
+        assert int(dense_count) == int(sparse_count) + (D - len(busy)) * 0
+    for dense_field, sparse_field in zip(dense_state, sparse_state):
+        np.testing.assert_array_equal(
+            np.asarray(dense_field), np.asarray(sparse_field)
+        )
+
+
+def test_sparse_kernel_matches_dense_rle_arena():
+    from hocuspocus_tpu.tpu.kernels_rle import (
+        integrate_op_slots_rle,
+        integrate_op_slots_rle_sparse,
+        make_empty_rle_state,
+    )
+
+    rng = np.random.default_rng(3)
+    clocks = np.zeros(D, np.int64)
+    dense_state = make_empty_rle_state(D, N)
+    sparse_state = make_empty_rle_state(D, N)
+    for _round in range(4):
+        busy = sorted(rng.choice(D, size=int(rng.integers(1, 6)), replace=False))
+        fields = _append_ops(rng, clocks, busy)
+        dense_state, _ = integrate_op_slots_rle(dense_state, OpBatch(*fields))
+        ops, slots = _sparse_view(fields, busy)
+        sparse_state, _ = integrate_op_slots_rle_sparse(sparse_state, ops, slots)
+    for dense_field, sparse_field in zip(dense_state, sparse_state):
+        np.testing.assert_array_equal(
+            np.asarray(dense_field), np.asarray(sparse_field)
+        )
+
+
+# -- live plane differential fuzz --------------------------------------------
+
+WORDS = ["alpha ", "bb", "c", "delta-", "ee ", "zz"]
+
+
+def _edit(rng, doc: Doc) -> None:
+    text = doc.get_text("t")
+    kind = rng.integers(0, 4)
+    if kind == 0 or len(text) == 0:
+        text.insert(int(rng.integers(0, len(text) + 1)), WORDS[rng.integers(0, 6)])
+    elif kind == 1:
+        pos = int(rng.integers(0, len(text)))
+        text.delete(pos, min(int(rng.integers(1, 3)), len(text) - pos))
+    elif kind == 2 and len(text) > 1:
+        pos = int(rng.integers(0, len(text) - 1))
+        text.format(pos, 1, {"bold": bool(rng.integers(0, 2))})
+    else:
+        text.insert(len(text), WORDS[rng.integers(0, 6)])
+
+
+@pytest.mark.parametrize("arena", ["unit", "rle"])
+@pytest.mark.parametrize("seed", [1, 7])
+def test_sparse_dispatch_fuzz_random_busy_subsets(seed, arena):
+    """Random busy subsets + interleaved flushes vs CPU ground truth:
+    every flush cycle dispatches a different busy width (different
+    (K, B) buckets, sparse and dense), and after every cycle each doc
+    must still serve bytes that rebuild its CPU double."""
+    rng = np.random.default_rng(seed)
+    plane = MergePlane(num_docs=32, capacity=2048, arena=arena)
+    serving = PlaneServing(plane)
+    population = 8
+    docs, pending = {}, {}
+    for i in range(population):
+        name = f"doc-{i}"
+        plane.register(name)
+        doc = Doc()
+        queue: list = []
+        doc.on("update", lambda update, *rest, queue=queue: queue.append(update))
+        docs[name], pending[name] = doc, queue
+    for _round in range(14):
+        subset = rng.choice(
+            population, size=int(rng.integers(1, population + 1)), replace=False
+        )
+        for i in subset:
+            name = f"doc-{i}"
+            for _ in range(int(rng.integers(1, 4))):
+                _edit(rng, docs[name])
+            for update in pending[name]:
+                plane.enqueue_update(name, update)
+            pending[name].clear()
+        # interleaved flushes: sometimes one batch per cycle (serving
+        # cadence), sometimes a full drain (sync-serve cadence)
+        if rng.integers(0, 2):
+            plane.flush(max_batches=1)
+            plane.flush()
+        else:
+            plane.flush()
+        serving.refresh()
+        assert plane.pending_ops() == 0
+    for i in range(population):
+        name = f"doc-{i}"
+        assert plane.is_supported(name), (seed, arena, plane.counters)
+        served = serving.encode_state_as_update(name, docs[name], None)
+        assert served is not None, (seed, arena, name)
+        rebuilt = Doc()
+        apply_update(rebuilt, served)
+        assert (
+            rebuilt.get_text("t").to_delta() == docs[name].get_text("t").to_delta()
+        ), (seed, arena, name)
+    assert plane.counters["flush_batches_sparse"] > 0
+
+
+# -- staging reuse regression -------------------------------------------------
+
+
+def test_staging_reused_not_reallocated():
+    """The per-flush staging buffers are allocated once (two sets,
+    double buffering) and every subsequent batch reuses them — a
+    regression here silently reintroduces the 8x(K, D)-fresh-allocs-
+    per-batch host cost the pipeline removed."""
+    plane = MergePlane(num_docs=16, capacity=512)
+    plane.register("doc")
+    source = Doc()
+    updates: list = []
+    source.on("update", lambda update, *rest: updates.append(update))
+    text = source.get_text("t")
+    cycles = 6
+    for cycle in range(cycles):
+        text.insert(len(text), f"cycle {cycle} ")
+        for update in updates:
+            plane.enqueue_update("doc", update)
+        updates.clear()
+        plane.flush()
+    assert plane.counters["flush_staging_allocs"] == 2
+    assert plane.counters["flush_staging_reuses"] == cycles - 1
+    first_ids = [id(field) for field in plane._staging[0].fields] + [
+        id(field) for field in plane._staging[1].fields
+    ]
+    text.insert(len(text), "tail")
+    for update in updates:
+        plane.enqueue_update("doc", update)
+    updates.clear()
+    plane.flush()
+    assert plane.counters["flush_staging_allocs"] == 2  # still the same two
+    assert [id(field) for field in plane._staging[0].fields] + [
+        id(field) for field in plane._staging[1].fields
+    ] == first_ids
+    assert plane.text("doc") == source.get_text("t").to_string()
+
+
+# -- warmup grid + canary ------------------------------------------------------
+
+
+def test_warmup_grid_covers_sparse_and_dense_shapes():
+    plane = MergePlane(num_docs=8, capacity=128, max_slots_per_flush=4)
+    shapes = plane.warmup_shapes()
+    # (K_max, 1) first: the canary probe's shape compiles before the
+    # first watchdog tick on a warmed plane
+    assert shapes[0] == (4, 1)
+    assert (4, 8) in shapes  # the dense fallback shape
+    # sparse shapes pin K to the top bucket: the grid is |K| + |B|
+    assert all(k == 4 for k, b in shapes if b < plane.num_docs)
+    assert all(b <= plane.num_docs for _k, b in shapes)
+    assert all(k & (k - 1) == 0 and b & (b - 1) == 0 for k, b in shapes)
+    plane.warmup_compiles((1, 1))
+    plane.warmup_compiles((2, 4))
+    plane.warmup_compiles(2)  # legacy int form: dense (2, num_docs)
+    latency = plane.canary_probe()
+    assert latency >= 0.0
+    # warmups + canaries integrate nothing
+    assert plane.total_integrated == 0
+    assert int(np.asarray(plane.state.length).sum()) == 0
+
+
+# -- CPU-backend flush-pipeline smoke (tier-1) --------------------------------
+
+
+def test_flush_pipeline_smoke_mixed_widths():
+    """Build→upload→step→readback smoke across the widths the engine
+    dispatches: one busy doc (sparse B=1), a few (sparse bucket), all
+    busy (dense fallback), and a multi-batch backlog drain."""
+    plane = MergePlane(num_docs=8, capacity=512, max_slots_per_flush=2)
+    serving = PlaneServing(plane)
+    population = 8
+    docs, pending = {}, {}
+    for i in range(population):
+        name = f"doc-{i}"
+        plane.register(name)
+        doc = Doc()
+        queue: list = []
+        doc.on("update", lambda update, *rest, queue=queue: queue.append(update))
+        docs[name], pending[name] = doc, queue
+
+    def touch(indices, burst=1):
+        for i in indices:
+            name = f"doc-{i}"
+            for n in range(burst):
+                docs[name].get_text("t").insert(0, f"w{n} ")
+            for update in pending[name]:
+                plane.enqueue_update(name, update)
+            pending[name].clear()
+
+    # one busy doc -> sparse (B=1)
+    touch([0])
+    plane.flush()
+    assert plane.counters["flush_batches_sparse"] >= 1
+    assert plane.flush_stats["batch_b"] == 1
+    assert plane.flush_stats["busy_slots"] == 1
+    # three busy docs -> sparse bucket B=4
+    touch([1, 2, 3])
+    plane.flush()
+    assert plane.flush_stats["batch_b"] == 4
+    assert plane.flush_stats["busy_fraction"] == pytest.approx(3 / 8)
+    # every doc busy -> dense fallback, no routing overhead
+    touch(range(population))
+    plane.flush()
+    assert plane.counters["flush_batches_dense"] >= 1
+    assert plane.flush_stats["batch_b"] == plane.num_docs
+    # backlog deeper than max_slots_per_flush drains over multiple
+    # batches; max_batches=1 leaves a remainder, a full flush clears it
+    touch([4], burst=6)
+    assert plane.pending_ops() > 2
+    plane.flush(max_batches=1)
+    assert plane.pending_ops() > 0
+    plane.flush()
+    assert plane.pending_ops() == 0
+    # stage gauges populated
+    for key in ("build_ms", "upload_ms", "device_sync_ms", "upload_bytes"):
+        assert plane.flush_stats[key] >= 0
+    assert plane.flush_stats["upload_bytes"] > 0
+    # served state equals ground truth after the mixed cycles
+    serving.refresh()
+    for i in range(population):
+        name = f"doc-{i}"
+        assert plane.text(name) == docs[name].get_text("t").to_string(), name
+        served = serving.encode_state_as_update(name, docs[name], None)
+        rebuilt = Doc()
+        apply_update(rebuilt, served)
+        assert (
+            rebuilt.get_text("t").to_string()
+            == docs[name].get_text("t").to_string()
+        )
+
+
+def test_pending_ops_tracks_busy_set_exactly():
+    """pending_ops walks the nonempty-slot set (O(busy)); it must stay
+    exact through enqueue/drain/retire transitions."""
+    plane = MergePlane(num_docs=8, capacity=256)
+    plane.register("a")
+    plane.register("b")
+    source = Doc()
+    updates: list = []
+    source.on("update", lambda update, *rest: updates.append(update))
+    source.get_text("t").insert(0, "hello")
+    for update in updates:
+        plane.enqueue_update("a", update)
+        plane.enqueue_update("b", update)
+    queued = sum(len(q) for q in plane.queues.values())
+    assert plane.pending_ops() == queued > 0
+    assert plane._busy_slots
+    plane.flush()
+    assert plane.pending_ops() == 0
+    assert not plane._busy_slots
+    # a retired doc's cleared queue leaves the busy set immediately
+    updates.clear()
+    source.get_text("t").insert(0, "more")
+    for update in updates:
+        plane.enqueue_update("a", update)
+    assert plane.pending_ops() > 0
+    plane.retire_doc("a", "fallback")
+    assert plane.pending_ops() == 0
+    assert not plane._busy_slots

@@ -58,14 +58,18 @@ def _fast_ext(**overrides):
 class _WedgeableStep:
     """Swappable step factory: pass-through until wedge() is called;
     wedged steps block on the gate, then run the real step — modeling a
-    hung device that later completes the in-flight launch."""
+    hung device that later completes the in-flight launch. Covers BOTH
+    device entry points (the dense step and the sparse busy-doc step —
+    flushes and the canary dispatch through either)."""
 
     def __init__(self, plane) -> None:
         self.plane = plane
         self.real = plane._step_fn
+        self.real_sparse = plane._sparse_step_fn
         self.gate = threading.Event()
         self.wedged = False
         plane._step_fn = self._factory
+        plane._sparse_step_fn = self._sparse_factory
 
     def _factory(self):
         real_step = self.real()
@@ -75,6 +79,17 @@ class _WedgeableStep:
         def blocked(state, ops):
             self.gate.wait()
             return real_step(state, ops)
+
+        return blocked
+
+    def _sparse_factory(self):
+        real_step = self.real_sparse()
+        if not self.wedged:
+            return real_step
+
+        def blocked(state, ops, slots):
+            self.gate.wait()
+            return real_step(state, ops, slots)
 
         return blocked
 
