@@ -594,6 +594,16 @@ def run_bench() -> None:
             sparse = _measure_sparse_load()
         except Exception as error:
             sparse = {"error": repr(error)[:300]}
+
+    # catch-up storm admission (config 5 miniature): cold snapshots
+    # burst into the residency hydration queue + SV-diff tail replay
+    storm = None
+    if os.environ.get("BENCH_CATCHUP_STORM", "1") != "0":
+        _log("inner: catch-up storm pass ...")
+        try:
+            storm = _measure_catchup_storm()
+        except Exception as error:
+            storm = {"error": repr(error)[:300]}
     _log("inner: all passes done")
 
     merges_per_sec = total_ops / elapsed
@@ -631,6 +641,8 @@ def run_bench() -> None:
         result["extra"]["rle"] = rle
     if sparse is not None:
         result["extra"]["sparse_load"] = sparse
+    if storm is not None:
+        result["extra"]["catchup_storm"] = storm
     if jax.default_backend() != "tpu":
         onchip = _latest_onchip_capture()
         result["extra"]["note"] = (
@@ -804,6 +816,92 @@ def _measure_sparse_load() -> dict:
         "staging_allocs": plane.counters["flush_staging_allocs"],
         "staging_reuses": plane.counters["flush_staging_reuses"],
     }
+
+
+def _measure_catchup_storm() -> dict:
+    """Cold-doc hydration storm through the residency manager
+    (BASELINE config 5 miniature, docs/guides/tpu-residency.md): N
+    stored snapshots burst into the admission queue at once; a quarter
+    of the docs also replay a post-snapshot live tail (the lowerer's
+    known-clock dedup makes that a state-vector-diff replay). Reports
+    hydration p50/p99, peak admission-queue depth, and the in-flight
+    bound actually observed — plus a full zero-lost-updates sweep."""
+    import asyncio as _asyncio
+    import time as _time
+
+    from hocuspocus_tpu.crdt import Doc, encode_state_as_update
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+    from hocuspocus_tpu.tpu.residency import EvictedDoc, ResidencyManager
+    from hocuspocus_tpu.tpu.serving import PlaneServing
+
+    storm = int(os.environ.get("BENCH_STORM_DOCS", 10_000))
+    batch = int(os.environ.get("BENCH_STORM_BATCH", 128))
+    budget_s = int(os.environ.get("BENCH_STORM_TIMEOUT", 300))
+
+    async def run() -> dict:
+        plane = MergePlane(num_docs=storm + 64, capacity=64)
+        serving = PlaneServing(plane)
+        mgr = ResidencyManager(
+            plane=plane, serving=serving, hydrate_batch=batch
+        )
+        texts: dict = {}
+        tails: dict = {}
+        for i in range(storm):
+            ref = Doc()
+            ref.get_text("t").insert(0, "cold doc %05d " % i + "payload " * 3)
+            snapshot = encode_state_as_update(ref)
+            if i % 4 == 0:
+                # edits that landed after the eviction snapshot: the
+                # hydration live-tail replay must carry them
+                ref.get_text("t").insert(0, "tail %d " % i)
+                tails[f"storm-{i}"] = ref
+            texts[f"storm-{i}"] = ref.get_text("t").to_string()
+            mgr.evicted[f"storm-{i}"] = EvictedDoc(snapshot, 0.0)
+
+        inflight_max = 0
+        orig_flush = plane.flush
+
+        def spy_flush(*args, **kwargs):
+            nonlocal inflight_max
+            inflight_max = max(inflight_max, mgr.inflight)
+            return orig_flush(*args, **kwargs)
+
+        plane.flush = spy_flush
+        t0 = _time.perf_counter()
+        for name in texts:
+            mgr.request_hydration(name, tails.get(name))
+        deadline = t0 + budget_s
+        while (mgr._queue or mgr._drain_running) and _time.perf_counter() < deadline:
+            await _asyncio.sleep(0.005)
+        elapsed = _time.perf_counter() - t0
+        plane.flush = orig_flush
+        completed = not mgr._queue and not mgr._drain_running
+
+        serving.refresh()
+        lost = sum(
+            1
+            for name, want in texts.items()
+            if not (plane.is_supported(name) and plane.text(name) == want)
+        )
+        stats = mgr.stats_snapshot()
+        hydrated = plane.counters["docs_hydrated"]
+        return {
+            "docs": storm,
+            "hydrate_batch": batch,
+            "tail_replays": len(tails),
+            "elapsed_s": round(elapsed, 2),
+            "hydrations_per_sec": round(hydrated / elapsed, 1) if elapsed else 0.0,
+            "hydrated": hydrated,
+            "declined": plane.counters["hydrations_declined"],
+            "hydration_p50_ms": stats["hydration_p50_ms"],
+            "hydration_p99_ms": stats["hydration_p99_ms"],
+            "queue_peak": int(plane.residency_stats["hydration_queue_peak"]),
+            "max_inflight": inflight_max,
+            "completed": completed,
+            "lost_updates": lost,
+        }
+
+    return _asyncio.run(run())
 
 
 def _measure_sharded_scale() -> dict:

@@ -83,6 +83,36 @@ def build_parser() -> argparse.ArgumentParser:
         "'rle' (one entry per run — survives churny long-lived docs; "
         "--tpu-capacity then counts entries)",
     )
+    # arena residency (docs/guides/tpu-residency.md): slots are a
+    # managed cache — idle docs evict to host snapshots, cold docs
+    # re-admit through a bounded hydration queue, pressured rows
+    # compact on-device instead of retiring to the CPU path forever.
+    parser.add_argument(
+        "--tpu-evict-idle-secs",
+        type=float,
+        default=0.0,
+        help="evict a doc's arena rows after this many seconds without "
+        "an edit (serve mode; 0 disables eviction). Evicted docs serve "
+        "from the CPU path and re-enter via batched hydration on their "
+        "next edit or load (default 0)",
+    )
+    parser.add_argument(
+        "--tpu-hydrate-batch",
+        type=int,
+        default=64,
+        help="cold/evicted docs admitted back onto the plane per "
+        "hydration round — the catch-up storm's concurrency bound "
+        "(default 64)",
+    )
+    parser.add_argument(
+        "--tpu-compact-threshold",
+        type=float,
+        default=0.75,
+        help="row occupancy fraction that triggers on-device tombstone "
+        "compaction; also enables compact-based recycling of "
+        "capacity/overflow-retired docs (serve mode; 0 disables, "
+        "default 0.75)",
+    )
     # plane supervisor (docs/guides/tpu-supervisor.md): the TPU runtime
     # is an accelerator the server may acquire, never a boot dependency
     # — a wedged/absent runtime degrades to CPU-merge mode, the server
@@ -156,6 +186,9 @@ async def run(args: argparse.Namespace) -> None:
                 flush_interval_ms=args.tpu_flush_interval,
                 broadcast_interval_ms=args.tpu_broadcast_interval,
                 arena=args.tpu_arena,
+                evict_idle_secs=args.tpu_evict_idle_secs,
+                hydrate_batch=args.tpu_hydrate_batch,
+                compact_threshold=args.tpu_compact_threshold,
             )
         )
 

@@ -141,6 +141,38 @@ class Metrics(Extension):
                     f"TPU merge plane flush stage stat: {key} (last cycle)",
                     fn=(lambda p=plane, k=key: p.flush_stats[k]),
                 )
+            # arena occupancy (docs/guides/tpu-residency.md): capacity
+            # pressure must be visible BEFORE admission starts failing.
+            # free + live + retired partition the arena; retired rows
+            # are allocated-but-degraded (bound to docs off the device
+            # path until unload or compaction reclaims them).
+            reg.gauge(
+                "hocuspocus_tpu_plane_slots_free",
+                "Arena rows on the free list (admission headroom)",
+                fn=(lambda p=plane: len(p.free)),
+            )
+            reg.gauge(
+                "hocuspocus_tpu_plane_slots_live",
+                "Arena rows bound to live (plane-served) docs",
+                fn=(lambda p=plane: int(p.slot_live.sum())),
+            )
+            reg.gauge(
+                "hocuspocus_tpu_plane_slots_retired",
+                "Arena rows held by retired/degraded docs until unload",
+                fn=(
+                    lambda p=plane: p.num_docs
+                    - len(p.free)
+                    - int(p.slot_live.sum())
+                ),
+            )
+            # residency subsystem stats (evicted population, hydration
+            # queue/latency, compaction timings)
+            for key in getattr(plane, "residency_stats", {}):
+                reg.gauge(
+                    f"hocuspocus_tpu_plane_residency_{key}",
+                    f"TPU plane residency stat: {key}",
+                    fn=(lambda p=plane, k=key: p.residency_stats[k]),
+                )
             return True
         shards = getattr(owner, "shards", None)
         if shards:
@@ -178,6 +210,48 @@ class Metrics(Extension):
                             s.plane.flush_stats[k] for s in o.shards
                         )
                     ),
+                )
+            reg.gauge(
+                "hocuspocus_tpu_plane_slots_free",
+                "Arena rows on the free lists, summed over shards",
+                fn=(lambda o=owner: sum(len(s.plane.free) for s in o.shards)),
+            )
+            reg.gauge(
+                "hocuspocus_tpu_plane_slots_live",
+                "Arena rows bound to live docs, summed over shards",
+                fn=(
+                    lambda o=owner: sum(
+                        int(s.plane.slot_live.sum()) for s in o.shards
+                    )
+                ),
+            )
+            reg.gauge(
+                "hocuspocus_tpu_plane_slots_retired",
+                "Arena rows held by retired docs, summed over shards",
+                fn=(
+                    lambda o=owner: sum(
+                        s.plane.num_docs
+                        - len(s.plane.free)
+                        - int(s.plane.slot_live.sum())
+                        for s in o.shards
+                    )
+                ),
+            )
+            # depth/population stats sum; latency quantiles report the
+            # worst shard, like the flush stage times above
+            for key in getattr(shards[0].plane, "residency_stats", {}):
+                if key.endswith("_ms"):
+                    fn = lambda o=owner, k=key: max(
+                        s.plane.residency_stats[k] for s in o.shards
+                    )
+                else:
+                    fn = lambda o=owner, k=key: sum(
+                        s.plane.residency_stats[k] for s in o.shards
+                    )
+                reg.gauge(
+                    f"hocuspocus_tpu_plane_residency_{key}",
+                    f"TPU plane residency stat: {key} (over shards)",
+                    fn=fn,
                 )
             return True
         return False

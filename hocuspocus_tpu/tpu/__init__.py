@@ -24,6 +24,7 @@ _LAZY = {
     "TpuMergeExtension": ("merge_plane", "TpuMergeExtension"),
     "ShardedTpuMergeExtension": ("sharded_extension", "ShardedTpuMergeExtension"),
     "PlaneSupervisor": ("supervisor", "PlaneSupervisor"),
+    "ResidencyManager": ("residency", "ResidencyManager"),
     "SupervisedTpuMergeExtension": ("supervisor", "SupervisedTpuMergeExtension"),
     "CircuitBreaker": ("supervisor", "CircuitBreaker"),
 }

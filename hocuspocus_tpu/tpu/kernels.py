@@ -276,6 +276,75 @@ def integrate_op_slots_sparse(
     return state, count
 
 
+# -- on-device compaction (tombstone GC) --------------------------------------
+#
+# The arena is append-only: tombstoned units keep their slots forever, so
+# a long-lived churny doc exhausts cumulative capacity no matter its live
+# size — the row then overflows and the doc falls off the plane. The
+# compact kernel is the device-side GC: rewrite a row so its LIVE units
+# occupy slots 0..L-1 in document (rank) order, with dense ranks and
+# predecessor-chained origin ranks — exactly the layout integrating a
+# freshly-lowered snapshot of the live text would produce. Tombstone ids
+# are dropped from the device; the host (tpu/residency.py) keeps a
+# remap so future ops whose origins reference removed ids re-anchor to
+# the nearest live neighbor (the same information loss yjs accepts once
+# tombstones are garbage-collected).
+
+
+def _compact_one(state: DocState) -> DocState:
+    """Compact a single document row (unbatched): pack live units into
+    slots 0..L-1 in rank order, clear tombstones and the overflow flag.
+
+    Ranks are dense over occupied units (0..length-1, each exactly
+    once), so the new rank of a live unit is a cumulative count of live
+    units at lower ranks — one scatter, one cumsum, one gather, one
+    scatter; no sort."""
+    n = state.id_client.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    occupied = idx < state.length
+    live = occupied & ~state.deleted
+    new_len = jnp.sum(live.astype(jnp.int32))
+    # rank-indexed live mask (ranks of unoccupied slots are _INF: the
+    # out-of-range scatter drops them), then inclusive cumsum gives
+    # each live rank its packed position
+    live_by_rank = jnp.zeros((n,), jnp.int32).at[state.rank].add(
+        live.astype(jnp.int32), mode="drop"
+    )
+    packed_of_rank = jnp.cumsum(live_by_rank) - 1
+    dst = jnp.where(
+        live, packed_of_rank[jnp.clip(state.rank, 0, n - 1)], n  # n = drop
+    )
+    in_new = idx < new_len
+    return DocState(
+        id_client=jnp.full((n,), NONE_CLIENT, jnp.uint32)
+        .at[dst]
+        .set(state.id_client, mode="drop"),
+        id_clock=jnp.zeros((n,), jnp.int32).at[dst].set(state.id_clock, mode="drop"),
+        rank=jnp.where(in_new, idx, _INF),
+        origin_rank=jnp.where(in_new, idx - 1, -1),
+        deleted=jnp.zeros((n,), bool),
+        length=new_len,
+        overflow=jnp.zeros((), bool),
+    )
+
+
+_compact_batch = jax.vmap(_compact_one)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def compact_doc_rows(state: DocState, slots: jax.Array) -> tuple[DocState, jax.Array]:
+    """Compact the B doc rows `slots` routes to (int32 (B,); num_docs =
+    padding sentinel, same gather/scatter contract as the sparse
+    integrate step). Returns (state, packed live lengths (B,)) — the
+    lengths are data-dependent on the scattered state, so fetching them
+    is the caller's completion barrier."""
+    sub = gather_doc_rows(state, slots)
+    sub = _compact_batch(sub)
+    state = scatter_doc_rows(state, sub, slots)
+    lengths, _ = jax.lax.optimization_barrier((sub.length, state.length))
+    return state, lengths
+
+
 @jax.jit
 def extract_live_mask(state: DocState) -> jax.Array:
     """(D, N) bool — live (non-tombstone) units, for host-side decoding."""

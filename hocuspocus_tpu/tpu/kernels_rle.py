@@ -315,6 +315,88 @@ def integrate_op_slots_rle_sparse(state: RleState, ops: OpBatch, slots):
     return state, count
 
 
+# -- on-device compaction (defragmentation GC) --------------------------------
+#
+# RLE entry cost grows with fragmentation: every mid-run insert or
+# delete splits a run into head+tail (and zero-length heads linger as
+# dead lanes), so a churny doc's entry count creeps toward capacity even
+# when its logical state is a handful of runs. The compact kernel is the
+# id-PRESERVING defragmenter: drop zero-length lanes and merge entries
+# that are rank-adjacent, id-consecutive, same-client and same-deleted —
+# the exact fragments splitting created. No unit rank changes and no id
+# range disappears, so origins keep resolving (range membership) and the
+# host needs no serve-log or payload rewrite at all — unlike the unit
+# arena's tombstone GC (kernels.compact_doc_rows), this one is pure
+# housekeeping.
+
+
+def _compact_one_rle(state: RleState) -> RleState:
+    r = state.run_client.shape[0]
+    idx = jnp.arange(r, dtype=jnp.int32)
+    occupied = idx < state.num_runs
+    keep = occupied & (state.run_len > 0)
+    # rank-order the kept entries (dropped lanes sort to the back)
+    order = jnp.argsort(jnp.where(keep, state.run_rank, _INF))
+    cl = state.run_client[order]
+    ck = state.run_clock[order]
+    ln = state.run_len[order]
+    rk = state.run_rank[order]
+    ok = state.run_orank[order]
+    dl = state.run_deleted[order]
+    kept = keep[order]  # a prefix of size sum(keep)
+    # an entry continues the previous one when splitting could have
+    # produced the pair: same author, consecutive clocks AND ranks,
+    # same tombstone verdict
+    prev = lambda a: jnp.concatenate([a[:1], a[:-1]])
+    merge = (
+        kept
+        & jnp.concatenate([jnp.zeros((1,), bool), kept[:-1]])
+        & (cl == prev(cl))
+        & (ck == prev(ck) + prev(ln))
+        & (rk == prev(rk) + prev(ln))
+        & (dl == prev(dl))
+    )
+    head = kept & ~merge
+    seg = jnp.cumsum(head.astype(jnp.int32)) - 1  # segment index per entry
+    num_segs = jnp.sum(head.astype(jnp.int32))
+    seg_dst = jnp.where(kept, seg, r)  # r = drop
+    seg_len = jnp.zeros((r,), jnp.int32).at[seg_dst].add(ln, mode="drop")
+    head_dst = jnp.where(head, seg, r)  # unique: one head per segment
+
+    def pack(vals, fill, dtype):
+        return jnp.full((r,), fill, dtype).at[head_dst].set(vals, mode="drop")
+
+    return RleState(
+        run_client=pack(cl, NONE_CLIENT, jnp.uint32),
+        run_clock=pack(ck, 0, jnp.int32),
+        run_len=seg_len,
+        run_rank=pack(rk, _INF, jnp.int32),
+        run_orank=pack(ok, -1, jnp.int32),
+        run_deleted=jnp.zeros((r,), bool).at[head_dst].set(dl, mode="drop"),
+        num_runs=num_segs,
+        total_units=state.total_units,  # rank space untouched
+        overflow=jnp.zeros((), bool),
+    )
+
+
+_compact_batch_rle = jax.vmap(_compact_one_rle)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def compact_doc_rows_rle(state: RleState, slots) -> tuple[RleState, jax.Array]:
+    """Defragment the B doc rows `slots` routes to (int32 (B,);
+    num_docs = padding sentinel). Returns (state, packed entry counts
+    (B,)) — data-dependent on the scattered state, the caller's
+    completion barrier."""
+    from .kernels import gather_doc_rows, scatter_doc_rows
+
+    sub = gather_doc_rows(state, slots)
+    sub = _compact_batch_rle(sub)
+    state = scatter_doc_rows(state, sub, slots)
+    counts, _ = jax.lax.optimization_barrier((sub.num_runs, state.total_units))
+    return state, counts
+
+
 # -- host-side extraction ----------------------------------------------------
 
 

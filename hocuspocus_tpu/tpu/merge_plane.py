@@ -73,6 +73,11 @@ class PlaneDoc:
     # the cold serving paths, cached under lane_cache_key
     lane_slot: Optional[int] = None
     lane_cache_key: Optional[tuple] = None
+    # residency compaction (tpu/residency.py): client -> ([starts],
+    # [(start, end, left_id, right_id)]) for id ranges the tombstone-GC
+    # kernel removed from the device — future ops whose origins land in
+    # a removed range re-anchor to the recorded live neighbor
+    origin_remap: dict = field(default_factory=dict)
 
 
 class _FlushStaging:
@@ -168,6 +173,7 @@ class MergePlane:
         self._step_lock = threading.RLock()
         self._sharded_step = None
         self._sharded_sparse_step = None
+        self._sharded_compact_step = None
         self._op_shardings = None
         self._sparse_op_shardings = None
         self._slots_sharding = None
@@ -191,14 +197,21 @@ class MergePlane:
                     f"axis ({doc_axis}) and capacity ({capacity}) a multiple of "
                     f"the unit axis ({unit_axis})"
                 )
+            from .sharding import (
+                make_sharded_compact_step,
+                make_sharded_rle_compact_step,
+            )
+
             if arena == "rle":
                 self.state = make_sharded_rle_state(mesh, num_docs, capacity)
                 self._sharded_step = make_sharded_rle_step(mesh)
                 self._sharded_sparse_step = make_sharded_rle_sparse_step(mesh)
+                self._sharded_compact_step = make_sharded_rle_compact_step(mesh)
             else:
                 self.state = make_sharded_state(mesh, num_docs, capacity)
                 self._sharded_step = make_sharded_step(mesh)
                 self._sharded_sparse_step = make_sharded_sparse_step(mesh)
+                self._sharded_compact_step = make_sharded_compact_step(mesh)
             self._op_shardings = ops_sharding(mesh)
             self._sparse_op_shardings, self._slots_sharding = sparse_ops_sharding(
                 mesh
@@ -273,6 +286,14 @@ class MergePlane:
             "docs_retired_plane_full": 0,
             "docs_retired_lane_demote": 0,
             "docs_recycled": 0,
+            # residency subsystem (tpu/residency.py): slots as a managed
+            # cache — idle docs snapshot off, cold docs re-admit through
+            # the hydration queue, pressured rows compact in place
+            "docs_evicted": 0,
+            "docs_hydrated": 0,
+            "docs_compacted": 0,
+            "hydrations_declined": 0,
+            "compactions_declined": 0,
             "sync_serves": 0,
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
@@ -301,6 +322,22 @@ class MergePlane:
             "batch_b": 0,
             "batches": 0,
             "upload_bytes": 0,
+        }
+        # residency manager seam (tpu/residency.py): set by the manager
+        # at construction. retire_doc consults it to preserve host logs
+        # through compactable retires; observability exports the stats.
+        self.residency = None
+        self.residency_stats: dict[str, float] = {
+            "evicted_docs": 0,
+            "evicted_bytes": 0,
+            "hydration_queue_depth": 0,
+            "hydration_queue_peak": 0,
+            "hydrations_inflight": 0,
+            "hydration_p50_ms": 0.0,
+            "hydration_p99_ms": 0.0,
+            "last_hydration_batch": 0,
+            "last_eviction_ms": 0.0,
+            "last_compaction_ms": 0.0,
         }
         # double-buffered staging (see _FlushStaging): allocated on the
         # first flush, alternated per batch so building batch i+1 never
@@ -353,6 +390,21 @@ class MergePlane:
         from .pallas_kernels import integrate_op_slots_sparse_fast
 
         return integrate_op_slots_sparse_fast
+
+    def _compact_step_fn(self):
+        """The compact (tombstone-GC / defragment) kernel for this
+        arena: takes (state, (B,) slot routing), returns (state,
+        per-slot packed sizes). Called by the residency manager
+        (tpu/residency.py) under the step lock."""
+        if self._sharded_compact_step is not None:
+            return self._sharded_compact_step
+        if self.arena == "rle":
+            from .pallas_kernels_rle import compact_doc_rows_rle_fast
+
+            return compact_doc_rows_rle_fast
+        from .pallas_kernels import compact_doc_rows_fast
+
+        return compact_doc_rows_fast
 
     # -- native text lane --------------------------------------------------
 
@@ -539,8 +591,11 @@ class MergePlane:
             self.validated_units[slot] = 0
             self.slot_live[slot] = False
             self.slot_gen[slot] += 1
-            self._clear_slot(slot)
             self.free.append(slot)
+        # ONE fused device rebuild for every released row (a tree doc
+        # spans many): the old per-slot _clear_slot rebuilt the whole
+        # state pytree once per sequence
+        self._clear_slots(sorted(slots))
 
     def retire_doc(self, name: str, reason: str, count: bool = True) -> None:
         """Permanently degrade a doc to the CPU path (rows stay allocated
@@ -564,8 +619,23 @@ class MergePlane:
             if count:
                 self.counters[f"docs_retired_{reason}"] += 1
         doc.lowerer.unsupported = True
-        doc.serve_log = []
-        doc.map_tombstones = []
+        # residency seam: a row-exhaustion retire keeps its host logs so
+        # the compaction path (tpu/residency.py) can rebuild the doc in
+        # place — a declined attempt calls drop_doc_logs to finish this.
+        # Judged on the STICKY first reason, not this call's: the CPU
+        # fallback re-retires with "fallback" and must not destroy the
+        # logs a capacity retire just preserved.
+        preserve = self.residency is not None and self.residency.wants_logs(
+            doc, doc.retire_reason
+        )
+        if preserve:
+            # the residency sweep visits preserved docs proactively, so
+            # an idle retired doc doesn't hold these logs until its
+            # next edit
+            self.residency.note_preserved(doc.name)
+        else:
+            doc.serve_log = []
+            doc.map_tombstones = []
         self.dirty.discard(name)
         # LOCK-FREE by documented invariant (not oversight): retires run
         # on the event loop (enqueue degrades, broadcast-timer fallback)
@@ -582,9 +652,16 @@ class MergePlane:
         # (c) unit_logs is REBOUND (not mutated): an in-flight serve
         #     holding the old list keeps a consistent snapshot.
         for slot in doc.seqs.values():
-            self.queues[slot].clear()
-            self._busy_slots.discard(slot)
-            self.unit_logs[slot] = []
+            if not preserve:
+                # preserve-mode keeps the QUEUES too: those ops are
+                # already in the serve/unit logs and the lowerer's known
+                # clocks, so dropping them here would leave the arena
+                # permanently behind the host mirrors — the compaction
+                # path drains them into the (inert, uncleared) rows
+                # before rebuilding instead
+                self.queues[slot].clear()
+                self._busy_slots.discard(slot)
+                self.unit_logs[slot] = []
             self.slot_live[slot] = False
             self.slot_gen[slot] += 1
         if doc.lane_slot is not None:
@@ -595,15 +672,67 @@ class MergePlane:
             self.slot_gen[slot] += 1
 
     def _clear_slot(self, slot: int) -> None:
-        empty = self._make_empty(1, self.capacity)
+        self._clear_slots([slot])
+
+    def _clear_slots(self, slots: "list[int]") -> None:
+        """Reset a batch of arena rows to empty in ONE state rebuild
+        (and one flush-epoch bump): `.at[slots].set` over every field
+        instead of a full pytree rebuild per slot."""
+        if not slots:
+            return
         # type(self.state): DocState or RleState, same field-wise rebuild
-        self.state = type(self.state)(
-            *(
-                field.at[slot].set(empty_field[0])
-                for field, empty_field in zip(self.state, empty)
+        if len(slots) == 1:
+            # static-index fast path (dynamic_update_slice, the shape
+            # every flush cycle already compiled) — the gather/scatter
+            # below would pay a fresh first-call compile for a hot,
+            # common case
+            empty = self._make_empty(1, self.capacity)
+            idx = slots[0]
+            self.state = type(self.state)(
+                *(
+                    field.at[idx].set(empty_field[0])
+                    for field, empty_field in zip(self.state, empty)
+                )
             )
-        )
+        else:
+            import jax.numpy as jnp
+
+            # power-of-two routing width with the num_docs drop
+            # sentinel (the sparse/compact steps' contract): release()
+            # runs on the event loop, where an unpadded width would
+            # pay a first-call scatter compile for every distinct
+            # released-slot count
+            width = 1
+            while width < len(slots):
+                width *= 2
+            empty = self._make_empty(width, self.capacity)
+            idx = jnp.asarray(
+                list(slots) + [self.num_docs] * (width - len(slots)),
+                jnp.int32,
+            )
+            self.state = type(self.state)(
+                *(
+                    field.at[idx].set(empty_field, mode="drop")
+                    for field, empty_field in zip(self.state, empty)
+                )
+            )
         self.flush_epoch += 1
+
+    def drop_doc_logs(self, name: str) -> None:
+        """Finish a log-preserving retire (see retire_doc): the
+        compaction attempt declined, so release the host memory (and
+        the retained queues) the ordinary retire would have freed."""
+        doc = self.docs.get(name)
+        if doc is None:
+            return
+        doc.serve_log = []
+        doc.map_tombstones = []
+        for slot in doc.seqs.values():
+            self.unit_logs[slot] = []
+            queue = self.queues.get(slot)
+            if queue:
+                queue.clear()
+            self._busy_slots.discard(slot)
 
     def is_supported(self, name: str) -> bool:
         doc = self.docs.get(name)
@@ -631,6 +760,8 @@ class MergePlane:
             return 0
         count = 0
         for seq_key, ops in seq_ops.items():
+            if doc.origin_remap:
+                self._remap_origins(doc, seq_key, ops)
             slot = self._alloc_seq(doc, seq_key)
             if slot is None:
                 self.retire_doc(name, "plane_full")
@@ -701,6 +832,68 @@ class MergePlane:
         if count:
             self.dirty.add(name)
         return count
+
+    def _remap_origins(self, doc: PlaneDoc, seq_key: tuple, ops: list) -> None:
+        """Re-anchor op origins that reference ids the tombstone-GC
+        compaction removed from the device (tpu/residency.py): the left
+        origin falls back to the nearest live unit that preceded the
+        removed range at compaction time, the right origin to the
+        nearest that followed — the same positional approximation yjs
+        accepts once tombstones are garbage-collected. An op whose both
+        origins dissolve into doc boundaries gets the sequence as its
+        explicit wire parent (serve-time Item.write needs one)."""
+        from bisect import bisect_right
+
+        remap = doc.origin_remap
+
+        def removed(client: int, clock: int):
+            entry = remap.get(client)
+            if entry is None:
+                return None
+            starts, rows = entry
+            i = bisect_right(starts, clock) - 1
+            if i >= 0 and rows[i][0] <= clock < rows[i][1]:
+                return rows[i]
+            return None
+
+        def resolve(client: int, clock: int, side: int):
+            """Chase the remap transitively: a recorded neighbor may
+            itself sit in a range a LATER compaction removed, so a
+            single lookup could hand back a dead id. Each hop follows
+            the same side (a left origin wants its replacement's own
+            left neighbor) and lands in a strictly newer removed range
+            — replacements were live when their row was written — so
+            the walk terminates."""
+            moved = False
+            while client != NONE_CLIENT:
+                row = removed(client, clock)
+                if row is None:
+                    break
+                moved = True
+                repl = row[side]
+                if repl is None:
+                    client, clock = NONE_CLIENT, 0
+                    break
+                client, clock = repl
+            return moved, client, clock
+
+        for op in ops:
+            if op.kind != KIND_INSERT:
+                continue
+            if op.left_client != NONE_CLIENT:
+                moved, client, clock = resolve(op.left_client, op.left_clock, 2)
+                if moved:
+                    op.left_client, op.left_clock = client, clock
+            if op.right_client != NONE_CLIENT:
+                moved, client, clock = resolve(op.right_client, op.right_clock, 3)
+                if moved:
+                    op.right_client, op.right_clock = client, clock
+            if (
+                op.left_client == NONE_CLIENT
+                and op.right_client == NONE_CLIENT
+                and op.parent is None
+            ):
+                op.parent = seq_key
 
     def pending_ops(self) -> int:
         # O(busy), not O(D): walk the nonempty-slot set, not the full
@@ -1382,18 +1575,32 @@ class MergePlane:
                 int(kcl[i]), int(kck[i]), int(kln[i]), int(krk[i]),
             )
             intervals = index.get(client)
-            pos = bisect_right(intervals, (clock0, 0x7FFFFFFF, 0)) - 1 if intervals else -1
-            if pos < 0:
+            if not intervals:
                 return None
-            iv_clock, iv_off, iv_len = intervals[pos]
-            if not (iv_clock <= clock0 and clock0 + length <= iv_clock + iv_len):
-                return None
-            base = iv_off + (clock0 - iv_clock)
-            for u in range(length):
-                clients.append(client)
-                clocks.append(clock0 + u)
-                ranks.append(rank0 + u)
-                entries.append(log[base + u] if base + u < len(log) else None)
+            # a run's payload may span SEVERAL insert records: residency
+            # compaction merges id-consecutive fragments whose payloads
+            # were logged by different ops — walk the clock range across
+            # the intervals instead of requiring a single container
+            clk = clock0
+            rnk = rank0
+            remaining = length
+            while remaining > 0:
+                pos = bisect_right(intervals, (clk, 0x7FFFFFFF, 0)) - 1
+                if pos < 0:
+                    return None
+                iv_clock, iv_off, iv_len = intervals[pos]
+                if not (iv_clock <= clk < iv_clock + iv_len):
+                    return None
+                take = min(remaining, iv_clock + iv_len - clk)
+                base = iv_off + (clk - iv_clock)
+                for u in range(take):
+                    clients.append(client)
+                    clocks.append(clk + u)
+                    ranks.append(rnk + u)
+                    entries.append(log[base + u] if base + u < len(log) else None)
+                clk += take
+                rnk += take
+                remaining -= take
         return clients, clocks, ranks, entries
 
 
@@ -1429,6 +1636,9 @@ class TpuMergeExtension(Extension):
         broadcast_interval_ms: float = 2.0,
         arena: str = "unit",
         native_lane: bool = True,
+        evict_idle_secs: float = 0.0,
+        hydrate_batch: int = 64,
+        compact_threshold: float = 0.0,
     ) -> None:
         if plane is not None and mesh is not None:
             raise ValueError(
@@ -1472,6 +1682,21 @@ class TpuMergeExtension(Extension):
 
             self.serving = PlaneServing(self.plane)
             self.serving.flush_failure_handler = self._degrade_all_served
+        # arena residency manager (tpu/residency.py): idle-doc eviction,
+        # admission-controlled hydration, on-device compaction. Opt-in
+        # (serve mode + a nonzero policy knob) so the default extension
+        # keeps its permanent-lease behavior exactly.
+        self.residency = None
+        self._residency_handle: Optional[asyncio.TimerHandle] = None
+        if serve and (evict_idle_secs > 0 or compact_threshold > 0):
+            from .residency import ResidencyManager
+
+            self.residency = ResidencyManager(
+                self,
+                evict_idle_secs=evict_idle_secs,
+                hydrate_batch=hydrate_batch,
+                compact_threshold=compact_threshold,
+            )
 
     def _spawn_tracked(self, coro) -> None:
         spawn_tracked(self._flush_tasks, coro)
@@ -1501,6 +1726,9 @@ class TpuMergeExtension(Extension):
         if self._broadcast_handle is not None:
             self._broadcast_handle.cancel()
             self._broadcast_handle = None
+        if self._residency_handle is not None:
+            self._residency_handle.cancel()
+            self._residency_handle = None
 
     async def reonboard(self, document, instance=None) -> None:
         """Fresh plane registration for a live document (supervisor hot
@@ -1512,6 +1740,8 @@ class TpuMergeExtension(Extension):
             if name in self.plane.docs:
                 self.plane.release(name)
             self._recycle_declined.discard(name)
+            if self.residency is not None:
+                self.residency.forget_doc(name)
         await self.after_load_document(
             Payload(
                 instance=instance if instance is not None else self._instance,
@@ -1552,6 +1782,7 @@ class TpuMergeExtension(Extension):
                     _logger_mod.log_error("gather warmup failed (continuing)")
 
         self._spawn_tracked(warm())
+        self._schedule_residency()
 
     def _attach_serving(self, name: str, document) -> None:
         """Hook a document into the plane's serving seams (shared by
@@ -1568,6 +1799,15 @@ class TpuMergeExtension(Extension):
 
         self._instance = data.instance
         name = data.document_name
+        if self.residency is not None:
+            self.residency.touch(name)
+            if self.residency.is_evicted(name):
+                # cold load of an evicted doc: re-enter through the
+                # admission-controlled hydration queue (a storm of cold
+                # loads must never thundering-herd the device); the doc
+                # serves from the CPU path until its batch lands
+                self.residency.request_hydration(name, data.document)
+                return
         lane_doc = None
         if self.native_lane:
             lane_doc = self.plane.register_lane(name)
@@ -1595,6 +1835,16 @@ class TpuMergeExtension(Extension):
     async def on_change(self, data: Payload) -> None:
         if self.serve and data.document_name in self._docs:
             return  # already captured synchronously in try_capture
+        if self.residency is not None:
+            self.residency.touch(data.document_name)
+            if self.residency.is_evicted(data.document_name):
+                # fresh traffic on an evicted doc: updates ride the CPU
+                # fan-out while the doc queues for hydration (the live
+                # document tail replayed at admission carries them)
+                self.residency.request_hydration(
+                    data.document_name, data.document
+                )
+                return
         if self.serve:
             # fresh traffic on a doc that degraded off the plane (e.g.
             # a device OVERFLOW retire from the health sweep — a seam
@@ -1636,6 +1886,8 @@ class TpuMergeExtension(Extension):
                     # re-pay the demote transient (degraded cross-
                     # instance flow while the rebuild lands) each time.
                     self._recycle_declined.discard(name)
+                    if self.residency is not None:
+                        self.residency.forget_doc(name)
                     return
             # A re-load is in flight. Wait for it OUTSIDE the lock: on
             # success its own eventual unload fires this hook again; on
@@ -1659,6 +1911,9 @@ class TpuMergeExtension(Extension):
             self._flush_handle.cancel()
         if self._broadcast_handle is not None:
             self._broadcast_handle.cancel()
+        if self._residency_handle is not None:
+            self._residency_handle.cancel()
+            self._residency_handle = None
         # flush the broadcast tail (LOCAL only: higher-priority
         # extensions like Redis destroy first, so their pub/sub is
         # already closed — peers heal via the join protocol and
@@ -1679,6 +1934,8 @@ class TpuMergeExtension(Extension):
         anti-entropy rates."""
         if name not in self._docs:
             return False
+        if self.residency is not None and self.residency.is_compacting(name):
+            return False  # compaction window: updates ride per-op fan-out
         doc = self.plane.docs.get(name)
         return doc is not None and not doc.retired
 
@@ -1689,6 +1946,14 @@ class TpuMergeExtension(Extension):
         name = document.name
         if not self.serve or name not in self._docs:
             return False
+        if self.residency is not None:
+            self.residency.touch(name)
+            if self.residency.is_compacting(name):
+                # an executor-side compaction is rewriting this doc's
+                # rows: enqueueing would race the serve-log rebuild.
+                # Ride the CPU fan-out (always correct); the manager's
+                # post-compaction tail replay re-syncs the plane.
+                return False
         plane = self.plane
         if not plane.is_supported(name):
             plane_doc = plane.docs.get(name)
@@ -1838,6 +2103,20 @@ class TpuMergeExtension(Extension):
             existing = plane.docs.get(name)
             if existing is None or not existing.retired:
                 return  # registration changed under us; leave it be
+            if (
+                self.residency is not None
+                and existing.retire_reason in ("capacity", "overflow")
+            ):
+                # on-device compaction first: when the doc's LIVE state
+                # fits its rows, the tombstone-GC kernel recycles it in
+                # place — no release, no snapshot re-lower, no re-upload.
+                # On failure (nothing reclaimable, or the replayed tail
+                # re-exhausted the row) fall through to the snapshot
+                # recycle below.
+                if await self.residency.compact_and_replay_locked(
+                    name, document
+                ):
+                    return
             try:
                 plane.release(name)
                 # a hot plain-text doc keeps its native lane across the
@@ -2108,6 +2387,30 @@ class TpuMergeExtension(Extension):
         self._flush_handle = asyncio.get_event_loop().call_later(
             self.flush_interval_ms / 1000, run
         )
+
+    def _schedule_residency(self) -> None:
+        """Periodic residency maintenance (eviction + proactive
+        compaction sweeps), riding its own timer like the flush and
+        broadcast cadences."""
+        if self.residency is None or self._residency_handle is not None:
+            return
+
+        def run() -> None:
+            self._residency_handle = None
+            self._spawn_tracked(self._residency_tick())
+
+        self._residency_handle = asyncio.get_event_loop().call_later(
+            self.residency.maintenance_interval, run
+        )
+
+    async def _residency_tick(self) -> None:
+        try:
+            await self.residency.run_maintenance()
+        except Exception:
+            from ..server import logger as _logger_mod
+
+            _logger_mod.log_error("residency maintenance failed (continuing)")
+        self._schedule_residency()
 
     def _schedule_broadcast(self) -> None:
         if not self.serve or self._broadcast_handle is not None:

@@ -379,3 +379,23 @@ def integrate_op_slots_sparse_fast(
     if jax.default_backend() == "tpu":
         return integrate_op_slots_sparse_pallas(state, ops, slots)
     return integrate_op_slots_sparse(state, ops, slots)
+
+
+# -- on-device compaction ------------------------------------------------------
+
+
+def compact_doc_rows_fast(state: DocState, slots) -> tuple[DocState, jax.Array]:
+    """Backend dispatcher for the compact (tombstone-GC) step, the seam
+    the plane calls through like every other kernel entry point.
+
+    Unlike the integrate hot loop — where the XLA scan re-reads the
+    whole arena from HBM once per op slot and the VMEM-resident Mosaic
+    kernel is the fix — compaction is a single-pass permutation
+    (scatter + cumsum + gather) with no K-pass HBM amplification to
+    kill, so the XLA lowering is already one read and one write of the
+    gathered rows on every backend. A handwritten Mosaic kernel would
+    buy nothing here; this wrapper exists so a future VMEM-resident
+    variant slots in without touching the plane."""
+    from .kernels import compact_doc_rows
+
+    return compact_doc_rows(state, slots)

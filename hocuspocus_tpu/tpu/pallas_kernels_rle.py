@@ -417,3 +417,17 @@ def integrate_op_slots_rle_sparse_fast(state: RleState, ops: OpBatch, slots):
     if jax.default_backend() == "tpu":
         return integrate_op_slots_rle_sparse_pallas(state, ops, slots)
     return integrate_op_slots_rle_sparse(state, ops, slots)
+
+
+# -- on-device compaction ------------------------------------------------------
+
+
+def compact_doc_rows_rle_fast(state: RleState, slots):
+    """Backend dispatcher for the RLE compact (defragment) step — the
+    single-pass sort+segment-merge permutation has no K-pass HBM
+    amplification for a Mosaic kernel to kill (see
+    pallas_kernels.compact_doc_rows_fast); the XLA lowering runs
+    everywhere."""
+    from .kernels_rle import compact_doc_rows_rle
+
+    return compact_doc_rows_rle(state, slots)

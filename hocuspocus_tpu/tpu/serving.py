@@ -166,6 +166,11 @@ class PlaneServing:
         if doc is not None:
             for slot in doc.seqs.values():
                 self._tombstone_cache.pop(slot, None)
+            if doc.lane_slot is not None:
+                # lane slots may predate root discovery (not yet in
+                # seqs): a stale entry left here would survive into the
+                # slot's next tenant's cache lookups
+                self._tombstone_cache.pop(doc.lane_slot, None)
 
     # -- health -------------------------------------------------------------
 

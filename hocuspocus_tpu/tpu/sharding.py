@@ -145,6 +145,40 @@ def make_sharded_sparse_step(mesh: Mesh):
     )
 
 
+def make_sharded_compact_step(mesh: Mesh):
+    """Jitted multi-chip compact (tombstone-GC) step: the (B,) slot
+    routing vector replicates like the sparse op batches, the
+    doc-sharded arenas stay in place, and XLA partitions the
+    gather/compact/scatter so only the shards owning routed rows do
+    work (residency compaction touches a handful of rows at a time)."""
+    from .kernels import compact_doc_rows
+
+    st_shard = state_sharding(mesh)
+    _, slot_shard = sparse_ops_sharding(mesh)
+    lengths_sharding = NamedSharding(mesh, P(None))
+    return jax.jit(
+        compact_doc_rows.__wrapped__,
+        in_shardings=(st_shard, slot_shard),
+        out_shardings=(st_shard, lengths_sharding),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_rle_compact_step(mesh: Mesh):
+    """RLE twin of make_sharded_compact_step (defragmentation)."""
+    from .kernels_rle import compact_doc_rows_rle
+
+    st_shard = rle_state_sharding(mesh)
+    _, slot_shard = sparse_ops_sharding(mesh)
+    counts_sharding = NamedSharding(mesh, P(None))
+    return jax.jit(
+        compact_doc_rows_rle.__wrapped__,
+        in_shardings=(st_shard, slot_shard),
+        out_shardings=(st_shard, counts_sharding),
+        donate_argnums=(0,),
+    )
+
+
 def make_sharded_rle_sparse_step(mesh: Mesh):
     """RLE twin of make_sharded_sparse_step."""
     from .kernels_rle import integrate_op_slots_rle_sparse

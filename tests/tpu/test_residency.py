@@ -1,0 +1,799 @@
+"""Arena residency: eviction, batched hydration, on-device compaction.
+
+The residency subsystem (docs/guides/tpu-residency.md) turns arena
+rows from a permanent lease into a managed cache. These suites pin:
+
+- kernel correctness: the unit-arena tombstone-GC compact and the RLE
+  defragmenter against numpy references (packing order, dense ranks,
+  padding sentinel, untouched rows);
+- the recycle rail: a capacity/overflow-retired doc whose live state
+  fits is compacted in place and serves CPU-equal bytes again;
+- evict -> hydrate round trips: content AND tombstone layout identical
+  to the CPU reference doc after random edit streams on both sides of
+  the eviction;
+- storm admission: a cold-doc catch-up burst completes with bounded
+  in-flight hydrations and zero lost updates (10k variant under the
+  `slow` marker);
+- the satellite regressions: one fused state rebuild per multi-slot
+  release, and lane-slot tombstone-cache cleanup in forget().
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from hocuspocus_tpu.crdt import (
+    Doc,
+    apply_update,
+    encode_state_as_update,
+    encode_state_vector,
+)
+import hocuspocus_tpu.crdt as crdt
+from hocuspocus_tpu.tpu.kernels import NONE_CLIENT, make_empty_state
+from hocuspocus_tpu.tpu.merge_plane import MergePlane
+from hocuspocus_tpu.tpu.residency import EvictedDoc, ResidencyManager
+from hocuspocus_tpu.tpu.serving import PlaneServing
+
+_INF = 0x7FFFFFFF
+
+
+# -- kernel differentials ----------------------------------------------------
+
+
+def _craft_unit_row(rng, n_units):
+    """A plausible occupied row: dense rank permutation, two authors
+    with per-author running clocks, random tombstones."""
+    rank = rng.permutation(n_units).astype(np.int32)
+    client = rng.integers(1, 3, n_units).astype(np.uint32)
+    clock = np.zeros(n_units, np.int32)
+    counters = {1: 0, 2: 0}
+    for i in range(n_units):
+        clock[i] = counters[int(client[i])]
+        counters[int(client[i])] += 1
+    deleted = rng.random(n_units) < 0.4
+    return client, clock, rank, deleted
+
+
+def _expected_compact(client, clock, rank, deleted, cap):
+    """The packed layout integrating a freshly-lowered live snapshot
+    would produce: live units in rank order at slots 0..L-1, dense
+    ranks, predecessor-chained origins, no tombstones."""
+    live_idx = np.flatnonzero(~deleted)
+    live_sorted = live_idx[np.argsort(rank[live_idx])]
+    L = len(live_sorted)
+    exp = {
+        "id_client": np.full(cap, NONE_CLIENT, np.uint32),
+        "id_clock": np.zeros(cap, np.int32),
+        "rank": np.full(cap, _INF, np.int32),
+        "origin_rank": np.full(cap, -1, np.int32),
+        "deleted": np.zeros(cap, bool),
+    }
+    exp["id_client"][:L] = client[live_sorted]
+    exp["id_clock"][:L] = clock[live_sorted]
+    exp["rank"][:L] = np.arange(L)
+    exp["origin_rank"][:L] = np.arange(L) - 1
+    return exp, L
+
+
+def test_compact_kernel_matches_cpu_reference():
+    from hocuspocus_tpu.tpu.kernels import DocState, compact_doc_rows
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    D, N = 4, 32
+    rows = {0: _craft_unit_row(rng, 20), 2: _craft_unit_row(rng, 31)}
+    fields = {
+        "id_client": np.full((D, N), NONE_CLIENT, np.uint32),
+        "id_clock": np.zeros((D, N), np.int32),
+        "rank": np.full((D, N), _INF, np.int32),
+        "origin_rank": np.full((D, N), -1, np.int32),
+        "deleted": np.zeros((D, N), bool),
+    }
+    length = np.zeros(D, np.int32)
+    overflow = np.zeros(D, bool)
+    # row 1 is an innocent bystander with content the compact must not touch
+    by_client, by_clock, by_rank, by_deleted = _craft_unit_row(rng, 9)
+    for d, (client, clock, rank, deleted) in {
+        **rows, 1: (by_client, by_clock, by_rank, by_deleted)
+    }.items():
+        n = len(client)
+        fields["id_client"][d, :n] = client
+        fields["id_clock"][d, :n] = clock
+        fields["rank"][d, :n] = rank
+        fields["deleted"][d, :n] = deleted
+        length[d] = n
+    overflow[0] = True  # the overflow flag must clear on compaction
+    before = {k: v.copy() for k, v in fields.items()}
+    state = DocState(
+        id_client=jnp.asarray(fields["id_client"]),
+        id_clock=jnp.asarray(fields["id_clock"]),
+        rank=jnp.asarray(fields["rank"]),
+        origin_rank=jnp.asarray(fields["origin_rank"]),
+        deleted=jnp.asarray(fields["deleted"]),
+        length=jnp.asarray(length),
+        overflow=jnp.asarray(overflow),
+    )
+    # pad with the out-of-range sentinel, exactly as the plane routes it
+    slots = jnp.asarray([0, 2, D, D], jnp.int32)
+    state, sizes = compact_doc_rows(state, slots)
+
+    for i, d in enumerate((0, 2)):
+        exp, L = _expected_compact(*rows[d], N)
+        assert int(sizes[i]) == L
+        assert int(np.asarray(state.length)[d]) == L
+        assert not bool(np.asarray(state.overflow)[d])
+        for name, want in exp.items():
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, name))[d], want, err_msg=f"{name}[{d}]"
+            )
+    # the unrouted row is untouched
+    for name, want in before.items():
+        np.testing.assert_array_equal(np.asarray(getattr(state, name))[1], want[1])
+    assert int(np.asarray(state.length)[1]) == 9
+
+
+def test_compact_kernel_rle_defragments():
+    from hocuspocus_tpu.tpu.kernels_rle import RleState, compact_doc_rows_rle
+
+    import jax.numpy as jnp
+
+    D, R = 3, 16
+    # entries for row 1 (rank-shuffled on purpose; the kernel sorts):
+    #  - two id-AND-rank-consecutive live fragments of client 1 -> merge
+    #  - a deleted continuation -> kept separate (tombstone verdict differs)
+    #  - a zero-length dead lane -> dropped
+    #  - client 2's run -> kept
+    entries = [
+        # (client, clock, len, rank, orank, deleted)
+        (1, 3, 2, 3, 2, False),  # fragment tail (merges into head below)
+        (1, 0, 3, 0, -1, False),  # fragment head
+        (1, 5, 1, 5, 4, True),  # deleted continuation: no merge
+        (3, 9, 0, 7, -1, False),  # dead lane: dropped
+        (2, 0, 4, 6, 5, False),
+    ]
+    fields = {
+        "run_client": np.full((D, R), NONE_CLIENT, np.uint32),
+        "run_clock": np.zeros((D, R), np.int32),
+        "run_len": np.zeros((D, R), np.int32),
+        "run_rank": np.full((D, R), _INF, np.int32),
+        "run_orank": np.full((D, R), -1, np.int32),
+        "run_deleted": np.zeros((D, R), bool),
+    }
+    for j, (cl, ck, ln, rk, ok, dl) in enumerate(entries):
+        fields["run_client"][1, j] = cl
+        fields["run_clock"][1, j] = ck
+        fields["run_len"][1, j] = ln
+        fields["run_rank"][1, j] = rk
+        fields["run_orank"][1, j] = ok
+        fields["run_deleted"][1, j] = dl
+    num_runs = np.zeros(D, np.int32)
+    num_runs[1] = len(entries)
+    total_units = np.zeros(D, np.int32)
+    total_units[1] = 10
+    state = RleState(
+        run_client=jnp.asarray(fields["run_client"]),
+        run_clock=jnp.asarray(fields["run_clock"]),
+        run_len=jnp.asarray(fields["run_len"]),
+        run_rank=jnp.asarray(fields["run_rank"]),
+        run_orank=jnp.asarray(fields["run_orank"]),
+        run_deleted=jnp.asarray(fields["run_deleted"]),
+        num_runs=jnp.asarray(num_runs),
+        total_units=jnp.asarray(total_units),
+        overflow=jnp.asarray(np.asarray([False, True, False])),
+    )
+    state, counts = compact_doc_rows_rle(state, jnp.asarray([1, D], jnp.int32))
+    assert int(counts[0]) == 3
+    assert int(np.asarray(state.num_runs)[1]) == 3
+    assert int(np.asarray(state.total_units)[1]) == 10  # rank space untouched
+    assert not bool(np.asarray(state.overflow)[1])
+    want = [
+        # (client, clock, len, rank, orank, deleted) — rank order, merged
+        (1, 0, 5, 0, -1, False),
+        (1, 5, 1, 5, 4, True),
+        (2, 0, 4, 6, 5, False),
+    ]
+    got = [
+        tuple(
+            int(np.asarray(getattr(state, f))[1, j])
+            for f in (
+                "run_client", "run_clock", "run_len", "run_rank", "run_orank"
+            )
+        )
+        + (bool(np.asarray(state.run_deleted)[1, j]),)
+        for j in range(3)
+    ]
+    assert got == want
+    # packed tail is pristine empty
+    assert int(np.asarray(state.run_len)[1, 3:].sum()) == 0
+    assert (np.asarray(state.run_client)[1, 3:] == NONE_CLIENT).all()
+
+
+# -- overflow -> compact -> recycle ------------------------------------------
+
+
+def _fingerprint(doc: Doc):
+    return (
+        doc.get_text("t").to_delta(),
+        dict(doc.get_map("m").to_json()),
+        doc.get_array("a").to_json(),
+    )
+
+
+async def test_overflow_compact_recycle_unit_arena():
+    """A churny doc retires on capacity; its live state fits, so the
+    tombstone-GC kernel recycles it in place — differential vs the CPU
+    reference doc, including post-recycle traffic."""
+    plane = MergePlane(num_docs=4, capacity=64)
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(plane=plane, serving=serving, compact_threshold=0.75)
+
+    ref = Doc()
+    t = ref.get_text("t")
+    plane.register("churny")
+    plane.enqueue_update("churny", encode_state_as_update(ref), presync=True)
+    for _ in range(12):
+        before = encode_state_vector(ref)
+        t.insert(len(t), "abcdef")
+        t.delete(0, 5)
+        plane.enqueue_update("churny", crdt.encode_state_as_update(ref, before))
+        if plane.docs["churny"].retired:
+            break
+    doc = plane.docs["churny"]
+    assert doc.retired and doc.retire_reason == "capacity"
+    assert doc.serve_log, "capacity retire must preserve logs for compaction"
+
+    async with plane.flush_lock:
+        assert await mgr.compact_doc_locked("churny")
+    assert not plane.docs["churny"].retired
+    assert plane.counters["docs_compacted"] == 1
+
+    # live-tail replay brings the plane current; serves must be CPU-equal
+    plane.enqueue_update("churny", encode_state_as_update(ref), presync=True)
+    plane.flush()
+    serving.refresh()
+    assert plane.text("churny") == t.to_string()
+    payload = serving.encode_state_as_update("churny", ref)
+    assert payload is not None
+    rebuilt = Doc()
+    apply_update(rebuilt, payload)
+    assert rebuilt.get_text("t").to_string() == t.to_string()
+
+    # the doc keeps serving through fresh churn after the recycle
+    for i in range(3):
+        before = encode_state_vector(ref)
+        t.insert(len(t), f"+{i}x")
+        t.delete(0, 2)
+        plane.enqueue_update("churny", crdt.encode_state_as_update(ref, before))
+    plane.flush()
+    serving.refresh()
+    assert not plane.docs["churny"].retired, plane.docs["churny"].retire_reason
+    assert plane.text("churny") == t.to_string()
+    payload = serving.encode_state_as_update("churny", ref)
+    assert payload is not None, "post-compaction serve fell back to CPU"
+    again = Doc()
+    apply_update(again, payload)
+    assert again.get_text("t").to_string() == t.to_string()
+
+
+async def test_overflow_compact_recycle_rle_arena():
+    """RLE twin: fragmentation (not tombstones) exhausts entries; the
+    id-preserving defragmenter recycles the doc."""
+    plane = MergePlane(num_docs=4, capacity=48, arena="rle")
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(plane=plane, serving=serving, compact_threshold=0.75)
+    ref = Doc()
+    t = ref.get_text("t")
+    plane.register("frag")
+    plane.enqueue_update("frag", encode_state_as_update(ref), presync=True)
+    for _ in range(40):
+        before = encode_state_vector(ref)
+        t.insert(len(t), "hello")
+        t.delete(1 if len(t) > 6 else 0, 3)
+        plane.enqueue_update("frag", crdt.encode_state_as_update(ref, before))
+        if plane.docs["frag"].retired:
+            break
+    assert plane.docs["frag"].retired
+    plane.flush()
+    async with plane.flush_lock:
+        assert await mgr.compact_doc_locked("frag")
+    assert not plane.docs["frag"].retired
+    plane.enqueue_update("frag", encode_state_as_update(ref), presync=True)
+    plane.flush()
+    serving.refresh()
+    assert plane.text("frag") == t.to_string()
+    payload = serving.encode_state_as_update("frag", ref)
+    assert payload is not None
+    rebuilt = Doc()
+    apply_update(rebuilt, payload)
+    assert rebuilt.get_text("t").to_string() == t.to_string()
+
+
+async def test_compact_declines_when_live_state_has_no_headroom():
+    """A doc whose LIVE length has no headroom declines compaction:
+    the doc stays retired, the deferred log drop lands, and the
+    attempt is suppressed (no busy-loop retrying a hopeless doc)."""
+    plane = MergePlane(num_docs=4, capacity=64)
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(plane=plane, serving=serving, compact_threshold=0.75)
+    ref = Doc()
+    t = ref.get_text("t")
+    plane.register("dense")
+    plane.enqueue_update("dense", encode_state_as_update(ref), presync=True)
+    for _ in range(10):
+        before = encode_state_vector(ref)
+        t.insert(len(t), "0123456789")  # pure growth: everything live
+        plane.enqueue_update("dense", crdt.encode_state_as_update(ref, before))
+        if plane.docs["dense"].retired:
+            break
+    doc = plane.docs["dense"]
+    assert doc.retired and doc.retire_reason == "capacity"
+    async with plane.flush_lock:
+        assert not await mgr.compact_doc_locked("dense")
+    assert plane.docs["dense"].retired
+    assert plane.counters["compactions_declined"] == 1
+    assert not doc.serve_log, "declined compaction must drop retained logs"
+    assert not mgr.wants_logs(doc, "capacity"), "decline is sticky"
+
+
+# -- evict -> hydrate round trips --------------------------------------------
+
+
+def _random_edits(rng, ref: Doc, steps: int) -> None:
+    words = ["alpha ", "beta ", "gamma ", "zz", "q "]
+    for step in range(steps):
+        kind = int(rng.integers(0, 5))
+        text = ref.get_text("t")
+        if kind == 0:
+            text.insert(int(rng.integers(0, len(text) + 1)),
+                        words[int(rng.integers(0, len(words)))])
+        elif kind == 1 and len(text) > 2:
+            pos = int(rng.integers(0, len(text) - 1))
+            text.delete(pos, min(int(rng.integers(1, 4)), len(text) - pos))
+        elif kind == 2:
+            ref.get_map("m").set(f"k{int(rng.integers(0, 3))}", int(step))
+        elif kind == 3:
+            key = f"k{int(rng.integers(0, 3))}"
+            if ref.get_map("m").get(key) is not None:
+                ref.get_map("m").delete(key)
+        else:
+            arr = ref.get_array("a")
+            if int(rng.integers(0, 3)) == 0 and len(arr) > 0:
+                arr.delete(int(rng.integers(0, len(arr))), 1)
+            else:
+                arr.insert(int(rng.integers(0, len(arr) + 1)), [int(step)])
+
+
+@pytest.mark.parametrize("arena", ["unit", "rle"])
+@pytest.mark.parametrize("seed", [3, 19])
+async def test_evict_hydrate_roundtrip_fuzz(seed, arena):
+    """Random edits, evict, more edits on the CPU path, hydrate: the
+    re-admitted doc serves bytes that rebuild a doc with content AND
+    tombstone layout identical to the CPU reference."""
+    rng = np.random.default_rng(seed)
+    plane = MergePlane(num_docs=8, capacity=4096, arena=arena)
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(plane=plane, serving=serving, hydrate_batch=4)
+
+    ref = Doc()
+    updates = []
+    ref.on("update", lambda update, *rest: updates.append(update))
+    plane.register("roundtrip")
+    plane.enqueue_update("roundtrip", encode_state_as_update(ref), presync=True)
+
+    for cycle in range(3):
+        _random_edits(rng, ref, 25)
+        while updates:
+            plane.enqueue_update("roundtrip", updates.pop(0))
+        plane.flush()
+        serving.refresh()
+        free_before = len(plane.free)
+
+        assert await mgr.evict("roundtrip", ref)
+        assert "roundtrip" not in plane.docs
+        assert len(plane.free) > free_before, "eviction must free rows"
+        assert mgr.is_evicted("roundtrip")
+        mid_sv = encode_state_vector(ref)
+
+        # post-eviction tail rides the CPU path
+        _random_edits(rng, ref, 10)
+        updates.clear()  # the hydration live-tail replay carries these
+
+        mgr.request_hydration("roundtrip", ref)
+        for _ in range(2000):
+            if not mgr._queue and not mgr._drain_running:
+                break
+            await asyncio.sleep(0.01)
+        assert not mgr.is_evicted("roundtrip")
+        assert plane.is_supported("roundtrip"), (
+            seed, arena, cycle,
+            {k: v for k, v in plane.counters.items() if v},
+        )
+        served = serving.encode_state_as_update("roundtrip", ref)
+        assert served is not None, (seed, arena, cycle)
+        rebuilt = Doc()
+        apply_update(rebuilt, served)
+        assert _fingerprint(rebuilt) == _fingerprint(ref), (seed, arena, cycle)
+        # tombstone layout identical: same state vector, and a stale
+        # peer catching up over the eviction boundary converges
+        assert encode_state_vector(rebuilt) == encode_state_vector(ref)
+        stale = serving.encode_state_as_update("roundtrip", ref, mid_sv)
+        assert stale is not None
+        peer = Doc()
+        apply_update(peer, mgr.evicted.get("roundtrip").snapshot
+                     if mgr.is_evicted("roundtrip") else served)
+        apply_update(peer, stale)
+        assert _fingerprint(peer) == _fingerprint(ref), (seed, arena, cycle)
+
+    assert plane.counters["docs_evicted"] == 3
+    assert plane.counters["docs_hydrated"] == 3
+
+
+async def test_extension_evicts_idle_doc_and_rehydrates_on_edit():
+    """The full policy loop through a live server: an idle doc's rows
+    evict on the maintenance timer; fresh traffic re-admits it through
+    the hydration queue, with no update lost on either side."""
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+    from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+    ext = TpuMergeExtension(
+        num_docs=8, capacity=1024, flush_interval_ms=1, serve=True,
+        evict_idle_secs=0.3,
+    )
+    assert ext.residency is not None
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="sleepy")
+    b = new_provider(server, name="sleepy")
+    try:
+        await wait_synced(a, b)
+        a.document.get_text("t").insert(0, "written then idle")
+
+        def evicted():
+            assert ext.residency.is_evicted("sleepy")
+            assert "sleepy" not in ext._docs
+            assert "sleepy" not in ext.plane.docs
+
+        await retryable_assertion(evicted)
+        assert ext.plane.counters["docs_evicted"] >= 1
+
+        # fresh traffic: served via CPU immediately, re-admitted via
+        # the hydration queue shortly after
+        a.document.get_text("t").insert(0, "awake! ")
+
+        def converged_and_rehydrated():
+            assert b.document.get_text("t").to_string() == "awake! written then idle"
+            assert "sleepy" in ext._docs
+            assert ext.plane.is_supported("sleepy")
+            ext.plane.flush()
+            assert ext.plane.text("sleepy") == "awake! written then idle"
+
+        await retryable_assertion(converged_and_rehydrated)
+        assert ext.plane.counters["docs_hydrated"] >= 1
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+# -- storm admission ---------------------------------------------------------
+
+
+async def _run_admission_storm(num_docs: int, storm: int, hydrate_batch: int):
+    """Shared storm body: `storm` cold snapshots burst into the
+    hydration queue at once; every flush the drain issues must carry at
+    most `hydrate_batch` in-flight docs, and every doc must come out
+    plane-served with its exact content."""
+    plane = MergePlane(num_docs=num_docs, capacity=64)
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(
+        plane=plane, serving=serving, hydrate_batch=hydrate_batch
+    )
+    texts = {}
+    snapshots = {}
+    for i in range(storm):
+        ref = Doc()
+        ref.get_text("t").insert(0, f"doc {i:05d} payload")
+        texts[f"cold-{i}"] = ref.get_text("t").to_string()
+        snapshots[f"cold-{i}"] = encode_state_as_update(ref)
+        mgr.evicted[f"cold-{i}"] = EvictedDoc(snapshots[f"cold-{i}"], 0.0)
+
+    inflight_at_flush = []
+    orig_flush = plane.flush
+
+    def spy_flush(*args, **kwargs):
+        inflight_at_flush.append(mgr.inflight)
+        return orig_flush(*args, **kwargs)
+
+    plane.flush = spy_flush
+    for name in texts:
+        mgr.request_hydration(name)
+    assert plane.residency_stats["hydration_queue_peak"] >= storm - hydrate_batch
+
+    for _ in range(12000):
+        if not mgr._queue and not mgr._drain_running:
+            break
+        await asyncio.sleep(0.005)
+    plane.flush = orig_flush
+
+    assert inflight_at_flush, "the drain never flushed"
+    assert max(inflight_at_flush) <= hydrate_batch, "admission bound violated"
+    assert len(inflight_at_flush) >= storm // hydrate_batch
+    assert plane.counters["docs_hydrated"] == storm
+    assert plane.counters["hydrations_declined"] == 0
+    assert mgr.inflight == 0 and not mgr._queue
+    assert plane.residency_stats["hydration_p99_ms"] > 0.0
+    return plane, serving, texts, snapshots
+
+
+async def test_storm_admission_bounded_inflight():
+    plane, serving, texts, _snapshots = await _run_admission_storm(
+        num_docs=256, storm=200, hydrate_batch=32
+    )
+    serving.refresh()
+    for name, want in texts.items():
+        assert plane.is_supported(name), name
+        assert plane.text(name) == want, name
+
+
+@pytest.mark.slow
+def test_storm_admission_10k_cold_docs():
+    """BASELINE config 5 miniature: a >=10k cold-doc catch-up storm
+    completes with bounded concurrent hydrations and zero lost
+    updates (acceptance rail)."""
+
+    async def run():
+        plane, serving, texts, snapshots = await _run_admission_storm(
+            num_docs=10_240, storm=10_000, hydrate_batch=128
+        )
+        serving.refresh()
+        # zero lost updates: every doc plane-served with exact content
+        for name, want in texts.items():
+            assert plane.is_supported(name), name
+            assert plane.text(name) == want, name
+        # spot-check the serving path end to end (the CPU reference doc
+        # rebuilt from the stored snapshot, as the server would hold it)
+        for i in range(0, 10_000, 500):
+            ref = Doc()
+            apply_update(ref, snapshots[f"cold-{i}"])
+            payload = serving.encode_state_as_update(f"cold-{i}", ref)
+            assert payload is not None
+            rebuilt = Doc()
+            apply_update(rebuilt, payload)
+            assert rebuilt.get_text("t").to_string() == texts[f"cold-{i}"]
+
+    asyncio.run(asyncio.wait_for(run(), timeout=1200))
+
+
+async def test_storm_overflow_declines_without_loss():
+    """More cold docs than rows: the overflow is declined (counted),
+    never wedged, and admitted docs still serve exact content."""
+    plane = MergePlane(num_docs=4, capacity=64)
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(plane=plane, serving=serving, hydrate_batch=2)
+    texts = {}
+    for i in range(8):
+        ref = Doc()
+        ref.get_text("t").insert(0, f"burst {i}")
+        texts[f"b-{i}"] = ref.get_text("t").to_string()
+        mgr.evicted[f"b-{i}"] = EvictedDoc(encode_state_as_update(ref), 0.0)
+        mgr.request_hydration(f"b-{i}")
+    for _ in range(2000):
+        if not mgr._queue and not mgr._drain_running:
+            break
+        await asyncio.sleep(0.01)
+    assert plane.counters["docs_hydrated"] == 4
+    assert plane.counters["hydrations_declined"] == 4
+    serving.refresh()
+    admitted = [n for n in texts if plane.is_supported(n)]
+    assert len(admitted) == 4
+    for name in admitted:
+        assert plane.text(name) == texts[name]
+    # declined docs keep their snapshot: a future retry can still admit
+    assert sum(1 for n in texts if mgr.is_evicted(n)) == 4
+
+
+# -- satellite regressions ---------------------------------------------------
+
+
+def test_release_fuses_multi_slot_clears():
+    """A release spanning several sequences does ONE state rebuild
+    (one flush_epoch bump), not one per slot."""
+    plane = MergePlane(num_docs=8, capacity=256)
+    ref = Doc()
+    ref.get_text("t").insert(0, "text")
+    ref.get_array("a").insert(0, [1, 2])
+    ref.get_xml_fragment("x")  # third root
+    plane.register("wide")
+    plane.enqueue_update("wide", encode_state_as_update(ref), presync=True)
+    plane.flush()
+    doc = plane.docs["wide"]
+    assert len(set(doc.seqs.values())) >= 2, "need a multi-slot doc"
+    epoch = plane.flush_epoch
+    free_before = len(plane.free)
+    released_slots = len(set(doc.seqs.values()))
+    plane.release("wide")
+    assert plane.flush_epoch == epoch + 1
+    assert len(plane.free) == free_before + released_slots
+
+
+def test_remap_origins_chases_stacked_compactions():
+    """An origin landing in a GC'd range re-anchors to that range's
+    recorded neighbor — and when a LATER compaction removed the
+    neighbor too, the chase must follow the chain to a live id, never
+    hand the device a dead one."""
+    from hocuspocus_tpu.tpu.lowering import DenseOp
+    from hocuspocus_tpu.tpu.kernels import KIND_INSERT
+    from hocuspocus_tpu.tpu.merge_plane import PlaneDoc
+
+    plane = MergePlane(num_docs=4, capacity=256)
+    doc = PlaneDoc("chained")
+    # compaction 1 removed client 1 clocks [10, 20); left neighbor was
+    # (1, 5), right neighbor (1, 25). Compaction 2 later removed
+    # [4, 7) — swallowing that left neighbor — with its own live left
+    # neighbor (1, 2) and right neighbor (1, 25).
+    doc.origin_remap[1] = (
+        [4, 10],
+        [(4, 7, (1, 2), (1, 25)), (10, 20, (1, 5), (1, 25))],
+    )
+    op = DenseOp(
+        kind=KIND_INSERT, client=2, clock=0, run_len=1,
+        left_client=1, left_clock=12, right_client=1, right_clock=15,
+    )
+    plane._remap_origins(doc, ("root", "t"), [op])
+    assert (op.left_client, op.left_clock) == (1, 2), "one hop is not enough"
+    assert (op.right_client, op.right_clock) == (1, 25)
+
+    # both origins dissolving into boundaries -> explicit wire parent
+    doc2 = PlaneDoc("edge")
+    doc2.origin_remap[1] = ([0], [(0, 30, None, None)])
+    op2 = DenseOp(
+        kind=KIND_INSERT, client=2, clock=1, run_len=1,
+        left_client=1, left_clock=3, right_client=1, right_clock=29,
+    )
+    plane._remap_origins(doc2, ("root", "t"), [op2])
+    assert op2.left_client == NONE_CLIENT
+    assert op2.right_client == NONE_CLIENT
+    assert op2.parent == ("root", "t")
+
+
+def test_forget_drops_lane_slot_tombstone_cache():
+    """PlaneServing.forget must drop the lane slot's tombstone-cache
+    entry too — lane slots may predate root discovery and are not in
+    doc.seqs."""
+    plane = MergePlane(num_docs=4, capacity=256)
+    if not plane.enable_lane():
+        pytest.skip("native lane unavailable on this build")
+    serving = PlaneServing(plane)
+    doc = plane.register_lane("laney")
+    assert doc is not None and doc.lane_slot is not None
+    serving._tombstone_cache[doc.lane_slot] = ("stale", "entry")
+    serving.forget("laney", doc)
+    assert doc.lane_slot not in serving._tombstone_cache
+
+
+def test_residency_counters_and_occupancy_exported():
+    """The capacity-pressure surface: plane counters carry the
+    residency events and the occupancy partition is derivable from the
+    gauges' inputs (free + live + retired == num_docs)."""
+    plane = MergePlane(num_docs=8, capacity=256)
+    for key in (
+        "docs_evicted", "docs_hydrated", "docs_compacted",
+        "hydrations_declined", "compactions_declined",
+    ):
+        assert key in plane.counters
+    for key in (
+        "evicted_docs", "hydration_queue_depth", "hydration_queue_peak",
+        "hydrations_inflight", "hydration_p50_ms", "hydration_p99_ms",
+    ):
+        assert key in plane.residency_stats
+    free = len(plane.free)
+    live = int(plane.slot_live.sum())
+    assert free + live + (plane.num_docs - free - live) == plane.num_docs
+
+
+async def test_evict_declines_while_broadcast_window_pending():
+    """An update claimed for plane-batched broadcast (try_capture said
+    "no CPU fan-out") but not yet shipped must block eviction: release()
+    would discard its queue entry and dirty mark and peers would never
+    receive it. The decline is transient — once the window ships, the
+    same eviction goes through."""
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+    from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+    ext = TpuMergeExtension(
+        num_docs=8, capacity=1024, flush_interval_ms=1, serve=True,
+        evict_idle_secs=30.0,  # manager on; the timer never fires in-test
+    )
+    assert ext.residency is not None
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="windowed")
+    try:
+        await wait_synced(a)
+        a.document.get_text("t").insert(0, "claimed update")
+
+        def settled():
+            ext.plane.flush()
+            assert ext.plane.text("windowed") == "claimed update"  # arrived
+            doc = ext.plane.docs.get("windowed")
+            assert not ext.residency._has_unshipped(doc)  # and shipped
+
+        await retryable_assertion(settled)
+        document = ext._docs["windowed"]
+
+        # a claimed-but-unshipped window (what a capture landing during
+        # the snapshot's executor hop looks like when evict re-checks);
+        # stall the broadcast tick so the window genuinely stays open
+        orig_broadcast = ext._broadcast_served
+        ext._broadcast_served = lambda *a, **k: None
+        ext.plane.dirty.add("windowed")
+        assert not await ext.residency.evict("windowed", document)
+        assert "windowed" in ext._docs and "windowed" in ext.plane.docs
+        assert not ext.residency.is_evicted("windowed")
+
+        ext._broadcast_served = orig_broadcast
+        ext.plane.dirty.discard("windowed")
+        assert await ext.residency.evict("windowed", document)
+        assert ext.residency.is_evicted("windowed")
+        assert "windowed" not in ext._docs
+    finally:
+        a.destroy()
+        await server.destroy()
+
+
+async def test_preserved_retired_doc_reclaimed_by_sweep():
+    """A health-sweep style retire (no recycle seam runs) preserves the
+    doc's host logs; the maintenance sweep must visit it proactively —
+    compacting it back onto the plane — instead of holding its
+    largest-possible logs until the next edit."""
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+    from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+    ext = TpuMergeExtension(
+        num_docs=8, capacity=1024, flush_interval_ms=1, serve=True,
+        compact_threshold=0.75, native_lane=False,
+    )
+    assert ext.residency is not None
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="swept")
+    try:
+        await wait_synced(a)
+        t = a.document.get_text("t")
+        t.insert(0, "abcdefgh")
+        t.delete(0, 4)  # tombstones: the compact pass has work to do
+
+        def flushed():
+            ext.plane.flush()
+            assert ext.plane.text("swept") == "efgh"  # edits landed
+            assert ext.plane.pending_ops() == 0
+            assert "swept" not in ext.plane.dirty
+
+        await retryable_assertion(flushed)
+
+        # the post-flush health sweep's seam: retire with NO recycle,
+        # then the CPU fallback — which pops the doc from
+        # extension._docs, the exact state the sweep must handle
+        ext.plane.retire_doc("swept", "overflow")
+        ext._fallback_to_cpu(ext._docs["swept"])
+        assert "swept" not in ext._docs
+        doc = ext.plane.docs["swept"]
+        assert doc.retired
+        assert doc.serve_log, "overflow retire must preserve logs"
+        assert "swept" in ext.residency._preserved
+
+        await ext.residency._visit_preserved()
+        doc = ext.plane.docs["swept"]
+        assert not doc.retired, "sweep must recycle the fitting doc"
+        assert ext.plane.is_supported("swept")
+        assert "swept" in ext._docs, "recycle must re-attach serving"
+        assert "swept" not in ext.residency._preserved
+        assert ext.plane.counters["docs_compacted"] >= 1
+
+        def serves_again():
+            ext.plane.flush()
+            assert ext.plane.text("swept") == "efgh"
+
+        await retryable_assertion(serves_again)
+    finally:
+        a.destroy()
+        await server.destroy()
