@@ -640,6 +640,10 @@ def run_bench() -> None:
     if rle is not None:
         result["extra"]["rle"] = rle
     if sparse is not None:
+        # hoist the stage-latency trajectory to its own extra key (the
+        # per-stage p50/p99 from the e2e lifecycle histograms)
+        if isinstance(sparse, dict) and sparse.get("update_e2e"):
+            result["extra"]["update_e2e"] = sparse.pop("update_e2e")
         result["extra"]["sparse_load"] = sparse
     if storm is not None:
         result["extra"]["catchup_storm"] = storm
@@ -787,6 +791,57 @@ def _measure_sparse_load() -> dict:
         lat.append(_time.perf_counter() - t0)
         stats.append(dict(plane.flush_stats))
     lat_ms = _np.array(lat) * 1000
+    # snapshot the flush-engine counters NOW: the traced pass below runs
+    # extra cycles on the same plane, and the reported batch/staging
+    # tallies must cover exactly the measured untraced loop
+    flush_counters = {
+        key: plane.counters[key]
+        for key in (
+            "flush_batches_sparse", "flush_batches_dense",
+            "flush_staging_allocs", "flush_staging_reuses",
+        )
+    }
+
+    # traced pass: the same shape with update-lifecycle tracing on,
+    # feeding the per-stage e2e histograms — BENCH_*.json captures a
+    # latency trajectory (extra.update_e2e), not just throughput
+    from hocuspocus_tpu.observability.metrics import Histogram
+    from hocuspocus_tpu.observability.tracing import Tracer
+
+    book = plane.update_traces
+    book.tracer = Tracer(enabled=True, max_spans=256)
+    book.histogram = Histogram(
+        "bench_update_e2e",
+        "",
+        buckets=(
+            0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+        ),
+    )
+    for _ in range(max(cycles // 2, 4)):
+        subset = rng.choice(num_docs, size=busy, replace=False)
+        # deliberately NOT added to `total`: merges_per_sec divides
+        # `total` by the untraced loop's latencies only
+        enqueue_round(subset)
+        for s in subset[:64]:  # bounded stamps per cycle
+            plane.note_trace(f"sparse-{s}")
+        plane.flush()
+        book.finish_all()  # no serving here: broadcast closes immediately
+    update_e2e = {}
+    for stage_name in (
+        "queue_wait", "build", "upload", "device", "readback", "broadcast", "total",
+    ):
+        count = book.histogram.series_count(stage=stage_name)
+        if count:
+            update_e2e[stage_name] = {
+                "p50_ms": round(
+                    (book.histogram.quantile(0.5, stage=stage_name) or 0.0) * 1000, 3
+                ),
+                "p99_ms": round(
+                    (book.histogram.quantile(0.99, stage=stage_name) or 0.0) * 1000, 3
+                ),
+                "count": count,
+            }
 
     def stage(key):
         return round(float(_np.mean([s[key] for s in stats])), 3)
@@ -811,10 +866,11 @@ def _measure_sparse_load() -> dict:
         ),
         "batch_b": int(stats[-1]["batch_b"]),
         "batch_k": int(stats[-1]["batch_k"]),
-        "sparse_batches": plane.counters["flush_batches_sparse"],
-        "dense_batches": plane.counters["flush_batches_dense"],
-        "staging_allocs": plane.counters["flush_staging_allocs"],
-        "staging_reuses": plane.counters["flush_staging_reuses"],
+        "sparse_batches": flush_counters["flush_batches_sparse"],
+        "dense_batches": flush_counters["flush_batches_dense"],
+        "staging_allocs": flush_counters["flush_staging_allocs"],
+        "staging_reuses": flush_counters["flush_staging_reuses"],
+        "update_e2e": update_e2e,
     }
 
 

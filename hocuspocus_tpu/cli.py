@@ -140,6 +140,47 @@ def build_parser() -> argparse.ArgumentParser:
         "breaker, draining served docs to the CPU path until a recovery "
         "probe passes (default 3; see docs/guides/tpu-supervisor.md)",
     )
+    # observability (docs/guides/observability.md): Prometheus /metrics,
+    # end-to-end update lifecycle tracing with Perfetto export
+    # (/debug/trace), on-demand device profiles (/debug/profile) and the
+    # per-doc flight recorder (/debug/docs).
+    parser.add_argument(
+        "--metrics",
+        action="store_true",
+        help="serve Prometheus metrics at /metrics plus the /debug "
+        "endpoints (trace export, profiler capture, per-doc flight "
+        "recorder); implied by --trace",
+    )
+    parser.add_argument(
+        "--trace",
+        action="store_true",
+        help="enable end-to-end update lifecycle tracing: stage spans "
+        "(queue-wait/build/upload/device/readback/broadcast) share one "
+        "trace id per sampled update, exported as Chrome/Perfetto JSON "
+        "at /debug/trace and as hocuspocus_tpu_update_e2e_seconds{stage=} "
+        "histograms on /metrics",
+    )
+    parser.add_argument(
+        "--trace-max-spans",
+        type=int,
+        default=4096,
+        help="span ring capacity (oldest spans drop first), default 4096",
+    )
+    parser.add_argument(
+        "--trace-slow-ms",
+        type=float,
+        default=0.0,
+        help="promote spans at/above this duration to structured log "
+        "lines and the hocuspocus_tpu_slow_spans_total{site=} counter — "
+        "survives ring wrap (0 disables, the default)",
+    )
+    parser.add_argument(
+        "--trace-sample",
+        type=int,
+        default=1,
+        help="trace 1 in N captured updates (default 1 = every update); "
+        "raise under load so tracing stays viable at 100k docs",
+    )
     return parser
 
 
@@ -148,6 +189,18 @@ async def run(args: argparse.Namespace) -> None:
     from .server import Configuration, Server
 
     extensions: list = [Logger()]
+    if args.trace:
+        from .observability import enable_tracing
+
+        tracer = enable_tracing(max_spans=args.trace_max_spans)
+        tracer.slow_ms = args.trace_slow_ms if args.trace_slow_ms > 0 else None
+        tracer.sample = max(args.trace_sample, 1)
+    if args.metrics or args.trace:
+        # /metrics + /debug/{trace,profile,docs}: tracing without the
+        # exporter would be write-only, so --trace implies it
+        from .observability import Metrics
+
+        extensions.append(Metrics())
     if args.sqlite is not None:
         extensions.append(SQLite(database=args.sqlite))
     if args.s3:

@@ -1,21 +1,32 @@
-"""Observability: span tracing, metrics registry, Prometheus endpoint.
+"""Observability: span tracing, metrics registry, Prometheus endpoint,
+per-doc flight recorder and the update-lifecycle trace pipeline.
 
 New capability beyond the reference (SURVEY.md §5.1/§5.5 record that the
 reference ships no tracing and no metrics exporter).
 """
 
 from .extension import Metrics
+from .flight_recorder import FlightRecorder, get_flight_recorder
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
-from .tracing import Tracer, disable_tracing, enable_tracing, get_tracer
+from .tracing import (
+    Tracer,
+    UpdateTraceBook,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+)
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Metrics",
     "MetricsRegistry",
     "Tracer",
+    "UpdateTraceBook",
     "disable_tracing",
     "enable_tracing",
+    "get_flight_recorder",
     "get_tracer",
 ]

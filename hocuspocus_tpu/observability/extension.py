@@ -15,10 +15,12 @@ instance at scrape time.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Optional
 
 from ..server.types import Extension, Payload
+from .flight_recorder import get_flight_recorder
 from .metrics import MetricsRegistry
 from .tracing import get_tracer
 
@@ -32,11 +34,17 @@ class Metrics(Extension):
         registry: Optional[MetricsRegistry] = None,
         path: str = "/metrics",
         expose_tracer: bool = False,
+        debug_endpoints: bool = True,
     ) -> None:
         self.registry = registry or MetricsRegistry()
         self.path = path
         self.expose_tracer = expose_tracer
+        # /debug/trace (Perfetto JSON), /debug/profile (on-demand jax
+        # profiler capture), /debug/docs[/<name>] (flight recorder)
+        self.debug_endpoints = debug_endpoints
         self._instance = None
+        self._plane_owner = None  # extension owning plane(s), for /debug/docs
+        self._slow_span_cb = None
 
         reg = self.registry
         self.connects = reg.counter(
@@ -72,12 +80,30 @@ class Metrics(Extension):
         self.store_seconds = reg.histogram(
             "hocuspocus_document_store_seconds", "onStoreDocument → afterStoreDocument"
         )
+        # update-lifecycle stage latencies (docs/guides/observability.md):
+        # one series per pipeline stage — queue_wait/build/upload/device/
+        # readback/broadcast plus the contiguous total — fed by the
+        # plane's UpdateTraceBook for every sampled traced update
+        self.update_e2e = reg.histogram(
+            "hocuspocus_tpu_update_e2e_seconds",
+            "End-to-end update lifecycle latency by pipeline stage",
+        )
+        self.slow_spans = reg.counter(
+            "hocuspocus_tpu_slow_spans_total",
+            "Spans promoted past the --trace-slow-ms threshold, by site",
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
     async def on_configure(self, data: Payload) -> None:
         instance = data.instance
         self._instance = instance
+        # slow-span promotion feeds the labelled counter even when the
+        # span ring has wrapped (tracing.Tracer._promote_slow fires at
+        # finish time, not export time)
+        if self._slow_span_cb is None:
+            self._slow_span_cb = lambda sp: self.slow_spans.inc(site=sp.name)
+            get_tracer().on_slow.append(self._slow_span_cb)
         self.registry.gauge(
             "hocuspocus_documents",
             "Documents currently in memory",
@@ -112,6 +138,8 @@ class Metrics(Extension):
         plane = getattr(owner, "plane", None)
         counters = getattr(plane, "counters", None)
         if isinstance(counters, dict):
+            self._plane_owner = owner
+            self._bind_trace_book(plane)
             for key in counters:
                 # keys like "plane_broadcasts" already carry the prefix
                 metric = f"hocuspocus_tpu_plane_{key.removeprefix('plane_')}"
@@ -176,6 +204,9 @@ class Metrics(Extension):
             return True
         shards = getattr(owner, "shards", None)
         if shards:
+            self._plane_owner = owner
+            for shard in shards:
+                self._bind_trace_book(shard.plane)
             for key in shards[0].plane.counters:
                 metric = f"hocuspocus_tpu_plane_{key.removeprefix('plane_')}"
                 reg.gauge(
@@ -255,6 +286,20 @@ class Metrics(Extension):
                 )
             return True
         return False
+
+    def _bind_trace_book(self, plane) -> None:
+        """Point the plane's update-lifecycle trace book at the labelled
+        e2e histogram, and route slow-flush promotions into the per-doc
+        flight recorder."""
+        book = getattr(plane, "update_traces", None)
+        if book is None:
+            return
+        book.histogram = self.update_e2e
+        if book.on_slow_flush is None:
+            recorder = get_flight_recorder()
+            book.on_slow_flush = lambda name, ms: recorder.record(
+                name, "slow_flush", e2e_ms=round(ms, 3)
+            )
 
     def _bind_supervisor_metrics(self, supervisor) -> None:
         """Plane supervisor surface (tpu/supervisor.py): state, breaker,
@@ -350,36 +395,141 @@ class Metrics(Extension):
     async def on_stateless(self, data: Payload) -> None:
         self.stateless.inc()
 
-    # -- scrape endpoint ---------------------------------------------------
+    async def on_destroy(self, data: Payload) -> None:
+        # unbind the global-tracer callback so test servers (one Metrics
+        # instance each) don't accumulate dead counters on the tracer
+        if self._slow_span_cb is not None:
+            try:
+                get_tracer().on_slow.remove(self._slow_span_cb)
+            except ValueError:
+                pass
+            self._slow_span_cb = None
+
+    # -- scrape + debug endpoints ------------------------------------------
 
     async def on_request(self, data: Payload) -> None:
         request = data.request
         path = getattr(getattr(request, "rel_url", None), "path", None) or getattr(
             request, "path", ""
         )
-        if path != self.path:
-            self.http_requests.inc()
-            return
-        body = self.registry.expose()
-        if self.expose_tracer:
-            import json
+        if path == self.path:
+            body = self.registry.expose()
+            if self.expose_tracer:
+                import json
 
-            spans = get_tracer().export()
-            body += "\n# tracer\n" + "\n".join(
-                "# " + json.dumps(span) for span in spans[-100:]
-            ) + "\n"
+                spans = get_tracer().export()
+                body += "\n# tracer\n" + "\n".join(
+                    "# " + json.dumps(span) for span in spans[-100:]
+                ) + "\n"
+            from aiohttp import web
+
+            data.response = web.Response(
+                text=body, content_type="text/plain", charset="utf-8"
+            )
+            # Raising aborts the rest of the hook chain and the default
+            # "Welcome" response; the server serves `data.response` instead
+            # (same mechanism as reference request interception,
+            # `packages/server/src/Server.ts:114-137`).
+            error = _ServeMetrics()
+            error.response = data.response
+            raise error
+        if self.debug_endpoints:
+            if path == "/debug/trace":
+                self._serve_json(data, get_tracer().export_chrome_trace())
+            if path == "/debug/docs":
+                self._serve_json(data, self._docs_overview())
+            if path.startswith("/debug/docs/"):
+                from urllib.parse import unquote
+
+                name = unquote(path[len("/debug/docs/") :])
+                self._serve_json(
+                    data,
+                    {"doc": name, "events": get_flight_recorder().events(name)},
+                )
+            if path == "/debug/profile":
+                self._serve_json(data, await self._run_profile(request))
+        self.http_requests.inc()
+
+    def _serve_json(self, data: Payload, payload: dict) -> None:
+        import json
+
         from aiohttp import web
 
         data.response = web.Response(
-            text=body, content_type="text/plain", charset="utf-8"
+            text=json.dumps(payload), content_type="application/json"
         )
-        # Raising aborts the rest of the hook chain and the default
-        # "Welcome" response; the server serves `data.response` instead
-        # (same mechanism as reference request interception,
-        # `packages/server/src/Server.ts:114-137`).
         error = _ServeMetrics()
         error.response = data.response
         raise error
+
+    async def _run_profile(self, request) -> dict:
+        """On-demand `jax.profiler` capture: `GET /debug/profile?secs=N`
+        traces the device for N seconds and returns the artifact
+        directory (open it with TensorBoard's profile plugin or convert
+        with xprof). Device spans (`Tracer.device_span`) annotate the
+        capture via jax.profiler.TraceAnnotation."""
+        query = getattr(getattr(request, "rel_url", None), "query", None)
+        if query is None:
+            query = getattr(request, "query", None) or {}
+        try:
+            secs = float(query.get("secs", 3.0))
+        except (TypeError, ValueError):
+            secs = 3.0
+        secs = min(max(secs, 0.1), 60.0)
+        try:
+            import jax
+        except Exception as error:
+            return {"error": f"jax unavailable: {error!r}"}
+        import tempfile
+
+        artifact = tempfile.mkdtemp(prefix="hocuspocus-tpu-profile-")
+        try:
+            jax.profiler.start_trace(artifact)
+        except Exception as error:
+            return {"error": f"profiler start failed: {error!r}"}
+        try:
+            await asyncio.sleep(secs)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        return {"artifact": artifact, "seconds": secs}
+
+    def _planes(self) -> list:
+        owner = self._plane_owner
+        if owner is None:
+            return []
+        plane = getattr(owner, "plane", None)
+        if plane is not None and hasattr(plane, "_busy_slots"):
+            return [plane]
+        shards = getattr(owner, "shards", None)
+        if shards:
+            return [shard.plane for shard in shards]
+        return []
+
+    def _docs_overview(self, top_k: int = 20) -> dict:
+        """`/debug/docs`: top-K busiest docs (driven by the planes' busy
+        slot sets + queue depths) and the flight recorder's
+        recently-eventful docs."""
+        rows: dict[str, dict] = {}
+        for plane in self._planes():
+            for slot in list(plane._busy_slots):
+                name = plane.slot_owner.get(slot)
+                if name is None:
+                    continue
+                row = rows.setdefault(
+                    name, {"doc": name, "busy_slots": 0, "queued_ops": 0}
+                )
+                row["busy_slots"] += 1
+                row["queued_ops"] += len(plane.queues.get(slot) or ())
+        busiest = sorted(
+            rows.values(), key=lambda row: -row["queued_ops"]
+        )[:top_k]
+        return {
+            "busiest": busiest,
+            "docs": get_flight_recorder().docs()[: max(top_k, 50)],
+        }
 
 
 class _ServeMetrics(Exception):

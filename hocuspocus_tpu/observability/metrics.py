@@ -14,6 +14,7 @@ float adds (no locks needed under the GIL).
 from __future__ import annotations
 
 import math
+from bisect import bisect_left
 from typing import Callable, Iterable, Optional, Sequence
 
 
@@ -35,9 +36,23 @@ def _fmt_labels(labels: dict[str, str]) -> str:
 def _fmt_value(value: float) -> str:
     if value == math.inf:
         return "+Inf"
-    if float(value).is_integer():
+    if value == -math.inf:
+        return "-Inf"
+    value = float(value)
+    if value != value:
+        return "NaN"
+    if value.is_integer() and abs(value) < 1e17:
         return str(int(value))
-    return repr(float(value))
+    # shortest round-trip decimal: the smallest %g precision whose
+    # output parses back to the same double (repr-style, but without
+    # repr's exponent/format quirks leaking into the exposition —
+    # float32-ish inputs like 0.30000000000000004 keep every digit they
+    # genuinely need and nothing more)
+    for precision in range(1, 18):
+        text = format(value, f".{precision}g")
+        if float(text) == value:
+            return text
+    return format(value, ".17g")
 
 
 class Counter:
@@ -103,7 +118,12 @@ DEFAULT_BUCKETS = (
 
 
 class Histogram:
-    """Fixed-bucket histogram (seconds by convention, like Prometheus)."""
+    """Fixed-bucket histogram (seconds by convention, like Prometheus),
+    optionally labelled: `observe(value, stage="build")` keeps one
+    bucket series per label set, exposed with the labels merged into
+    each `_bucket`/`_sum`/`_count` sample. Bucket lookup is a `bisect`
+    over the sorted bounds — this sits on the per-update hot path once
+    the e2e lifecycle histograms are wired in."""
 
     def __init__(
         self, name: str, help: str, buckets: Sequence[float] = DEFAULT_BUCKETS
@@ -111,38 +131,80 @@ class Histogram:
         self.name = name
         self.help = help
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
-        self._sum = 0.0
-        self._total = 0
+        # labels key -> [bucket counts (+1 for +Inf), sum, total]
+        self._series: dict[tuple, list] = {}
 
-    def observe(self, value: float) -> None:
-        self._sum += value
-        self._total += 1
-        for i, bound in enumerate(self.buckets):
-            if value <= bound:
-                self._counts[i] += 1
-                return
-        self._counts[-1] += 1
+    def _series_for(self, labels: dict) -> list:
+        key = tuple(sorted(labels.items()))
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = [
+                [0] * (len(self.buckets) + 1),
+                0.0,
+                0,
+            ]
+        return series
+
+    def observe(self, value: float, **labels: str) -> None:
+        series = self._series_for(labels)
+        # first bucket whose bound >= value (le semantics); past the
+        # end = the +Inf bucket
+        series[0][bisect_left(self.buckets, value)] += 1
+        series[1] += value
+        series[2] += 1
 
     @property
     def count(self) -> int:
-        return self._total
+        return sum(series[2] for series in self._series.values())
 
     @property
     def sum(self) -> float:
-        return self._sum
+        return sum(series[1] for series in self._series.values())
+
+    def series_count(self, **labels: str) -> int:
+        series = self._series.get(tuple(sorted(labels.items())))
+        return 0 if series is None else series[2]
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Estimated q-quantile for one label set (linear interpolation
+        within the landing bucket, like PromQL's histogram_quantile).
+        None when the series has no observations."""
+        series = self._series.get(tuple(sorted(labels.items())))
+        if series is None or series[2] == 0:
+            return None
+        target = q * series[2]
+        cumulative = 0
+        for i, bound in enumerate(self.buckets):
+            prev = cumulative
+            cumulative += series[0][i]
+            if cumulative >= target:
+                lower = self.buckets[i - 1] if i > 0 else 0.0
+                in_bucket = series[0][i]
+                frac = (target - prev) / in_bucket if in_bucket else 0.0
+                return lower + (bound - lower) * frac
+        return self.buckets[-1] if self.buckets else None
 
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        cumulative = 0
-        for bound, count in zip(self.buckets, self._counts):
-            cumulative += count
-            yield f'{self.name}_bucket{{le="{_fmt_value(bound)}"}} {cumulative}'
-        cumulative += self._counts[-1]
-        yield f'{self.name}_bucket{{le="+Inf"}} {cumulative}'
-        yield f"{self.name}_sum {_fmt_value(self._sum)}"
-        yield f"{self.name}_count {self._total}"
+        series = self._series or {(): [[0] * (len(self.buckets) + 1), 0.0, 0]}
+        for key in sorted(series):
+            counts, total_sum, total = series[key]
+            labels = dict(key)
+            cumulative = 0
+            for bound, count in zip(self.buckets, counts):
+                cumulative += count
+                yield (
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**labels, 'le': _fmt_value(bound)})} {cumulative}"
+                )
+            cumulative += counts[-1]
+            yield (
+                f"{self.name}_bucket"
+                f"{_fmt_labels({**labels, 'le': '+Inf'})} {cumulative}"
+            )
+            yield f"{self.name}_sum{_fmt_labels(labels)} {_fmt_value(total_sum)}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {total}"
 
 
 class MetricsRegistry:

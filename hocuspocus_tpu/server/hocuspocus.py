@@ -14,6 +14,7 @@ from typing import Any, Callable, Optional
 
 from .. import __version__
 from ..crdt import Doc, apply_update, encode_state_as_update
+from ..observability.flight_recorder import get_flight_recorder
 from ..observability.tracing import get_tracer
 from ..protocol.awareness import awareness_states_to_array
 from ..protocol.close_events import RESET_CONNECTION
@@ -368,6 +369,7 @@ class Hocuspocus:
 
         document.is_loading = False
         await self.hooks("after_load_document", hook_payload)
+        get_flight_recorder().record(document_name, "load")
 
         def on_update(document: Document, origin: Any, update: bytes) -> None:
             request = getattr(origin, "request", None)
@@ -426,6 +428,7 @@ class Hocuspocus:
             return
         self.documents.pop(document_name, None)
         document.destroy()
+        get_flight_recorder().record(document_name, "unload")
         await self.hooks(
             "after_unload_document", Payload(instance=self, document_name=document_name)
         )

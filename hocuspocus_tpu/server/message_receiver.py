@@ -196,11 +196,18 @@ class MessageReceiver:
                     build_sync_status_frame(document.name, False)
                 )
                 return sync_type
-            read_update(
-                message.decoder,
-                document,
-                connection if connection is not None else self.default_transaction_origin,
+            origin = (
+                connection if connection is not None else self.default_transaction_origin
             )
+            tracer = get_tracer()
+            if tracer.enabled:
+                # the CPU-side apply that precedes the capture seam: a
+                # lifecycle trace's host prologue is visible next to its
+                # update.* stage spans in /debug/trace
+                with tracer.span("message.update_apply", document=document.name):
+                    read_update(message.decoder, document, origin)
+            else:
+                read_update(message.decoder, document, origin)
             if connection is not None:
                 connection.send(
                     build_sync_status_frame(document.name, True)

@@ -26,6 +26,8 @@ from typing import Optional
 import numpy as np
 
 from ..aio import spawn_tracked
+from ..observability.flight_recorder import get_flight_recorder
+from ..observability.tracing import UpdateTraceBook, get_tracer
 from ..server.types import Extension, Payload
 from .kernels import (
     KIND_DELETE,
@@ -357,6 +359,12 @@ class MergePlane:
         self._lane = None
         self._lane_codec = None
         self._lane_banned: set[str] = set()
+        # update-lifecycle trace pipeline (observability/tracing.py):
+        # the capture seam stamps sampled updates here; the flush loop
+        # below carries their trace ids through drain → build → upload
+        # → device → readback, and the broadcast pass closes them. One
+        # truth test per flush batch when tracing is idle.
+        self.update_traces = UpdateTraceBook()
 
     # -- arena dispatch ----------------------------------------------------
 
@@ -565,11 +573,17 @@ class MergePlane:
         self.slot_gen[slot] += 1
         return slot
 
+    def note_trace(self, name: str) -> Optional[int]:
+        """Capture-seam stamp: give one just-enqueued update a lifecycle
+        trace id (sampled). Called by try_capture and the benches."""
+        return self.update_traces.stamp(name)
+
     def release(self, name: str) -> None:
         doc = self.docs.pop(name, None)
         if doc is None:
             return
         self.dirty.discard(name)
+        self.update_traces.drop(name)
         # Serialization: release() only runs from unload paths that hold
         # the extension's flush_lock (see TpuMergeExtension._flush_now
         # docstring), so no executor-side flush is in flight here —
@@ -618,6 +632,8 @@ class MergePlane:
             # never miss a degradation class added later
             if count:
                 self.counters[f"docs_retired_{reason}"] += 1
+            get_flight_recorder().record(name, "retire", reason=reason)
+        self.update_traces.drop(name)
         doc.lowerer.unsupported = True
         # residency seam: a row-exhaustion retire keeps its host logs so
         # the compaction path (tpu/residency.py) can rebuild the doc in
@@ -1054,9 +1070,9 @@ class MergePlane:
         return self._upload_sparse_batch(fields, slots)
 
     def _flush_locked(self, max_batches: Optional[int] = None) -> int:
-        from ..observability.tracing import get_tracer
-
         tracer = get_tracer()
+        book = self.update_traces
+        trace_batches: list = []
         k_max = self._k_buckets()[-1]
         total = 0
         batches = 0
@@ -1068,6 +1084,13 @@ class MergePlane:
             drained = self._drain_ops(k_max)
             if drained is None:
                 break
+            cycle_traces = None
+            if book.active():
+                # stamped updates whose slots drained this batch enter
+                # the in-flight set; t0 closes their queue-wait stage
+                cycle_traces = book.take_drained(
+                    (self.slot_owner.get(int(s)) for s in drained[4]), t0
+                )
             built, depth = drained[5], drained[6]
             # sparse batches pin K to the top bucket (one compiled
             # program per B bucket — see warmup_shapes); dense batches
@@ -1118,23 +1141,31 @@ class MergePlane:
                     span.set("integrated", built)
             else:
                 self.state, _count = step(self.state, *step_args)
+            t_dispatch = time.perf_counter()
+            if cycle_traces:
+                trace_batches.append((cycle_traces, t1, t2, t_dispatch))
             total += built
             batches += 1
             build_ms += (t1 - t0) * 1000.0
             upload_ms += (t2 - t1) * 1000.0
             # ~0 where dispatch is truly asynchronous; on synchronous
             # backends this is the device compute the cycle pays inline
-            dispatch_ms += (time.perf_counter() - t2) * 1000.0
+            dispatch_ms += (t_dispatch - t2) * 1000.0
             upload_bytes += staging.nbytes(k, b, slot_view is not None)
             k_last, b_last, busy_last = k, b, b_actual
         if batches:
             t3 = time.perf_counter()
             self._sync_health()
+            t_sync = time.perf_counter()
+            if trace_batches:
+                # the cycle's single readback barrier closes every
+                # in-flight trace's device/readback stages
+                book.complete_cycle(trace_batches, t_sync)
             self.flush_stats.update(
                 build_ms=round(build_ms, 3),
                 upload_ms=round(upload_ms, 3),
                 dispatch_ms=round(dispatch_ms, 3),
-                device_sync_ms=round((time.perf_counter() - t3) * 1000.0, 3),
+                device_sync_ms=round((t_sync - t3) * 1000.0, 3),
                 busy_slots=busy_last,
                 busy_fraction=round(busy_last / max(self.num_docs, 1), 6),
                 batch_k=k_last,
@@ -1715,6 +1746,9 @@ class TpuMergeExtension(Extension):
     def degrade_all(self) -> None:
         """Drain every served doc to the CPU path (full-state fallback
         broadcast each) — the supervisor's breaker-open action."""
+        recorder = get_flight_recorder()
+        for name in list(self._docs):
+            recorder.record(name, "breaker_degrade")
         self._degrade_all_served()
 
     def cancel_timers(self) -> None:
@@ -1973,7 +2007,17 @@ class TpuMergeExtension(Extension):
             self._fallback_to_cpu(document)
             self._maybe_recycle(document, reason)
             return False
-        plane.enqueue_update(name, update, remote=origin == REDIS_ORIGIN)
+        # capture seam: stamp the (sampled) update with a trace id + its
+        # enqueue timestamp BEFORE queueing — an executor-side flush can
+        # drain the queue the moment the op lands, and a stamp arriving
+        # after that drain would miss its own flush cycle
+        book = plane.update_traces
+        trace_id = plane.note_trace(name) if book.enabled else None
+        accepted = plane.enqueue_update(name, update, remote=origin == REDIS_ORIGIN)
+        if trace_id is not None and not accepted:
+            # nothing queued (deduplicated, or the doc degraded during
+            # the enqueue — where retire already dropped the doc's book)
+            book.unstamp(name, trace_id)
         if not plane.is_supported(name):
             # this very update degraded the doc; it broadcasts via CPU
             plane_doc = plane.docs.get(name)
@@ -2159,6 +2203,7 @@ class TpuMergeExtension(Extension):
                     self._recycle_declined.add(name)
                     return
                 plane.counters["docs_recycled"] += 1
+                get_flight_recorder().record(name, "recycle")
                 self._attach_serving(name, document)
             except Exception:
                 # a half-recycled registration (released + re-registered
@@ -2187,6 +2232,8 @@ class TpuMergeExtension(Extension):
         self._detach_serving(name, document)
         if name in self.plane.docs:
             self.plane.retire_doc(name, "fallback")
+        self.plane.update_traces.drop(name)
+        get_flight_recorder().record(name, "degrade")
         self.plane.counters["cpu_fallbacks"] += 1
         # receivers may hold plane broadcasts only up to the last flush;
         # ship the full CPU state once (dedup makes it a cheap no-op for
@@ -2270,13 +2317,20 @@ class TpuMergeExtension(Extension):
             return
         for name in failed:
             self._degrade_one(name, docs_by_name[name])
+        book = plane.update_traces
         for name, pair in pairs:
             document = docs_by_name[name]
             try:
                 if pair is None:
+                    # empty window (e.g. presync-only records): close any
+                    # flushed traces anyway — fan-out was a no-op
+                    book.finish(name)
                     continue
                 update, cross_update = pair
                 document.broadcast_update_frame(update)
+                # broadcast completion closes the lifecycle trace: the
+                # fan-out stage span + the end-to-end observation
+                book.finish(name)
                 if (
                     cross_instance
                     and cross_update is not None

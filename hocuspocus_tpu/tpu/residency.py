@@ -58,6 +58,7 @@ from typing import Optional
 import numpy as np
 
 from ..aio import spawn_tracked
+from ..observability.flight_recorder import get_flight_recorder
 from .kernels import KIND_INSERT, NONE_CLIENT
 from .lowering import DenseOp
 from .merge_plane import LogRec, MergePlane, PlaneDoc
@@ -369,9 +370,11 @@ class ResidencyManager:
             self.last_active.pop(name, None)  # not resident: drop from the scan
             self._evicted_add(name, snapshot)
             plane.counters["docs_evicted"] += 1
-            self._publish_stats(
-                last_eviction_ms=round((time.perf_counter() - t0) * 1000.0, 3)
+            eviction_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+            get_flight_recorder().record(
+                name, "evict", ms=eviction_ms, bytes=len(snapshot)
             )
+            self._publish_stats(last_eviction_ms=eviction_ms)
         return True
 
     def _snapshot(self, name: str, document) -> Optional[bytes]:
@@ -490,6 +493,7 @@ class ResidencyManager:
                 return False  # unloading anyway; keep the snapshot
         if not plane.free:
             plane.counters["hydrations_declined"] += 1
+            get_flight_recorder().record(name, "hydrate_declined", reason="plane_full")
             return False  # no rows: the doc stays on the CPU path
         record = self._evicted_pop(name)
         if name in plane.docs:
@@ -528,6 +532,7 @@ class ResidencyManager:
         if not plane.is_supported(name):
             return False  # retired during enqueue (counted there)
         plane.counters["docs_hydrated"] += 1
+        get_flight_recorder().record(name, "hydrate")
         # re-enter the activity clock at admission: the pre-eviction
         # entry was dropped as stale, and without one the doc would be
         # invisible to the eviction scan until its next edit
@@ -737,6 +742,7 @@ class ResidencyManager:
             self.serving.forget(name, doc)
             self.serving.broadcast_cursor[name] = len(doc.serve_log)
         plane.counters["docs_compacted"] += 1
+        get_flight_recorder().record(name, "compact", live=was_live)
         if was_live and self.extension is not None:
             document = self.extension._docs.get(name)
             if document is not None:

@@ -38,6 +38,7 @@ from ..crdt.encoding import Encoder
 from ..crdt.ids import ID
 from ..crdt.structs import GC, Item
 from ..crdt.update import _write_structs, decode_state_vector
+from ..observability.tracing import get_tracer
 from .kernels import KIND_DELETE, KIND_INSERT, NONE_CLIENT
 from .lowering import DenseOp, units_to_text
 from .merge_plane import LogRec, MergePlane, PlaneDoc
@@ -668,6 +669,15 @@ class PlaneServing:
         """
         if self.paused:
             return None  # supervisor drain: serve from the CPU document
+        tracer = get_tracer()
+        if tracer.enabled:
+            with tracer.span("serving.sync_serve", document=name):
+                return self._encode_state_as_update_inner(name, document, sv_bytes)
+        return self._encode_state_as_update_inner(name, document, sv_bytes)
+
+    def _encode_state_as_update_inner(
+        self, name: str, document, sv_bytes: Optional[bytes] = None
+    ) -> Optional[bytes]:
         plane = self.plane
         with plane._step_lock:  # reentrant: flush() re-acquires
             if plane.pending_ops() > 0:
@@ -741,7 +751,12 @@ class PlaneServing:
         # the flush lock: every step reads device state, and a
         # concurrent executor-side flush donates the buffers it reads
         async with plane.flush_lock:
-            await self._drain_catchup_locked(batch)
+            tracer = get_tracer()
+            if tracer.enabled:
+                with tracer.span("serving.catchup_drain", batch=len(batch)):
+                    await self._drain_catchup_locked(batch)
+            else:
+                await self._drain_catchup_locked(batch)
 
     async def _drain_catchup_locked(self, batch: list) -> None:
         import asyncio

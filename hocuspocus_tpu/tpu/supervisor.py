@@ -57,6 +57,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ..aio import spawn_tracked
+from ..observability.flight_recorder import get_flight_recorder
 from ..observability.tracing import get_tracer
 from ..server import logger as _logger_mod
 from ..server.types import Extension, Payload
@@ -490,6 +491,10 @@ class PlaneSupervisor:
         key = f"{frm}->{to}"
         self.transitions[key] = self.transitions.get(key, 0) + 1
         get_tracer().event("supervisor.transition", frm=frm, to=to)
+        # plane-level history rides the recorder under a pseudo-doc, so
+        # /debug/docs/__plane__ shows the supervisor's timeline next to
+        # the per-doc lifecycle rings
+        get_flight_recorder().record("__plane__", "supervisor.transition", frm=frm, to=to)
         for fn in list(self.on_transition):
             try:
                 fn(frm, to)

@@ -1,0 +1,579 @@
+"""End-to-end update lifecycle tracing: trace-id propagation through the
+flush pipeline, Perfetto/Chrome export, slow-span promotion, labelled
+histograms, Prometheus exposition conformance, flight recorder, and the
+/debug endpoints.
+
+The reference has none of this (SURVEY.md §5.1/§5.5); these tests cover
+the instrumentation layer the TPU build adds so perf PRs are measurable
+instead of anecdotal.
+"""
+
+from __future__ import annotations
+
+import json
+
+import aiohttp
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, encode_state_as_update
+from hocuspocus_tpu.observability import (
+    FlightRecorder,
+    Histogram,
+    Metrics,
+    MetricsRegistry,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_flight_recorder,
+    get_tracer,
+)
+from hocuspocus_tpu.observability.metrics import _fmt_value
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+STAGES = ("queue_wait", "build", "upload", "device", "readback", "broadcast")
+
+
+def _make_update(text: str = "hello") -> bytes:
+    doc = Doc()
+    doc.get_text("t").insert(0, text)
+    return encode_state_as_update(doc)
+
+
+def _fresh_traced_plane(num_docs: int = 8, capacity: int = 256):
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+
+    tracer = Tracer(enabled=True, max_spans=256)
+    plane = MergePlane(num_docs=num_docs, capacity=capacity)
+    plane.update_traces.tracer = tracer
+    return plane, tracer
+
+
+# -- trace-id propagation ------------------------------------------------------
+
+
+def test_trace_id_propagates_through_flush_and_broadcast_stages():
+    """One update -> six contiguous stage spans sharing one trace id,
+    whose durations sum exactly to the end-to-end latency (the
+    acceptance invariant for the lifecycle pipeline)."""
+    plane, tracer = _fresh_traced_plane()
+    hist = Histogram("e2e_seconds", "e2e")
+    plane.update_traces.histogram = hist
+
+    plane.register("traced")
+    plane.enqueue_update("traced", _make_update())
+    trace_id = plane.note_trace("traced")
+    assert trace_id is not None
+    assert plane.flush() > 0
+    assert plane.update_traces.finish("traced") == 1
+
+    spans = [s for s in tracer.export() if s["name"].startswith("update.")]
+    assert {s["name"] for s in spans} == {f"update.{st}" for st in STAGES}
+    assert {s["trace_id"] for s in spans} == {trace_id}
+    broadcast = next(s for s in spans if s["name"] == "update.broadcast")
+    e2e_ms = broadcast["attributes"]["e2e_ms"]
+    stage_sum = sum(s["duration_ms"] for s in spans)
+    assert stage_sum == pytest.approx(e2e_ms, abs=0.01)
+    # every stage observed once, plus the total series
+    for stage in STAGES:
+        assert hist.series_count(stage=stage) == 1
+    assert hist.series_count(stage="total") == 1
+
+
+def test_trace_sampling_one_in_n():
+    plane, tracer = _fresh_traced_plane()
+    tracer.sample = 4
+    plane.register("sampled")
+    ids = [plane.note_trace("sampled") for _ in range(8)]
+    stamped = [i for i in ids if i is not None]
+    assert len(stamped) == 2
+    assert ids[0] is not None  # the first update is always sampled
+
+
+def test_trace_book_drops_on_retire():
+    plane, tracer = _fresh_traced_plane()
+    plane.register("doomed")
+    plane.enqueue_update("doomed", _make_update())
+    assert plane.note_trace("doomed") is not None
+    plane.retire_doc("doomed", "capacity")
+    assert not plane.update_traces.active()
+
+
+def test_trace_book_disabled_costs_nothing():
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+
+    plane = MergePlane(num_docs=4, capacity=128)
+    plane.update_traces.tracer = Tracer(enabled=False)
+    plane.register("quiet")
+    plane.enqueue_update("quiet", _make_update())
+    assert plane.note_trace("quiet") is None
+    assert not plane.update_traces.active()
+    plane.flush()
+    assert plane.update_traces.finish("quiet") == 0
+
+
+# -- Perfetto / Chrome trace export --------------------------------------------
+
+
+def test_chrome_trace_export_schema():
+    tracer = Tracer(enabled=True, max_spans=64)
+    with tracer.span("outer", doc="d") as sp:
+        sp.set("bytes", 12)
+    tracer.event("instant.thing", detail="x")
+    tracer.add_span("staged", 1.0, 1.5, trace_id=42, doc="d")
+
+    trace = tracer.export_chrome_trace()
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    events = trace["traceEvents"]
+    # metadata record + three spans
+    assert len(events) == 4
+    assert events[0]["ph"] == "M"
+    for event in events:
+        assert {"name", "ph", "pid", "tid"} <= set(event)
+    complete = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert {e["name"] for e in complete} == {"outer", "staged"}
+    assert [e["name"] for e in instants] == ["instant.thing"]
+    for event in complete:
+        assert event["dur"] >= 0
+        assert isinstance(event["ts"], float)
+    staged = next(e for e in complete if e["name"] == "staged")
+    assert staged["args"]["trace_id"] == 42
+    assert staged["dur"] == pytest.approx(0.5e6)
+    json.loads(json.dumps(trace))  # valid JSON end to end
+
+
+# -- slow spans ----------------------------------------------------------------
+
+
+def test_slow_spans_promoted_even_after_ring_wrap():
+    tracer = Tracer(enabled=True, max_spans=2)
+    tracer.slow_ms = 0.0  # everything is slow
+    seen = []
+    tracer.on_slow.append(lambda sp: seen.append(sp.name))
+    for i in range(5):
+        with tracer.span(f"site{i}"):
+            pass
+    assert len(tracer) == 2  # ring wrapped...
+    assert len(seen) == 5  # ...but every slow span was promoted
+
+
+def test_slow_span_threshold_filters():
+    tracer = Tracer(enabled=True)
+    tracer.slow_ms = 10_000.0
+    hits = []
+    tracer.on_slow.append(hits.append)
+    with tracer.span("fast"):
+        pass
+    assert hits == []
+    tracer.add_span("synthetic", 0.0, 20.0)  # 20s
+    assert [sp.name for sp in hits] == ["synthetic"]
+
+
+# -- enable_tracing ring preservation ------------------------------------------
+
+
+def test_enable_tracing_preserves_ring_size_on_repeat_calls():
+    tracer = enable_tracing(max_spans=16)
+    try:
+        assert tracer._spans.maxlen == 16
+        again = enable_tracing()  # no size given: must NOT rebuild
+        assert again is tracer
+        assert tracer._spans.maxlen == 16
+        enable_tracing(max_spans=32)
+        assert tracer._spans.maxlen == 32
+    finally:
+        disable_tracing()
+        tracer.clear()
+        enable_tracing(max_spans=4096)
+        disable_tracing()
+
+
+# -- labelled histograms -------------------------------------------------------
+
+
+def test_histogram_labels_exposition_and_bisect_buckets():
+    hist = Histogram("stage_seconds", "Stage latency", buckets=(0.01, 0.1, 1.0))
+    hist.observe(0.005, stage="build")
+    hist.observe(0.05, stage="build")
+    hist.observe(0.5, stage="device")
+    hist.observe(0.1, stage="device")  # exactly on a bound: le-inclusive
+    lines = list(hist.expose())
+    assert 'stage_seconds_bucket{le="0.01",stage="build"} 1' in lines
+    assert 'stage_seconds_bucket{le="0.1",stage="build"} 2' in lines
+    assert 'stage_seconds_bucket{le="+Inf",stage="build"} 2' in lines
+    assert 'stage_seconds_bucket{le="0.1",stage="device"} 1' in lines
+    assert 'stage_seconds_bucket{le="1",stage="device"} 2' in lines
+    assert 'stage_seconds_count{stage="build"} 2' in lines
+    assert 'stage_seconds_count{stage="device"} 2' in lines
+    assert hist.count == 4  # aggregate across series
+    assert hist.series_count(stage="build") == 2
+
+
+def test_histogram_unlabelled_stays_compatible():
+    reg = MetricsRegistry()
+    hist = reg.histogram("lat_seconds", "Latency", buckets=(0.01, 0.1, 1.0))
+    for value in (0.005, 0.05, 0.5, 5.0):
+        hist.observe(value)
+    text = reg.expose()
+    assert 'lat_seconds_bucket{le="0.01"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+    assert "lat_seconds_count 4" in text
+
+
+def test_histogram_quantile_interpolation():
+    hist = Histogram("q_seconds", "", buckets=(0.1, 0.2, 0.4))
+    for _ in range(100):
+        hist.observe(0.15, stage="s")
+    q50 = hist.quantile(0.5, stage="s")
+    assert 0.1 <= q50 <= 0.2
+    assert hist.quantile(0.5, stage="missing") is None
+
+
+# -- _fmt_value ----------------------------------------------------------------
+
+
+def test_fmt_value_shortest_round_trip():
+    assert _fmt_value(0.25) == "0.25"
+    assert _fmt_value(0.1) == "0.1"
+    assert _fmt_value(3.0) == "3"
+    assert _fmt_value(float("inf")) == "+Inf"
+    assert _fmt_value(float("-inf")) == "-Inf"
+    assert _fmt_value(1e-09) in ("1e-09", "1e-9")
+    # accumulated float error keeps only the digits it needs — and the
+    # output always parses back to the exact same double
+    for value in (0.1 + 0.2, 1 / 3, 2.5e-7, 123456.789, 1e300):
+        text = _fmt_value(value)
+        assert float(text) == value
+        mantissa = text.split("e")[0].lstrip("-0.")
+        assert sum(c.isdigit() for c in mantissa) <= 17  # ≤17 significant digits
+    # and never MORE digits than the value needs: a shorter string that
+    # still round-trips must not exist
+    assert _fmt_value(0.1 + 0.2) == "0.30000000000000004"
+    assert _fmt_value(0.5) == "0.5"
+
+
+# -- Prometheus exposition conformance -----------------------------------------
+
+
+def _parse_exposition(body: str):
+    """-> (families: name -> {help, type, samples}), asserting the
+    HELP -> TYPE -> samples ordering per family as it parses."""
+    families: dict = {}
+    current = None
+    for line in body.splitlines():
+        if not line or line.startswith("# tracer"):
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"help": line, "type": None, "samples": []}
+            current = name
+        elif line.startswith("# TYPE "):
+            name = line.split()[2]
+            assert name == current, f"TYPE {name} not directly after its HELP"
+            assert families[name]["type"] is None
+            families[name]["type"] = line.split()[3]
+        elif line.startswith("#"):
+            continue
+        else:
+            sample_name = line.split("{")[0].split()[0]
+            assert current is not None and sample_name.startswith(current), line
+            assert families[current]["type"] is not None, line  # TYPE before samples
+            families[current]["samples"].append(line)
+    return families
+
+
+def _bucket_series(samples: list[str]):
+    """bucket samples -> {labels-without-le: [(le, cumulative)]}"""
+    import re
+
+    series: dict = {}
+    for line in samples:
+        if "_bucket{" not in line:
+            continue
+        labels_part = line[line.index("{") + 1 : line.rindex("}")]
+        value = float(line.rsplit(None, 1)[1])
+        labels = dict(
+            (m.group(1), m.group(2))
+            for m in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"', labels_part)
+        )
+        le = labels.pop("le")
+        key = tuple(sorted(labels.items()))
+        series.setdefault(key, []).append(
+            (float("inf") if le == "+Inf" else float(le), value)
+        )
+    return series
+
+
+async def test_metrics_scrape_is_prometheus_conformant():
+    """Full /metrics scrape: HELP/TYPE ordering, label escaping,
+    histogram bucket monotonicity with a labelled histogram live."""
+    metrics = Metrics()
+    # exercise escaping + labelled series before the scrape
+    metrics.registry.counter("esc_total", "Escapes").inc(
+        label='quote " backslash \\ newline \n end'
+    )
+    metrics.update_e2e.observe(0.003, stage="build")
+    metrics.update_e2e.observe(0.5, stage="build")
+    metrics.update_e2e.observe(0.02, stage="device")
+    server = await new_hocuspocus(extensions=[metrics])
+    provider = new_provider(server, name="conformance")
+    try:
+        await wait_synced(provider)
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/metrics") as response:
+                assert response.status == 200
+                body = await response.text()
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+    families = _parse_exposition(body)
+    # every family has HELP, then TYPE, then at least one sample
+    for name, family in families.items():
+        assert family["type"] in ("counter", "gauge", "histogram"), name
+        assert family["samples"], name
+    # escaping: backslash, quote and newline all escaped in the output
+    esc_line = next(s for s in families["esc_total"]["samples"] if "{" in s)
+    assert '\\"' in esc_line and "\\\\" in esc_line and "\\n" in esc_line
+    assert "\n" not in esc_line  # the raw newline never leaks
+    # histogram bucket monotonicity (every labelled series, le ascending)
+    histo_families = [f for n, f in families.items() if f["type"] == "histogram"]
+    assert histo_families
+    checked = 0
+    for family in histo_families:
+        for key, buckets in _bucket_series(family["samples"]).items():
+            assert buckets == sorted(buckets, key=lambda b: b[0]), key
+            values = [v for _, v in buckets]
+            assert values == sorted(values), (key, values)
+            assert buckets[-1][0] == float("inf")
+            checked += 1
+    assert checked >= 3
+    # the labelled e2e histogram made it into the exposition
+    assert any(
+        'stage="build"' in s
+        for s in families["hocuspocus_tpu_update_e2e_seconds"]["samples"]
+    )
+
+
+# -- flight recorder -----------------------------------------------------------
+
+
+def test_flight_recorder_bounded_rings_and_lru():
+    recorder = FlightRecorder(max_docs=2, max_events=3)
+    for i in range(5):
+        recorder.record("a", f"e{i}")
+    assert len(recorder.events("a")) == 3  # per-doc ring bounded
+    assert recorder.events("a")[-1]["event"] == "e4"
+    recorder.record("b", "x")
+    recorder.record("c", "y")  # evicts the least-recently-eventful doc
+    assert len(recorder) == 2
+    assert recorder.events("a") == []
+    assert recorder.evicted_docs == 1
+    summary = recorder.docs()
+    assert summary[0]["doc"] == "c"  # most recent first
+    assert summary[0]["last_event"] == "y"
+
+
+def test_flight_recorder_records_plane_lifecycle():
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+
+    recorder = get_flight_recorder()
+    recorder.forget("fr-doc")
+    plane = MergePlane(num_docs=4, capacity=128)
+    plane.register("fr-doc")
+    plane.enqueue_update("fr-doc", _make_update())
+    plane.retire_doc("fr-doc", "capacity")
+    events = [e["event"] for e in recorder.events("fr-doc")]
+    assert "retire" in events
+    retire = next(e for e in recorder.events("fr-doc") if e["event"] == "retire")
+    assert retire["reason"] == "capacity"
+
+
+# -- live server: /debug endpoints + acceptance flow ---------------------------
+
+
+async def test_traced_update_served_from_debug_endpoints():
+    """Acceptance: with tracing enabled, a single client update produces
+    a causally-linked trace retrievable from /debug/trace as valid
+    Chrome trace-event JSON, and hocuspocus_tpu_update_e2e_seconds
+    appears in /metrics with per-stage labels; the flight recorder
+    answers /debug/docs and /debug/docs/<name>."""
+    from hocuspocus_tpu.tpu import TpuMergeExtension
+
+    tracer = enable_tracing(max_spans=2048)
+    tracer.clear()
+    get_flight_recorder().forget("traced-live")
+    ext = TpuMergeExtension(
+        num_docs=8, capacity=512, flush_interval_ms=1,
+        broadcast_interval_ms=1, serve=True,
+    )
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics, ext])
+    provider = new_provider(server, name="traced-live")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "trace me")
+
+        def full_trace():
+            spans = [
+                s for s in tracer.export() if s["name"].startswith("update.")
+            ]
+            by_id: dict = {}
+            for span in spans:
+                by_id.setdefault(span["trace_id"], set()).add(span["name"])
+            complete = [
+                tid
+                for tid, names in by_id.items()
+                if names == {f"update.{st}" for st in STAGES}
+            ]
+            assert complete, by_id
+            return complete[0]
+
+        trace_id = await retryable_assertion(full_trace)
+        spans = [
+            s
+            for s in tracer.export()
+            if s["name"].startswith("update.") and s["trace_id"] == trace_id
+        ]
+        broadcast = next(s for s in spans if s["name"] == "update.broadcast")
+        assert sum(s["duration_ms"] for s in spans) == pytest.approx(
+            broadcast["attributes"]["e2e_ms"], abs=0.01
+        )
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/debug/trace") as response:
+                assert response.status == 200
+                trace = json.loads(await response.text())
+            assert "traceEvents" in trace
+            update_events = [
+                e
+                for e in trace["traceEvents"]
+                if e["name"].startswith("update.")
+                and e.get("args", {}).get("trace_id") == trace_id
+            ]
+            assert len(update_events) == len(STAGES)
+            for event in update_events:
+                assert event["ph"] in ("X", "i")
+                assert "ts" in event and "pid" in event and "tid" in event
+
+            async with session.get(f"{server.http_url}/metrics") as response:
+                body = await response.text()
+            assert 'hocuspocus_tpu_update_e2e_seconds_bucket{le=' in body
+            for stage in STAGES + ("total",):
+                assert f'stage="{stage}"' in body
+
+            async with session.get(
+                f"{server.http_url}/debug/docs/traced-live"
+            ) as response:
+                doc_events = json.loads(await response.text())
+            assert doc_events["doc"] == "traced-live"
+            assert "load" in [e["event"] for e in doc_events["events"]]
+
+            async with session.get(f"{server.http_url}/debug/docs") as response:
+                overview = json.loads(await response.text())
+            assert "busiest" in overview and "docs" in overview
+            assert any(d["doc"] == "traced-live" for d in overview["docs"])
+    finally:
+        disable_tracing()
+        tracer.clear()
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_slow_span_counter_in_metrics():
+    """--trace-slow-ms promotion lands in the labelled slow-span counter
+    on /metrics even with a tiny (always-wrapping) ring."""
+    tracer = enable_tracing(max_spans=4)
+    tracer.clear()
+    tracer.slow_ms = 0.0  # promote everything
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics])
+    provider = new_provider(server, name="slow-doc")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "x")
+
+        def promoted():
+            assert metrics.slow_spans.value(site="message.apply") >= 1
+
+        await retryable_assertion(promoted)
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/metrics") as response:
+                body = await response.text()
+        assert 'hocuspocus_tpu_slow_spans_total{site="message.apply"}' in body
+    finally:
+        tracer.slow_ms = None
+        disable_tracing()
+        tracer.clear()
+        provider.destroy()
+        await server.destroy()
+
+
+# -- tracing overhead guard ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_tracing_overhead_on_sparse_flush_under_5_percent():
+    """Disabled-vs-enabled tracing on a miniature sparse-load flush
+    loop: the lifecycle pipeline must stay within the 5% overhead
+    budget (the acceptance bound for the sparse-load bench)."""
+    import time
+
+    import numpy as np
+
+    from hocuspocus_tpu.tpu.kernels import KIND_INSERT, NONE_CLIENT
+    from hocuspocus_tpu.tpu.lowering import DenseOp
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+
+    num_docs, busy, ops_per_doc, run = 256, 8, 4, 8
+
+    def build(traced: bool):
+        plane = MergePlane(num_docs=num_docs, capacity=4096, max_slots_per_flush=4)
+        plane.update_traces.tracer = Tracer(enabled=traced, max_spans=512)
+        slots = []
+        for d in range(num_docs):
+            doc = plane.register(f"d{d}")
+            slots.append(plane._alloc_seq(doc, ("root", "t")))
+        plane.warmup_compiles((plane._k_buckets()[-1], plane._bucket_b(busy)))
+        return plane, slots, np.zeros(num_docs, np.int64)
+
+    def run_cycles(plane, slots, clocks, traced: bool, cycles: int) -> float:
+        rng = np.random.default_rng(7)
+        start = time.perf_counter()
+        for _ in range(cycles):
+            subset = rng.choice(num_docs, size=busy, replace=False)
+            for s in subset:
+                slot = slots[s]
+                queue = plane.queues[slot]
+                for _ in range(ops_per_doc):
+                    clock = int(clocks[s])
+                    queue.append(
+                        DenseOp(
+                            kind=KIND_INSERT, client=7, clock=clock, run_len=run,
+                            left_client=7 if clock else NONE_CLIENT,
+                            left_clock=clock - 1 if clock else 0,
+                        )
+                    )
+                    clocks[s] += run
+                plane.projected_len[slot] += ops_per_doc * run
+                plane._busy_slots.add(slot)
+                if traced:
+                    plane.note_trace(f"d{s}")
+            plane.flush()
+            if traced:
+                plane.update_traces.finish_all()
+        return time.perf_counter() - start
+
+    cycles = 40
+    best = {False: float("inf"), True: float("inf")}
+    for _ in range(3):
+        for traced in (False, True):
+            plane, slots, clocks = build(traced)
+            run_cycles(plane, slots, clocks, traced, 4)  # warm
+            best[traced] = min(
+                best[traced], run_cycles(plane, slots, clocks, traced, cycles)
+            )
+    # 5% relative budget plus a tiny absolute floor for timer noise
+    assert best[True] <= best[False] * 1.05 + 0.005, best

@@ -81,6 +81,69 @@ async def test_cli_tpu_serve_mode():
                     p.destroy()
 
 
+async def test_cli_trace_flags_serve_debug_endpoints():
+    """--trace boots the server with lifecycle tracing + the metrics
+    extension: a client edit becomes a causally-linked trace at
+    /debug/trace and per-stage e2e histograms on /metrics."""
+    import json
+
+    import aiohttp
+
+    async with _launch_cli(
+        "--tpu-serve", "--tpu-docs", "16", "--tpu-capacity", "512",
+        "--tpu-flush-interval", "1", "--tpu-broadcast-interval", "1",
+        "--trace", "--trace-max-spans", "1024", "--trace-sample", "1",
+    ) as port:
+        provider = None
+        try:
+            provider = HocuspocusProvider(
+                name="cli-traced", url=f"ws://127.0.0.1:{port}"
+            )
+            await wait_for(lambda: provider.synced, timeout=30)
+            provider.document.get_text("t").insert(0, "trace via cli")
+            await wait_for(lambda: not provider.has_unsynced_changes, timeout=10)
+
+            async def traced() -> bool:
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(
+                        f"http://127.0.0.1:{port}/debug/trace"
+                    ) as response:
+                        if response.status != 200:
+                            return False
+                        trace = json.loads(await response.text())
+                return any(
+                    e["name"] == "update.broadcast"
+                    for e in trace.get("traceEvents", [])
+                )
+
+            import asyncio as _asyncio
+
+            # keep editing while we poll: the CLI boots the SUPERVISED
+            # plane, so an edit landing before the runtime hot-attaches
+            # rides the CPU path untraced — later edits get captured
+            # (and stamped) once the plane is READY
+            ok = False
+            for attempt in range(120):
+                if await traced():
+                    ok = True
+                    break
+                if attempt % 5 == 4:
+                    provider.document.get_text("t").insert(0, "x")
+                await _asyncio.sleep(0.25)
+            assert ok
+
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"http://127.0.0.1:{port}/metrics"
+                ) as response:
+                    body = await response.text()
+            assert "hocuspocus_tpu_update_e2e_seconds_bucket" in body
+            assert 'stage="total"' in body
+        finally:
+            if provider is not None:
+                provider.destroy()
+
+
 async def test_cli_sharded_serve_flags():
     """--tpu-shards/--tpu-arena boot the doc-partitioned serve-mode
     server from the CLI; docs on different shards converge end to end."""
