@@ -196,6 +196,7 @@ def main() -> None:
         os.environ.setdefault("BENCH_SERVER_P99", "0")
         os.environ.setdefault("BENCH_CATCHUP", "0")
         os.environ.setdefault("BENCH_RLE", "0")
+        os.environ.setdefault("BENCH_WIRE", "0")
     cpu_smoke = None
     for attempt in range(2):
         cpu_smoke = _run_inner("cpu")
@@ -316,6 +317,7 @@ def _attach_baseline_scale_pass(result: dict, platforms: "str | None") -> None:
             "BENCH_STEPS": "8",
             "BENCH_SERVER_P99": "0",
             "BENCH_CATCHUP": "0",
+            "BENCH_WIRE": "0",
             # no RLE side-pass at 100k width: it would add a ~2 GB arena
             # next to the live 9.6 GB one and minutes of microbatches
             # inside this pass's short budget
@@ -604,6 +606,16 @@ def run_bench() -> None:
             storm = _measure_catchup_storm()
         except Exception as error:
             storm = {"error": repr(error)[:300]}
+
+    # wire-path load (socket edge): msgs/s, bytes in/out, send-queue
+    # peak and ingress-stage quantiles through the full provider pipe
+    wire_load = None
+    if os.environ.get("BENCH_WIRE", "1") != "0":
+        _log("inner: wire-load pass ...")
+        try:
+            wire_load = _measure_wire_load()
+        except Exception as error:
+            wire_load = {"error": repr(error)[:300]}
     _log("inner: all passes done")
 
     merges_per_sec = total_ops / elapsed
@@ -647,6 +659,8 @@ def run_bench() -> None:
         result["extra"]["sparse_load"] = sparse
     if storm is not None:
         result["extra"]["catchup_storm"] = storm
+    if wire_load is not None:
+        result["extra"]["wire_load"] = wire_load
     if jax.default_backend() != "tpu":
         onchip = _latest_onchip_capture()
         result["extra"]["note"] = (
@@ -871,6 +885,81 @@ def _measure_sparse_load() -> dict:
         "staging_allocs": flush_counters["flush_staging_allocs"],
         "staging_reuses": flush_counters["flush_staging_reuses"],
         "update_e2e": update_e2e,
+    }
+
+
+def _measure_wire_load() -> dict:
+    """Wire-path load characterization (the socket edge of the request
+    path): drives loadgen's ServedLoadHarness — real providers, the
+    full auth/SyncStep1/2 pipeline, served planes — with wire telemetry
+    and lifecycle tracing enabled, and reports msgs/s, bytes in/out,
+    send-queue peak and the ingress-stage (ws receive → decode → apply
+    → capture) p50/p99 from the e2e histograms."""
+    import asyncio
+
+    from hocuspocus_tpu.loadgen import ServedLoadHarness
+    from hocuspocus_tpu.observability import (
+        disable_tracing,
+        enable_tracing,
+        get_wire_telemetry,
+    )
+
+    docs = int(os.environ.get("BENCH_WIRE_DOCS", 64))
+    edits = int(os.environ.get("BENCH_WIRE_EDITS", 80))
+    budget_s = int(os.environ.get("BENCH_WIRE_TIMEOUT", 240))
+
+    wire = get_wire_telemetry()
+    wire.enable()
+    before = wire.totals()
+    tracer = enable_tracing(max_spans=8192)
+    tracer.sample = 1
+    harness = ServedLoadHarness(
+        num_docs=docs,
+        sampled=min(16, docs),
+        edits=edits,
+        shards=1,
+        capacity=1024,
+        flush_interval_ms=2.0,
+        docs_per_socket=min(64, docs),
+        with_metrics=True,
+    )
+    started = time.perf_counter()
+    try:
+        served = asyncio.run(harness.run(budget_s=budget_s))
+    finally:
+        disable_tracing()
+    elapsed = max(time.perf_counter() - started, 1e-9)
+    after = wire.totals()
+
+    hist = harness.metrics[0].update_e2e if harness.metrics else None
+
+    def quantile_ms(stage: str, q: float):
+        if hist is None:
+            return None
+        value = hist.quantile(q, stage=stage)
+        return None if value is None else round(value * 1000, 3)
+
+    msgs_in = after["messages_in"] - before["messages_in"]
+    return {
+        "docs": docs,
+        "samples": served["extra"]["samples"],
+        "msgs_in": int(msgs_in),
+        "msgs_out": int(after["messages_out"] - before["messages_out"]),
+        "msgs_per_sec": round(msgs_in / elapsed, 1),
+        "bytes_in": int(after["bytes_in"] - before["bytes_in"]),
+        "bytes_out": int(after["bytes_out"] - before["bytes_out"]),
+        "send_queue_peak": int(after["send_queue_peak"]),
+        "backpressure_events": int(
+            after["backpressure_events"] - before["backpressure_events"]
+        ),
+        "wire_errors": int(after["errors"] - before["errors"]),
+        "ingress": {
+            "p50_ms": quantile_ms("ingress", 0.5),
+            "p99_ms": quantile_ms("ingress", 0.99),
+            "count": 0 if hist is None else hist.series_count(stage="ingress"),
+        },
+        "served_p99_ms": served["value"],
+        "elapsed_s": round(elapsed, 1),
     }
 
 

@@ -181,6 +181,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="trace 1 in N captured updates (default 1 = every update); "
         "raise under load so tracing stays viable at 100k docs",
     )
+    # SLO engine (docs/guides/observability.md): multi-window burn
+    # rates over the e2e-latency and wire-error-rate objectives, served
+    # at /debug/slo and folded into /healthz
+    parser.add_argument(
+        "--slo-e2e-ms",
+        type=float,
+        default=50.0,
+        help="e2e latency objective: 99%% of traced updates must "
+        "complete socket->broadcast within this many ms (default 50, "
+        "the BASELINE p99 budget)",
+    )
+    parser.add_argument(
+        "--slo-error-rate",
+        type=float,
+        default=0.001,
+        help="error budget for the wire error-rate objective: the "
+        "allowed fraction of inbound messages that may fail (default "
+        "0.001 = 99.9%% succeed)",
+    )
     return parser
 
 
@@ -196,11 +215,16 @@ async def run(args: argparse.Namespace) -> None:
         tracer.slow_ms = args.trace_slow_ms if args.trace_slow_ms > 0 else None
         tracer.sample = max(args.trace_sample, 1)
     if args.metrics or args.trace:
-        # /metrics + /debug/{trace,profile,docs}: tracing without the
-        # exporter would be write-only, so --trace implies it
+        # /metrics + /debug/{trace,profile,docs,slo}: tracing without
+        # the exporter would be write-only, so --trace implies it
         from .observability import Metrics
 
-        extensions.append(Metrics())
+        extensions.append(
+            Metrics(
+                slo_e2e_p99_ms=args.slo_e2e_ms,
+                slo_error_rate=args.slo_error_rate,
+            )
+        )
     if args.sqlite is not None:
         extensions.append(SQLite(database=args.sqlite))
     if args.s3:

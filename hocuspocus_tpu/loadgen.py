@@ -58,6 +58,7 @@ class ServedLoadHarness:
         docs_per_socket: int = 512,
         sync_timeout: float = 600.0,
         background_fraction: int = 16,
+        with_metrics: bool = False,
         progress=None,
     ) -> None:
         self.num_docs = num_docs
@@ -71,6 +72,12 @@ class ServedLoadHarness:
         self.docs_per_socket = docs_per_socket
         self.sync_timeout = sync_timeout
         self.background_fraction = background_fraction
+        # with_metrics: add a Metrics extension per instance (enables
+        # the wire telemetry singleton and binds each plane's trace
+        # book to the e2e histogram) — the bench's wire_load pass reads
+        # ingress-stage quantiles off metrics[0] after the run
+        self.with_metrics = with_metrics
+        self.metrics: list[Any] = []
         self._progress = progress or (lambda msg: None)
 
         self.servers: list[Server] = []
@@ -126,6 +133,12 @@ class ServedLoadHarness:
                         disconnect_delay=100,
                     )
                 )
+            if self.with_metrics:
+                from .observability import Metrics
+
+                metrics = Metrics()
+                self.metrics.append(metrics)
+                extensions.append(metrics)
             extensions.append(ext)
             server = Server(Configuration(quiet=True, extensions=extensions))
             await server.listen(port=0)

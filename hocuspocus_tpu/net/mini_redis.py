@@ -13,6 +13,7 @@ import asyncio
 import time
 from typing import Optional
 
+from ..observability.wire import get_wire_telemetry
 from .resp import CRLF, EXTEND_LOCK_SCRIPT, RELEASE_LOCK_SCRIPT, key_hash_slot, read_reply
 
 
@@ -230,6 +231,9 @@ class MiniRedis:
                         # (subscriber never sees it; publisher is none
                         # the wiser — pub/sub is at-most-once)
                         self.drop_publishes -= 1
+                        wire = get_wire_telemetry()
+                        if wire.enabled:
+                            wire.record_publish(0, dropped=True)
                         writer.write(b":0\r\n")
                         await writer.drain()
                         continue
@@ -242,6 +246,11 @@ class MiniRedis:
                             if node is not self and id(node) not in seen:
                                 seen.add(id(node))
                                 delivered += node._deliver(channel, payload)
+                    wire = get_wire_telemetry()
+                    if wire.enabled:
+                        # pub/sub fan-out accounting: publishes vs the
+                        # frames actually fanned out (cluster bus incl.)
+                        wire.record_publish(delivered)
                     writer.write(b":%d\r\n" % delivered)
                 elif command == b"SUBSCRIBE":
                     for channel in args:

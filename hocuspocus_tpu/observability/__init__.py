@@ -5,9 +5,11 @@ New capability beyond the reference (SURVEY.md §5.1/§5.5 record that the
 reference ships no tracing and no metrics exporter).
 """
 
+from .device_watch import CompileTracker
 from .extension import Metrics
 from .flight_recorder import FlightRecorder, get_flight_recorder
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .slo import SloEngine, SloTarget, counter_ratio_slo, fraction_slo, latency_slo
 from .tracing import (
     Tracer,
     UpdateTraceBook,
@@ -15,18 +17,27 @@ from .tracing import (
     enable_tracing,
     get_tracer,
 )
+from .wire import WireTelemetry, get_wire_telemetry
 
 __all__ = [
+    "CompileTracker",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "Metrics",
     "MetricsRegistry",
+    "SloEngine",
+    "SloTarget",
     "Tracer",
     "UpdateTraceBook",
+    "WireTelemetry",
+    "counter_ratio_slo",
     "disable_tracing",
     "enable_tracing",
+    "fraction_slo",
     "get_flight_recorder",
     "get_tracer",
+    "get_wire_telemetry",
+    "latency_slo",
 ]

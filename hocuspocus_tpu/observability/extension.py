@@ -16,13 +16,17 @@ instance at scrape time.
 from __future__ import annotations
 
 import asyncio
+import sys
 import time
 from typing import Optional
 
 from ..server.types import Extension, Payload
+from .device_watch import compile_metrics
 from .flight_recorder import get_flight_recorder
 from .metrics import MetricsRegistry
+from .slo import SloEngine, counter_ratio_slo, fraction_slo, latency_slo
 from .tracing import get_tracer
+from .wire import get_wire_telemetry
 
 
 class Metrics(Extension):
@@ -35,16 +39,21 @@ class Metrics(Extension):
         path: str = "/metrics",
         expose_tracer: bool = False,
         debug_endpoints: bool = True,
+        slo_e2e_p99_ms: float = 50.0,
+        slo_error_rate: float = 0.001,
+        slo_sample_interval_s: float = 15.0,
     ) -> None:
         self.registry = registry or MetricsRegistry()
         self.path = path
         self.expose_tracer = expose_tracer
         # /debug/trace (Perfetto JSON), /debug/profile (on-demand jax
-        # profiler capture), /debug/docs[/<name>] (flight recorder)
+        # profiler capture), /debug/docs[/<name>] (flight recorder),
+        # /debug/slo (burn-rate rollup)
         self.debug_endpoints = debug_endpoints
         self._instance = None
         self._plane_owner = None  # extension owning plane(s), for /debug/docs
         self._slow_span_cb = None
+        self._slo_task: Optional[asyncio.Task] = None
 
         reg = self.registry
         self.connects = reg.counter(
@@ -92,12 +101,56 @@ class Metrics(Extension):
             "hocuspocus_tpu_slow_spans_total",
             "Spans promoted past the --trace-slow-ms threshold, by site",
         )
+        # wire-path telemetry (observability/wire.py): the socket-edge
+        # counters/gauges/histograms are process-global collectors; the
+        # registry adopts them so they render on this server's /metrics
+        self.wire = get_wire_telemetry()
+        for metric in self.wire.metrics():
+            reg.register(metric)
+        # compile tracker exposition (observability/device_watch.py):
+        # shared by every plane/shard in the process
+        for metric in compile_metrics():
+            reg.register(metric)
+        # SLO engine (observability/slo.py): e2e latency + wire error
+        # rate by default; the breaker-open fraction target joins when a
+        # supervised plane binds. Thresholds snap to histogram bucket
+        # bounds for exact good/bad counting.
+        self.slo = SloEngine(sample_interval_s=slo_sample_interval_s)
+        self.slo.add(
+            latency_slo(
+                "update_e2e_latency",
+                self.update_e2e,
+                threshold_s=slo_e2e_p99_ms / 1000.0,
+                objective=0.99,
+                stage="total",
+                # description generated by the factory: it reports the
+                # EFFECTIVE (bucket-snapped) threshold, not the request
+            )
+        )
+        self.slo.add(
+            counter_ratio_slo(
+                "wire_error_rate",
+                self.wire.messages_in,
+                self.wire.errors,
+                objective=1.0 - slo_error_rate,
+                description=(
+                    f"{1.0 - slo_error_rate:.2%} of inbound messages handled "
+                    "without closing the channel"
+                ),
+            )
+        )
+        for metric in self.slo.metrics():
+            reg.register(metric)
 
     # -- lifecycle ---------------------------------------------------------
 
     async def on_configure(self, data: Payload) -> None:
         instance = data.instance
         self._instance = instance
+        # light the socket edge: wire-telemetry sites cost one attribute
+        # read until this flips
+        self.wire.enable()
+        self._set_build_info()
         # slow-span promotion feeds the labelled counter even when the
         # span ring has wrapped (tracing.Tracer._promote_slow fires at
         # finish time, not export time)
@@ -129,6 +182,74 @@ class Metrics(Extension):
                 break
             if self._bind_plane_metrics(extension):
                 break  # one plane per server
+
+    def _set_build_info(self) -> None:
+        """`hocuspocus_tpu_build_info 1` with version/backend/device
+        labels — the standard join target for dashboards ("which build
+        is this scrape from?"). Refreshed at every scrape (labels go
+        stale otherwise: on the CLI TPU path jax is imported by the
+        supervisor's worker thread AFTER configure) and must NEVER
+        force backend init — `jax.default_backend()`/`device_count()`
+        block on PJRT discovery, which is exactly the boot hang the
+        plane supervisor exists to avoid. Only ALREADY-initialized
+        backends are reported; until one exists the labels read
+        backend="none"."""
+        from .. import __version__
+
+        backend = "none"
+        device_count = 0
+        if "jax" in sys.modules:
+            try:
+                # read the registry of initialized backends without
+                # triggering initialization (a plain dict read)
+                from jax._src import xla_bridge
+
+                backends = getattr(xla_bridge, "_backends", None) or {}
+                if backends:
+                    # prefer the accelerator when both it and the cpu
+                    # fallback backend are initialized
+                    name = next(
+                        (n for n in backends if n != "cpu"), next(iter(backends))
+                    )
+                    backend = str(name)
+                    device_count = int(backends[name].device_count())
+            except Exception:
+                backend = "unknown"
+        gauge = self.registry.gauge(
+            "hocuspocus_tpu_build_info",
+            "Build/runtime identity (constant 1; labels carry the data)",
+        )
+        gauge.clear()
+        gauge.set(
+            1.0,
+            version=str(__version__),
+            backend=backend,
+            device_count=str(device_count),
+        )
+
+    def health_status(self) -> dict:
+        """SLO rollup folded into `Hocuspocus.get_health()` / `/healthz`:
+        a target breaching its multi-window burn-rate rule downgrades
+        the server to "degraded" — the same verdict `/debug/slo` and the
+        burn-rate gauges report, so the supervisor story and the SLO
+        story can't disagree."""
+        self.slo.maybe_sample()
+        status = self.slo.status()
+        breaching = [
+            name for name, slo in status["slos"].items() if slo["breaching"]
+        ]
+        return {
+            "state": "burning" if breaching else "ok",
+            "degraded": bool(breaching),
+            "breaching": breaching,
+            "slos": {
+                name: {
+                    window: stats["burn_rate"]
+                    for window, stats in slo["windows"].items()
+                }
+                for name, slo in status["slos"].items()
+            },
+        }
 
     def _bind_plane_metrics(self, owner) -> bool:
         """Register the plane-counter gauges for `owner` (an extension
@@ -201,6 +322,16 @@ class Metrics(Extension):
                     f"TPU plane residency stat: {key}",
                     fn=(lambda p=plane, k=key: p.residency_stats[k]),
                 )
+            # HBM watch (observability/device_watch.py): arena/staging
+            # live bytes, the biggest single-cycle upload, and the
+            # cumulative readback-barrier stall time
+            if hasattr(plane, "memory_stats"):
+                for key in plane.memory_stats():
+                    reg.gauge(
+                        f"hocuspocus_tpu_plane_{key}",
+                        f"TPU plane device-memory stat: {key}",
+                        fn=(lambda p=plane, k=key: p.memory_stats()[k]),
+                    )
             return True
         shards = getattr(owner, "shards", None)
         if shards:
@@ -284,6 +415,27 @@ class Metrics(Extension):
                     f"TPU plane residency stat: {key} (over shards)",
                     fn=fn,
                 )
+            if hasattr(shards[0].plane, "memory_stats"):
+                # bytes/stall totals sum across shards; the upload PEAK
+                # is a per-cycle maximum — summing would report an
+                # upload no single cycle ever performed (same worst-
+                # shard convention as the stage times above)
+                for key in shards[0].plane.memory_stats():
+                    if key == "upload_bytes_peak":
+                        fn = lambda o=owner, k=key: max(
+                            s.plane.memory_stats()[k] for s in o.shards
+                        )
+                        how = "max over shards"
+                    else:
+                        fn = lambda o=owner, k=key: sum(
+                            s.plane.memory_stats()[k] for s in o.shards
+                        )
+                        how = "summed over shards"
+                    reg.gauge(
+                        f"hocuspocus_tpu_plane_{key}",
+                        f"TPU plane device-memory stat: {key} ({how})",
+                        fn=fn,
+                    )
             return True
         return False
 
@@ -354,12 +506,57 @@ class Metrics(Extension):
             )
         # the plane's own counters bind the moment a runtime attaches
         supervisor.on_attach.append(self._bind_plane_metrics)
+        # breaker-open fraction SLO: each engine sample observes the
+        # breaker state, so the windowed fraction is time-open at
+        # sample-interval resolution
+        if not any(t.name == "breaker_open_fraction" for t in self.slo.targets):
+            self.slo.add(
+                fraction_slo(
+                    "breaker_open_fraction",
+                    lambda b=supervisor.breaker: b.state != "closed",
+                    objective=0.99,
+                    description=(
+                        "plane circuit breaker closed for 99% of sampled time"
+                    ),
+                )
+            )
+
+    async def on_listen(self, data: Payload) -> None:
+        # background burn-rate sampler: scrape-driven sampling alone
+        # would leave windows empty on servers nobody is scraping yet
+        if self._slo_task is None or self._slo_task.done():
+            self._slo_task = asyncio.ensure_future(self._slo_sampler())
+
+    async def _slo_sampler(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.slo.sample_interval_s)
+                self.slo.maybe_sample()
+        except asyncio.CancelledError:
+            pass
 
     async def connected(self, data: Payload) -> None:
         self.connects.inc()
+        name = getattr(data, "document_name", None)
+        if name:
+            document = getattr(getattr(data, "connection", None), "document", None)
+            get_flight_recorder().record(
+                name,
+                "connect",
+                connections=document.get_connections_count()
+                if document is not None
+                else None,
+            )
 
     async def on_disconnect(self, data: Payload) -> None:
         self.disconnects.inc()
+        name = getattr(data, "document_name", None)
+        if name:
+            # clients_count in the disconnect payload is taken AFTER the
+            # connection was removed: the audience remaining
+            get_flight_recorder().record(
+                name, "disconnect", connections=getattr(data, "clients_count", None)
+            )
 
     async def on_change(self, data: Payload) -> None:
         self.changes.inc()
@@ -396,6 +593,9 @@ class Metrics(Extension):
         self.stateless.inc()
 
     async def on_destroy(self, data: Payload) -> None:
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            self._slo_task = None
         # unbind the global-tracer callback so test servers (one Metrics
         # instance each) don't accumulate dead counters on the tracer
         if self._slow_span_cb is not None:
@@ -413,6 +613,9 @@ class Metrics(Extension):
             request, "path", ""
         )
         if path == self.path:
+            # keep the burn-rate gauges and build-info labels fresh
+            self.slo.maybe_sample()
+            self._set_build_info()
             body = self.registry.expose()
             if self.expose_tracer:
                 import json
@@ -423,8 +626,15 @@ class Metrics(Extension):
                 ) + "\n"
             from aiohttp import web
 
+            # Prometheus text exposition format 0.0.4: scrapers content-
+            # negotiate on the version parameter. Series order is
+            # deterministic (registry, label-set and bucket iteration
+            # are all sorted), so consecutive scrapes diff cleanly.
             data.response = web.Response(
-                text=body, content_type="text/plain", charset="utf-8"
+                body=body.encode("utf-8"),
+                headers={
+                    "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
+                },
             )
             # Raising aborts the rest of the hook chain and the default
             # "Welcome" response; the server serves `data.response` instead
@@ -434,6 +644,9 @@ class Metrics(Extension):
             error.response = data.response
             raise error
         if self.debug_endpoints:
+            if path == "/debug/slo":
+                self.slo.maybe_sample()
+                self._serve_json(data, self.slo.status())
             if path == "/debug/trace":
                 self._serve_json(data, get_tracer().export_chrome_trace())
             if path == "/debug/docs":

@@ -82,7 +82,9 @@ class Counter:
 
 class Gauge:
     """Settable value; can also track a live callable (e.g. connection
-    counts read straight off the instance at scrape time)."""
+    counts read straight off the instance at scrape time). Optionally
+    labelled: `set(1.0, slo="e2e", window="5m")` keeps one series per
+    label set, exposed in sorted label order (deterministic scrapes)."""
 
     def __init__(
         self, name: str, help: str, fn: Optional[Callable[[], float]] = None
@@ -90,26 +92,40 @@ class Gauge:
         self.name = name
         self.help = help
         self._fn = fn
-        self._value = 0.0
+        self._series: dict[tuple, float] = {}
 
-    def set(self, value: float) -> None:
-        self._value = value
+    def set(self, value: float, **labels: str) -> None:
+        self._series[tuple(sorted(labels.items()))] = float(value)
 
-    def inc(self, amount: float = 1.0) -> None:
-        self._value += amount
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        self._series[key] = self._series.get(key, 0.0) + amount
 
-    def dec(self, amount: float = 1.0) -> None:
-        self._value -= amount
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
 
-    def value(self) -> float:
-        if self._fn is not None:
+    def value(self, **labels: str) -> float:
+        if self._fn is not None and not labels:
             return float(self._fn())
-        return self._value
+        return self._series.get(tuple(sorted(labels.items())), 0.0)
+
+    def clear(self) -> None:
+        """Drop every labelled series (for gauges whose label VALUES
+        change over time — e.g. build_info's backend label once the
+        runtime attaches — so stale series don't linger)."""
+        self._series.clear()
 
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
-        yield f"{self.name} {_fmt_value(self.value())}"
+        if self._fn is not None:
+            yield f"{self.name} {_fmt_value(float(self._fn()))}"
+            return
+        if not self._series:
+            yield f"{self.name} 0"
+            return
+        for key, value in sorted(self._series.items()):
+            yield f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(value)}"
 
 
 DEFAULT_BUCKETS = (
@@ -245,6 +261,16 @@ class MetricsRegistry:
         if not isinstance(metric, Histogram):
             raise TypeError(f"metric {name!r} already registered as {type(metric).__name__}")
         return metric
+
+    def register(self, metric) -> None:
+        """Adopt a pre-built metric object (Counter/Gauge/Histogram) into
+        this registry's exposition — how process-global collectors (the
+        wire telemetry singleton, the compile tracker) surface on one
+        server's /metrics without being constructed by it."""
+        existing = self._metrics.get(metric.name)
+        if existing is not None and existing is not metric:
+            raise ValueError(f"metric {metric.name!r} already registered")
+        self._metrics[metric.name] = metric
 
     def expose(self) -> str:
         lines: list[str] = []

@@ -25,6 +25,7 @@ Design constraints:
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import os
 import threading
@@ -34,6 +35,16 @@ from contextlib import contextmanager
 from typing import Any, Callable, Iterator, Optional
 
 _slow_logger = logging.getLogger("hocuspocus_tpu.tracing")
+
+# ingress mark (see Tracer.ingress_mark): a ContextVar, NOT a tracer
+# attribute — the websocket edge awaits hook chains between setting the
+# mark and the capture seam consuming it, and concurrent dispatches
+# from different sockets run as different asyncio tasks. A shared slot
+# would let task B clobber task A's receive timestamp mid-await; the
+# context is per-task, so each dispatch sees exactly its own mark.
+_ingress_mark: "contextvars.ContextVar[Optional[float]]" = contextvars.ContextVar(
+    "hocuspocus_tpu_ingress_mark", default=None
+)
 
 
 class Span:
@@ -123,6 +134,26 @@ class Tracer:
         # perf_counter origin for trace-viewer timestamps (`ts` is
         # microseconds relative to this anchor)
         self._origin_perf = time.perf_counter()
+
+    # -- ingress mark ------------------------------------------------------
+
+    @property
+    def ingress_mark(self) -> Optional[float]:
+        """The current dispatch's frame-receive timestamp, or None.
+
+        The websocket edge (Connection.handle_message) sets this before
+        dispatching and clears it in its finally; UpdateTraceBook.stamp
+        reads it at the capture seam, so lifecycle traces born inside
+        the dispatch gain an `update.ingress` stage (ws receive ->
+        decode -> apply -> capture) and the e2e span truly runs
+        socket -> broadcast. Backed by a ContextVar: dispatch tasks
+        from different sockets interleave across the hook-chain awaits,
+        and each must see only its own mark."""
+        return _ingress_mark.get()
+
+    @ingress_mark.setter
+    def ingress_mark(self, value: Optional[float]) -> None:
+        _ingress_mark.set(value)
 
     # -- recording ---------------------------------------------------------
 
@@ -298,6 +329,11 @@ class UpdateTraceBook:
     adjacent stages, so the per-stage durations are contiguous and sum
     exactly to the end-to-end latency:
 
+        receive → enqueue:   ingress   (ws receive → decode → apply →
+                                        capture; present only when the
+                                        tracer's ingress_mark was set,
+                                        i.e. the update arrived through
+                                        the websocket edge)
         enqueue → drain:     queue_wait
         drain → built:       build
         built → uploaded:    upload
@@ -372,8 +408,16 @@ class UpdateTraceBook:
                 self.dropped += 1
                 return None
             trace_id = tracer.next_trace_id()
+            t_enqueue = time.perf_counter()
+            # a live ingress mark anchors the trace at the websocket
+            # receive instead of the capture seam (never later than the
+            # enqueue: a stale mark from a previous dispatch is cleared
+            # by that dispatch's finally)
+            t_receive = tracer.ingress_mark
+            if t_receive is not None and t_receive > t_enqueue:
+                t_receive = None
             self._pending.setdefault(name, []).append(
-                (trace_id, time.perf_counter())
+                (trace_id, t_enqueue, t_receive)
             )
             self._pending_count += 1
             self._live[name] = self._live.get(name, 0) + 1
@@ -387,7 +431,7 @@ class UpdateTraceBook:
             entries = self._pending.get(name)
             if not entries:
                 return
-            for i, (tid, _t_enqueue) in enumerate(entries):
+            for i, (tid, *_times) in enumerate(entries):
                 if tid == trace_id:
                     entries.pop(i)
                     self._pending_count -= 1
@@ -413,12 +457,13 @@ class UpdateTraceBook:
                 self._pending_count -= len(entries)
                 if out is None:
                     out = []
-                for trace_id, t_enqueue in entries:
+                for trace_id, t_enqueue, t_receive in entries:
                     out.append(
                         {
                             "trace_id": trace_id,
                             "doc": name,
                             "t_enqueue": t_enqueue,
+                            "t_receive": t_receive,
                             "t_drain": t_drain,
                         }
                     )
@@ -439,6 +484,7 @@ class UpdateTraceBook:
             for trace in traces:
                 trace_id = trace["trace_id"]
                 name = trace["doc"]
+                t_receive = trace.get("t_receive")
                 stages = (
                     ("queue_wait", trace["t_enqueue"], trace["t_drain"]),
                     ("build", trace["t_drain"], t_build),
@@ -446,6 +492,12 @@ class UpdateTraceBook:
                     ("device", t_upload, t_dispatch),
                     ("readback", t_dispatch, t_sync),
                 )
+                if t_receive is not None:
+                    # the websocket edge stamped this update: the trace
+                    # opens at the frame receive, not the capture seam
+                    stages = (
+                        ("ingress", t_receive, trace["t_enqueue"]),
+                    ) + stages
                 for stage, s0, s1 in stages:
                     tracer.add_span(
                         f"update.{stage}", s0, s1, trace_id=trace_id, doc=name
@@ -521,7 +573,13 @@ class UpdateTraceBook:
             self.slow_flush_ms if self.slow_flush_ms is not None else tracer.slow_ms
         )
         for trace in entries:
-            e2e_ms = (t_now - trace["t_enqueue"]) * 1000.0
+            # the trace opens at the websocket receive when the ingress
+            # stage exists, else at the capture seam — either way the
+            # stage spans partition [t_start, t_now] exactly
+            t_start = trace.get("t_receive")
+            if t_start is None:
+                t_start = trace["t_enqueue"]
+            e2e_ms = (t_now - t_start) * 1000.0
             tracer.add_span(
                 "update.broadcast",
                 trace["t_sync"],
@@ -532,7 +590,7 @@ class UpdateTraceBook:
             )
             if hist is not None:
                 hist.observe(max(t_now - trace["t_sync"], 0.0), stage="broadcast")
-                hist.observe(max(t_now - trace["t_enqueue"], 0.0), stage="total")
+                hist.observe(max(t_now - t_start, 0.0), stage="total")
             if (
                 slow_ms is not None
                 and e2e_ms >= slow_ms

@@ -1,0 +1,291 @@
+"""Wire-path telemetry: the websocket edge of the observation boundary.
+
+PR 4 lit the merge path from the capture seam to broadcast; this module
+lights the other half of the request path — the socket edge. One
+process-global collector (same singleton pattern as `get_tracer` /
+`get_flight_recorder`) that the hot-path seams write into:
+
+- per-`MessageType` ingress/egress message + byte counters and
+  handle-latency histograms (`Connection.handle_message` →
+  `MessageReceiver`),
+- sync-step latency by step (step1/step2/update) and auth
+  (Auth-frame → hook chain complete) latency,
+- per-connection send-queue depth (summed live gauge), the high-water
+  mark, and backpressure-watermark crossings
+  (`CallbackWebSocketTransport`),
+- socket churn: sockets opened/closed and close-code counters
+  (`ClientConnection` / the websocket host),
+- mini_redis pub/sub fan-out counters (publishes, deliveries, injected
+  drops) so the cross-instance path is countable in tests and dev.
+
+Disabled by default: every instrumentation site costs one attribute
+read + truth test until the `Metrics` extension (or a test) calls
+`enable()`. The metric objects are the plain primitives from
+`metrics.py`; `Metrics` adopts them into its registry via
+`MetricsRegistry.register`, so they render on `/metrics` with the rest
+of the exposition. Errors feed the SLO engine's error-rate objective
+(`observability/slo.py`).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Iterable, Optional
+
+from ..protocol.message import MessageType
+from .metrics import Counter, Gauge, Histogram
+
+# var-uint sync submessage ids (protocol/sync.py) -> label values
+_SYNC_STEP_NAMES = {0: "step1", 1: "step2", 2: "update"}
+
+# queue depth at/above which a send() counts as a backpressure event
+# (per crossing, not per queued frame: the counter increments when a
+# connection's queue climbs past the watermark, and re-arms once it
+# drains below)
+DEFAULT_BACKPRESSURE_WATERMARK = 64
+
+
+def message_type_name(message_type: int) -> str:
+    try:
+        return MessageType(message_type).name
+    except ValueError:
+        return f"unknown_{int(message_type)}"
+
+
+class WireTelemetry:
+    """Socket-edge counters/gauges/histograms, shared process-wide."""
+
+    def __init__(self, backpressure_watermark: int = DEFAULT_BACKPRESSURE_WATERMARK) -> None:
+        self.enabled = False
+        self.backpressure_watermark = backpressure_watermark
+        self.messages_in = Counter(
+            "hocuspocus_wire_messages_in_total",
+            "Inbound websocket messages handled, by MessageType",
+        )
+        self.messages_out = Counter(
+            "hocuspocus_wire_messages_out_total",
+            "Outbound websocket messages sent, by MessageType",
+        )
+        self.bytes_in = Counter(
+            "hocuspocus_wire_bytes_in_total",
+            "Inbound websocket payload bytes, by MessageType",
+        )
+        self.bytes_out = Counter(
+            "hocuspocus_wire_bytes_out_total",
+            "Outbound websocket payload bytes, by MessageType",
+        )
+        self.handle_seconds = Histogram(
+            "hocuspocus_wire_handle_seconds",
+            "Inbound message handle latency (decode -> dispatch done), by MessageType",
+        )
+        self.sync_step_seconds = Histogram(
+            "hocuspocus_wire_sync_step_seconds",
+            "Sync submessage handle latency by step (step1/step2/update)",
+        )
+        self.auth_seconds = Histogram(
+            "hocuspocus_wire_auth_seconds",
+            "Auth frame arrival -> onConnect/onAuthenticate hook chain complete",
+        )
+        self.errors = Counter(
+            "hocuspocus_wire_errors_total",
+            "Message-handling failures that closed a document channel, by kind",
+        )
+        self.sockets_opened = Counter(
+            "hocuspocus_wire_sockets_opened_total",
+            "Client sockets (ClientConnection sessions) opened",
+        )
+        self.sockets_closed = Counter(
+            "hocuspocus_wire_sockets_closed_total",
+            "Client sockets closed, by websocket close code",
+        )
+        self.channel_closes = Counter(
+            "hocuspocus_wire_channel_closes_total",
+            "Per-document channel closes, by close code",
+        )
+        self.send_queue_depth = Gauge(
+            "hocuspocus_wire_send_queue_depth",
+            "Frames queued across live transports (summed)",
+            fn=self._total_queue_depth,
+        )
+        self.send_queue_peak = Gauge(
+            "hocuspocus_wire_send_queue_peak",
+            "Deepest single-transport send queue observed since start",
+        )
+        self.backpressure_events = Counter(
+            "hocuspocus_wire_backpressure_total",
+            "Send-queue watermark crossings (queue climbed past the watermark)",
+        )
+        self.pubsub_publishes = Counter(
+            "hocuspocus_wire_pubsub_publishes_total",
+            "mini_redis PUBLISH commands handled",
+        )
+        self.pubsub_deliveries = Counter(
+            "hocuspocus_wire_pubsub_deliveries_total",
+            "mini_redis messages fanned out to subscribers",
+        )
+        self.pubsub_dropped = Counter(
+            "hocuspocus_wire_pubsub_dropped_total",
+            "mini_redis publishes dropped by fault injection",
+        )
+        # live transports (weak: an abandoned transport must not leak
+        # through the gauge); per-transport watermark armed state rides
+        # in the map value
+        self._transports: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        # egress header-parse cache (see record_egress_frame): identity
+        # of the last frame parsed + its type (strong ref on purpose —
+        # object identity is only trustworthy while the object lives)
+        self._egress_last_frame: Optional[bytes] = None
+        self._egress_last_type: int = -1
+
+    def enable(self) -> "WireTelemetry":
+        self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    # -- ingress / egress ----------------------------------------------------
+
+    def record_ingress(self, message_type: int, nbytes: int, seconds: float) -> None:
+        name = message_type_name(message_type)
+        self.messages_in.inc(type=name)
+        self.bytes_in.inc(nbytes, type=name)
+        self.handle_seconds.observe(seconds, type=name)
+
+    def record_egress(self, message_type: int, nbytes: int) -> None:
+        name = message_type_name(message_type)
+        self.messages_out.inc(type=name)
+        self.bytes_out.inc(nbytes, type=name)
+
+    def record_egress_frame(self, data: bytes) -> None:
+        """Egress accounting from a raw frame. Broadcasts send ONE frame
+        object to N connections, so the header parse is cached by
+        object identity — a 10k-subscriber fan-out parses once, not
+        10k times."""
+        if data is self._egress_last_frame:
+            message_type = self._egress_last_type
+        else:
+            try:
+                from ..protocol.frames import parse_frame_header
+
+                _name, message_type, _offset = parse_frame_header(data)
+            except Exception:
+                return
+            self._egress_last_frame = data
+            self._egress_last_type = message_type
+        self.record_egress(message_type, len(data))
+
+    def record_sync_step(self, sync_type: int, seconds: float) -> None:
+        step = _SYNC_STEP_NAMES.get(int(sync_type), f"unknown_{int(sync_type)}")
+        self.sync_step_seconds.observe(seconds, step=step)
+
+    def record_auth(self, seconds: float, ok: bool) -> None:
+        self.auth_seconds.observe(seconds, outcome="ok" if ok else "denied")
+
+    def record_error(self, kind: str) -> None:
+        self.errors.inc(kind=kind)
+
+    # -- connection churn ----------------------------------------------------
+
+    def record_socket_opened(self) -> None:
+        self.sockets_opened.inc()
+
+    def record_socket_closed(self, code: int) -> None:
+        self.sockets_closed.inc(code=str(int(code)))
+
+    def record_channel_close(self, code: Optional[int]) -> None:
+        self.channel_closes.inc(code=str(int(code)) if code is not None else "none")
+
+    # -- send queues ---------------------------------------------------------
+
+    def track_transport(self, transport) -> None:
+        """Register a live transport whose `queue.qsize()` feeds the
+        depth gauge. Weakly held — GC'd transports fall out on their
+        own; `untrack_transport` drops them eagerly at close."""
+        self._transports[transport] = {"armed": True}
+
+    def untrack_transport(self, transport) -> None:
+        self._transports.pop(transport, None)
+
+    def note_send_queued(self, transport) -> None:
+        """Called after a frame is queued: updates the peak gauge and
+        counts watermark crossings (once per excursion)."""
+        try:
+            depth = transport.queue.qsize()
+        except Exception:
+            return
+        if depth > self.send_queue_peak.value():
+            self.send_queue_peak.set(depth)
+        entry = self._transports.get(transport)
+        if entry is None:
+            return
+        if depth >= self.backpressure_watermark:
+            if entry["armed"]:
+                entry["armed"] = False
+                self.backpressure_events.inc()
+        elif depth <= self.backpressure_watermark // 2:
+            entry["armed"] = True
+
+    def _total_queue_depth(self) -> int:
+        total = 0
+        for transport in list(self._transports):
+            try:
+                total += transport.queue.qsize()
+            except Exception:
+                continue
+        return total
+
+    # -- pub/sub -------------------------------------------------------------
+
+    def record_publish(self, delivered: int, dropped: bool = False) -> None:
+        if dropped:
+            self.pubsub_dropped.inc()
+            return
+        self.pubsub_publishes.inc()
+        if delivered:
+            self.pubsub_deliveries.inc(delivered)
+
+    # -- registry binding ----------------------------------------------------
+
+    def metrics(self) -> Iterable:
+        """Every metric object, for MetricsRegistry.register adoption."""
+        return (
+            self.messages_in,
+            self.messages_out,
+            self.bytes_in,
+            self.bytes_out,
+            self.handle_seconds,
+            self.sync_step_seconds,
+            self.auth_seconds,
+            self.errors,
+            self.sockets_opened,
+            self.sockets_closed,
+            self.channel_closes,
+            self.send_queue_depth,
+            self.send_queue_peak,
+            self.backpressure_events,
+            self.pubsub_publishes,
+            self.pubsub_deliveries,
+            self.pubsub_dropped,
+        )
+
+    # -- reading (bench / tests) ---------------------------------------------
+
+    def totals(self) -> dict:
+        """Aggregate snapshot for the bench's wire_load pass."""
+        return {
+            "messages_in": sum(self.messages_in._values.values()),
+            "messages_out": sum(self.messages_out._values.values()),
+            "bytes_in": sum(self.bytes_in._values.values()),
+            "bytes_out": sum(self.bytes_out._values.values()),
+            "send_queue_peak": self.send_queue_peak.value(),
+            "backpressure_events": sum(self.backpressure_events._values.values()),
+            "errors": sum(self.errors._values.values()),
+        }
+
+
+_default = WireTelemetry()
+
+
+def get_wire_telemetry() -> WireTelemetry:
+    return _default

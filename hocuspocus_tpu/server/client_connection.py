@@ -8,9 +8,11 @@ hook chain passes; then a `Connection` is created and the queue replayed.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import Any, Callable, Optional
 
+from ..observability.wire import get_wire_telemetry
 from ..protocol.close_events import (
     CloseEvent,
     FORBIDDEN,
@@ -48,6 +50,9 @@ class ClientConnection:
         self.hook_payloads: dict[str, Payload] = {}
         self.callbacks: dict[str, list] = {"on_close": []}
         self._closed = False
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            wire.record_socket_opened()
 
     def on_close(self, callback: Callable) -> "ClientConnection":
         self.callbacks["on_close"].append(callback)
@@ -61,6 +66,13 @@ class ClientConnection:
         if self._closed:
             return
         self._closed = True
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            # socket-level churn by close code: 1000/1001 are normal
+            # departures, everything else is the abnormal-close signal
+            # the SLO error-rate objective watches
+            wire.record_socket_closed(code)
+            wire.untrack_transport(self.transport)
         self.close(CloseEvent(code, reason))
 
     # -- connection establishment -----------------------------------------
@@ -205,6 +217,8 @@ class ClientConnection:
             token = tmp.read_var_string()
 
             hook_payload = self.hook_payloads[document_name]
+            wire = get_wire_telemetry()
+            auth_started = time.perf_counter() if wire.enabled else None
             try:
                 def merge_context(context_additions: Any) -> None:
                     if isinstance(context_additions, dict):
@@ -226,6 +240,8 @@ class ClientConnection:
                     ),
                     merge_context,
                 )
+                if auth_started is not None:
+                    wire.record_auth(time.perf_counter() - auth_started, ok=True)
                 hook_payload.connection_config.is_authenticated = True
                 message = OutgoingMessage(document_name).write_authenticated(
                     hook_payload.connection_config.read_only
@@ -233,6 +249,8 @@ class ClientConnection:
                 self.transport.send(message.to_bytes())
                 await self._set_up_new_connection(document_name)
             except Exception as error:
+                if auth_started is not None:
+                    wire.record_auth(time.perf_counter() - auth_started, ok=False)
                 reason = getattr(error, "reason", None) or (
                     getattr(getattr(error, "event", None), "reason", None)
                 )

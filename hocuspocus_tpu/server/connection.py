@@ -2,8 +2,11 @@
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional
 
+from ..observability.tracing import get_tracer
+from ..observability.wire import get_wire_telemetry
 from ..protocol.close_events import CloseError, CloseEvent, RESET_CONNECTION
 from ..protocol.message import IncomingMessage, OutgoingMessage
 from . import logger
@@ -66,6 +69,12 @@ class Connection:
             self.transport.send(message)
         except Exception:
             self.close()
+            return
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            # identity-cached header parse: a broadcast fans the SAME
+            # frame object to every connection, paying one parse total
+            wire.record_egress_frame(message)
 
     def send_stateless(self, payload: str) -> None:
         message = OutgoingMessage(self.document.name).write_stateless(payload)
@@ -75,6 +84,11 @@ class Connection:
         """Graceful close of this document channel (socket stays open —
         other documents may be multiplexed on it)."""
         if self.document.has_connection(self):
+            wire = get_wire_telemetry()
+            if wire.enabled:
+                wire.record_channel_close(
+                    event.code if event is not None else None
+                )
             self.document.remove_connection(self)
             for callback in self.callbacks["on_close"]:
                 callback(self.document, event)
@@ -97,10 +111,22 @@ class Connection:
         if document_name != self.document.name:
             return
         message.write_var_string(document_name)
+        wire = get_wire_telemetry()
+        tracer = get_tracer()
+        mark = None
+        if tracer.enabled:
+            # ingress mark: a lifecycle trace stamped during this
+            # dispatch (capture seam, same call stack) opens at the
+            # frame receive — the update.ingress stage covers ws
+            # receive -> decode -> apply -> capture (cleared in the
+            # finally so a later non-websocket stamp can't adopt it)
+            mark = tracer.ingress_mark = time.perf_counter()
         try:
             await self.callbacks["before_handle_message"](self, data)
             await MessageReceiver(message).apply(self.document, self)
         except CloseError as error:
+            if wire.enabled:
+                wire.record_error("close_error")
             logger.log_error(
                 f"closing connection {self.socket_id} (while handling "
                 f"{document_name}): {error.event.reason}"
@@ -109,8 +135,13 @@ class Connection:
         except Exception as error:
             code = getattr(error, "code", RESET_CONNECTION.code)
             reason = getattr(error, "reason", RESET_CONNECTION.reason)
+            if wire.enabled:
+                wire.record_error("exception")
             logger.log_error(
                 f"closing connection {self.socket_id} (while handling "
                 f"{document_name}) because of exception: {error!r}"
             )
             self.close(CloseEvent(code, reason))
+        finally:
+            if mark is not None:
+                tracer.ingress_mark = None

@@ -7,6 +7,7 @@ SyncStep1), awareness, stateless, read-only SyncStatus acks.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 from ..crdt import snapshot, snapshot_contains_update
@@ -23,6 +24,7 @@ from ..protocol.sync import (
     write_sync_step2,
 )
 from ..observability.tracing import get_tracer
+from ..observability.wire import get_wire_telemetry
 from .document import Document
 from . import logger as _logger_mod
 
@@ -60,6 +62,33 @@ class MessageReceiver:
         message_type = message.read_var_uint()
         if span is not None:
             span.set("type", int(message_type))
+        wire = get_wire_telemetry()
+        # ingress accounting covers the SOCKET edge only: redis-bus
+        # replicated messages also flow through this receiver
+        # (extensions/redis.py, connection=None) but can never produce
+        # a wire error, so counting them would dilute the error-rate
+        # SLO's denominator and hide real client-facing breaches
+        if wire.enabled and connection is not None:
+            started = time.perf_counter()
+            try:
+                await self._dispatch(message, message_type, document, connection, reply)
+            finally:
+                wire.record_ingress(
+                    int(message_type),
+                    len(message.decoder.buf),
+                    time.perf_counter() - started,
+                )
+        else:
+            await self._dispatch(message, message_type, document, connection, reply)
+
+    async def _dispatch(
+        self,
+        message: IncomingMessage,
+        message_type: int,
+        document: Document,
+        connection=None,
+        reply: Optional[Callable[[bytes], None]] = None,
+    ) -> None:
         empty_message_length = message.length
 
         if message_type in (MessageType.Sync, MessageType.SyncReply):
@@ -117,6 +146,31 @@ class MessageReceiver:
             )
 
     async def read_sync_message(
+        self,
+        message: IncomingMessage,
+        document: Document,
+        connection=None,
+        reply: Optional[Callable[[bytes], None]] = None,
+        request_first_sync: bool = True,
+    ) -> int:
+        wire = get_wire_telemetry()
+        if not wire.enabled or connection is None:
+            # socket-edge latency only (see apply: redis-bus messages
+            # arrive with connection=None)
+            return await self._read_sync_message(
+                message, document, connection, reply, request_first_sync
+            )
+        started = time.perf_counter()
+        sync_type = await self._read_sync_message(
+            message, document, connection, reply, request_first_sync
+        )
+        # sync-step latency by submessage: step1 covers the SyncStep2
+        # reply build (device state gather on the plane path), step2/
+        # update cover the CPU apply
+        wire.record_sync_step(sync_type, time.perf_counter() - started)
+        return sync_type
+
+    async def _read_sync_message(
         self,
         message: IncomingMessage,
         document: Document,

@@ -20,6 +20,8 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, Optional
 
+from ..observability.wire import get_wire_telemetry
+
 
 class CallbackWebSocketTransport:
     """Queue-backed transport over caller-supplied async callables.
@@ -44,6 +46,9 @@ class CallbackWebSocketTransport:
         self.queue: asyncio.Queue = asyncio.Queue()
         self._closed = False
         self._writer_task = asyncio.ensure_future(self._writer())
+        # send-queue depth gauge + backpressure watermark (weakly held;
+        # untracked eagerly at close/abort)
+        get_wire_telemetry().track_transport(self)
 
     @property
     def is_closed(self) -> bool:
@@ -55,6 +60,9 @@ class CallbackWebSocketTransport:
     def send(self, data: bytes) -> None:
         if not self.is_closed:
             self.queue.put_nowait(("data", data))
+            wire = get_wire_telemetry()
+            if wire.enabled:
+                wire.note_send_queued(self)
 
     def close(self, code: int = 1000, reason: str = "") -> None:
         if not self._closed:
@@ -70,12 +78,15 @@ class CallbackWebSocketTransport:
                 else:
                     code, reason = payload
                     await self._close_async(code, reason)
+                    get_wire_telemetry().untrack_transport(self)
                     return
             except Exception:
                 self._closed = True
+                get_wire_telemetry().untrack_transport(self)
                 return
 
     def abort(self) -> None:
         """Tear down without a close frame (the socket is already gone)."""
         self._closed = True
         self._writer_task.cancel()
+        get_wire_telemetry().untrack_transport(self)

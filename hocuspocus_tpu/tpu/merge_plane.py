@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from ..aio import spawn_tracked
+from ..observability.device_watch import CompileTracker, pytree_nbytes
 from ..observability.flight_recorder import get_flight_recorder
 from ..observability.tracing import UpdateTraceBook, get_tracer
 from ..server.types import Extension, Payload
@@ -365,6 +366,20 @@ class MergePlane:
         # → device → readback, and the broadcast pass closes them. One
         # truth test per flush batch when tracing is idle.
         self.update_traces = UpdateTraceBook()
+        # device runtime watch (observability/device_watch.py): every
+        # jitted dispatch — warmup, canary, live flush batch — is
+        # classified fresh-compile vs cache-hit per (site, shape), and
+        # fresh compiles past the warm grid raise the recompile-storm
+        # alarm. device_stats accumulates the HBM/stall side: readback-
+        # barrier time and the biggest single-cycle upload.
+        self.compile_watch = CompileTracker()
+        self.device_stats: dict[str, float] = {
+            "readback_stall_ms_total": 0.0,
+            "readback_stalls": 0,
+            "upload_bytes_peak": 0,
+        }
+        # short-TTL memo for memory_stats (one scrape = one pytree walk)
+        self._memory_stats_cache: "tuple[float, Optional[dict]]" = (0.0, None)
 
     # -- arena dispatch ----------------------------------------------------
 
@@ -950,19 +965,31 @@ class MergePlane:
         lock acquisition per shape), a bare int k for the dense
         (k, num_docs) shape, or nothing for the whole grid.
         """
+        full_grid = shape is None
         shapes = [shape] if shape is not None else self.warmup_shapes()
         with self._step_lock:
             for entry in shapes:
                 k, b = entry if isinstance(entry, tuple) else (entry, self.num_docs)
                 if b >= self.num_docs:
                     ops = self._empty_batch(k)
-                    self.state, count = self._step_fn()(self.state, ops)
+                    with self.compile_watch.track(
+                        "integrate_dense", (k, self.num_docs), warmup=True
+                    ):
+                        self.state, count = self._step_fn()(self.state, ops)
+                        int(count)  # completion barrier (data-dependent)
                 else:
                     ops, slots = self._empty_sparse_batch(k, b)
-                    self.state, count = self._sparse_step_fn()(
-                        self.state, ops, slots
-                    )
-                int(count)  # completion barrier (data-dependent)
+                    with self.compile_watch.track(
+                        "integrate_sparse", (k, b), warmup=True
+                    ):
+                        self.state, count = self._sparse_step_fn()(
+                            self.state, ops, slots
+                        )
+                        int(count)  # completion barrier (data-dependent)
+        if full_grid:
+            # the whole grid is compiled: any later fresh compile means
+            # the flush shapes drifted off the warmed buckets
+            self.compile_watch.mark_warmed()
 
     def canary_probe(self) -> float:
         """One tiny no-op integrate + data-dependent readback: the plane
@@ -977,12 +1004,16 @@ class MergePlane:
             if self.num_docs > 1:
                 # (K_max, 1): the first entry of the warmup grid — a
                 # warmed plane's probes never pay a compile
-                ops, slots = self._empty_sparse_batch(self._k_buckets()[-1], 1)
-                self.state, count = self._sparse_step_fn()(self.state, ops, slots)
+                k_max = self._k_buckets()[-1]
+                ops, slots = self._empty_sparse_batch(k_max, 1)
+                with self.compile_watch.track("integrate_sparse", (k_max, 1)):
+                    self.state, count = self._sparse_step_fn()(self.state, ops, slots)
+                    int(count)  # completion barrier (data-dependent readback)
             else:
                 ops = self._empty_batch(1)
-                self.state, count = self._step_fn()(self.state, ops)
-            int(count)  # completion barrier (data-dependent readback)
+                with self.compile_watch.track("integrate_dense", (1, self.num_docs)):
+                    self.state, count = self._step_fn()(self.state, ops)
+                    int(count)  # completion barrier (data-dependent readback)
         return time.perf_counter() - started
 
     def _k_buckets(self) -> list[int]:
@@ -1142,6 +1173,17 @@ class MergePlane:
             else:
                 self.state, _count = step(self.state, *step_args)
             t_dispatch = time.perf_counter()
+            # compile-event classification from the timestamps already
+            # taken: a first dispatch at this (site, shape) paid its
+            # XLA/Mosaic compile inline in t_dispatch - t2
+            if slot_view is None:
+                self.compile_watch.observe(
+                    "integrate_dense", (k, self.num_docs), t_dispatch - t2
+                )
+            else:
+                self.compile_watch.observe(
+                    "integrate_sparse", (k, b), t_dispatch - t2
+                )
             if cycle_traces:
                 trace_batches.append((cycle_traces, t1, t2, t_dispatch))
             total += built
@@ -1157,6 +1199,13 @@ class MergePlane:
             t3 = time.perf_counter()
             self._sync_health()
             t_sync = time.perf_counter()
+            # readback-barrier stall: the host time this cycle spent
+            # blocked on the device before results were visible
+            self.device_stats["readback_stall_ms_total"] += (t_sync - t3) * 1000.0
+            self.device_stats["readback_stalls"] += 1
+            if upload_bytes > self.device_stats["upload_bytes_peak"]:
+                self.device_stats["upload_bytes_peak"] = upload_bytes
+            self._memory_stats_cache = (0.0, None)  # staging/stalls moved
             if trace_batches:
                 # the cycle's single readback barrier closes every
                 # in-flight trace's device/readback stages
@@ -1175,6 +1224,33 @@ class MergePlane:
             )
         self.total_integrated += total
         return total
+
+    def memory_stats(self) -> dict:
+        """Device/host memory footprint (HBM watch): arena state bytes
+        (constant after construction), allocated staging bytes, the
+        biggest single-cycle upload and the cumulative readback-stall
+        time. Array `.nbytes` reads only metadata — no transfer. The
+        pytree walks are cached briefly: one /metrics scrape reads five
+        gauges off this dict and must pay one walk, not five (x shards
+        on the summed variant)."""
+        now = time.monotonic()
+        cached_at, cached = self._memory_stats_cache
+        if cached is not None and now - cached_at < 0.5:
+            return cached
+        staging_bytes = 0
+        for staging in self._staging or ():
+            staging_bytes += pytree_nbytes(staging.fields) + staging.slots.nbytes
+        stats = {
+            "arena_bytes": pytree_nbytes(self.state),
+            "staging_bytes": staging_bytes,
+            "upload_bytes_peak": self.device_stats["upload_bytes_peak"],
+            "readback_stall_ms_total": round(
+                self.device_stats["readback_stall_ms_total"], 3
+            ),
+            "readback_stalls": self.device_stats["readback_stalls"],
+        }
+        self._memory_stats_cache = (now, stats)
+        return stats
 
     def _sync_health(self) -> None:
         """ONE combined device->host readback per flush cycle.
@@ -1806,6 +1882,9 @@ class TpuMergeExtension(Extension):
 
                     _logger_mod.log_error("plane compile warmup failed (continuing)")
                     return
+            # from here every flush shape is compiled: a later fresh
+            # compile is the recompile-storm signal
+            self.plane.compile_watch.mark_warmed()
             if self.serving is not None:
                 try:
                     async with self.plane.flush_lock:

@@ -28,6 +28,7 @@ Safety model:
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
@@ -140,8 +141,15 @@ class PlaneServing:
                 self._length_cache = plane.last_lengths
                 self._overflow_cache = plane.last_overflows
             else:
+                t0 = time.perf_counter()
                 self._length_cache = np.asarray(plane.state.length)
                 self._overflow_cache = np.asarray(plane.state.overflow)
+                # cache-miss path only: a real device→host transfer,
+                # charged to the same stall meter as the flush barrier
+                plane.device_stats["readback_stall_ms_total"] += (
+                    time.perf_counter() - t0
+                ) * 1000.0
+                plane.device_stats["readback_stalls"] += 1
             self._validated_cache = plane.validated_units.copy()
             self._gen_cache = None if plane.last_gen is None else plane.last_gen.copy()
 
@@ -411,8 +419,16 @@ class PlaneServing:
         plane = self.plane
         width = next(w for w in self._gather_widths() if w >= len(chunk))
         with plane._step_lock:  # never gather donated buffers mid-flush
+            t0 = time.perf_counter()
             fused = self._gather_rows(chunk + [chunk[0]] * (width - len(chunk)))
             gens = [int(plane.slot_gen[slot]) for slot in chunk]
+            # tombstone gathers are serve-path device readbacks: count
+            # them into the stall meter so /metrics shows how much host
+            # time sync serving spends blocked on the device
+            plane.device_stats["readback_stall_ms_total"] += (
+                time.perf_counter() - t0
+            ) * 1000.0
+            plane.device_stats["readback_stalls"] += 1
         rle = plane.arena == "rle"
         for i, slot in enumerate(chunk):
             sel = np.nonzero(fused[0, i])[0]

@@ -32,6 +32,10 @@ from hocuspocus_tpu.observability.metrics import _fmt_value
 from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
 
 STAGES = ("queue_wait", "build", "upload", "device", "readback", "broadcast")
+# updates arriving through the websocket edge additionally carry the
+# ingress stage (ws receive -> decode -> apply -> capture), so the e2e
+# span truly runs socket -> broadcast
+WS_STAGES = ("ingress",) + STAGES
 
 
 def _make_update(text: str = "hello") -> bytes:
@@ -110,6 +114,29 @@ def test_trace_book_disabled_costs_nothing():
     assert not plane.update_traces.active()
     plane.flush()
     assert plane.update_traces.finish("quiet") == 0
+
+
+async def test_ingress_mark_is_isolated_per_dispatch_task():
+    """Concurrent dispatches from different sockets run as different
+    asyncio tasks whose hook chains await mid-dispatch: one task's
+    ingress mark must never be adopted or cleared by another's
+    (regression: the mark was once a shared tracer attribute)."""
+    import asyncio
+
+    tracer = Tracer(enabled=True)
+    observed = {}
+
+    async def dispatch(name: str, mark: float) -> None:
+        tracer.ingress_mark = mark
+        try:
+            await asyncio.sleep(0.01)  # hook-chain await: tasks interleave
+            observed[name] = tracer.ingress_mark
+        finally:
+            tracer.ingress_mark = None
+
+    await asyncio.gather(dispatch("a", 111.0), dispatch("b", 222.0))
+    assert observed == {"a": 111.0, "b": 222.0}
+    assert tracer.ingress_mark is None
 
 
 # -- Perfetto / Chrome trace export --------------------------------------------
@@ -397,9 +424,13 @@ def test_flight_recorder_records_plane_lifecycle():
 async def test_traced_update_served_from_debug_endpoints():
     """Acceptance: with tracing enabled, a single client update produces
     a causally-linked trace retrievable from /debug/trace as valid
-    Chrome trace-event JSON, and hocuspocus_tpu_update_e2e_seconds
-    appears in /metrics with per-stage labels; the flight recorder
-    answers /debug/docs and /debug/docs/<name>."""
+    Chrome trace-event JSON — including the update.ingress stage, since
+    the update arrived through the websocket edge — and
+    hocuspocus_tpu_update_e2e_seconds appears in /metrics with
+    per-stage labels; the flight recorder answers /debug/docs and
+    /debug/docs/<name>. The span-sum invariant covers all SEVEN stages:
+    they still sum exactly to the e2e latency, now measured from the
+    frame receive."""
     from hocuspocus_tpu.tpu import TpuMergeExtension
 
     tracer = enable_tracing(max_spans=2048)
@@ -426,7 +457,7 @@ async def test_traced_update_served_from_debug_endpoints():
             complete = [
                 tid
                 for tid, names in by_id.items()
-                if names == {f"update.{st}" for st in STAGES}
+                if names == {f"update.{st}" for st in WS_STAGES}
             ]
             assert complete, by_id
             return complete[0]
@@ -453,7 +484,7 @@ async def test_traced_update_served_from_debug_endpoints():
                 if e["name"].startswith("update.")
                 and e.get("args", {}).get("trace_id") == trace_id
             ]
-            assert len(update_events) == len(STAGES)
+            assert len(update_events) == len(WS_STAGES)
             for event in update_events:
                 assert event["ph"] in ("X", "i")
                 assert "ts" in event and "pid" in event and "tid" in event
@@ -461,7 +492,7 @@ async def test_traced_update_served_from_debug_endpoints():
             async with session.get(f"{server.http_url}/metrics") as response:
                 body = await response.text()
             assert 'hocuspocus_tpu_update_e2e_seconds_bucket{le=' in body
-            for stage in STAGES + ("total",):
+            for stage in WS_STAGES + ("total",):
                 assert f'stage="{stage}"' in body
 
             async with session.get(

@@ -1,0 +1,564 @@
+"""Socket-to-silicon observability: wire-path telemetry, the SLO
+burn-rate engine, build-info/exposition conformance and connection-churn
+coverage.
+
+The reference has no metrics at all (SURVEY.md §5.5); these tests cover
+the observation boundary this build extends in both directions — from
+the capture seam out to the websocket edge, and up into the SLO layer
+that decides "healthy enough for millions of users".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from hocuspocus_tpu.observability import (
+    Counter,
+    Gauge,
+    Histogram,
+    Metrics,
+    MetricsRegistry,
+    SloEngine,
+    counter_ratio_slo,
+    fraction_slo,
+    get_flight_recorder,
+    get_wire_telemetry,
+    latency_slo,
+)
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _totals(counter: Counter) -> float:
+    return sum(counter._values.values())
+
+
+# -- wire-path telemetry (live server) -----------------------------------------
+
+
+async def test_wire_counters_cover_ingress_egress_and_sync_steps():
+    """A provider's sync handshake + one edit light the per-MessageType
+    ingress/egress counters, byte counters, handle-latency histogram
+    and the sync-step latency histogram."""
+    wire = get_wire_telemetry()
+    before_in = _totals(wire.messages_in)
+    before_out = _totals(wire.messages_out)
+    before_bytes_in = _totals(wire.bytes_in)
+    handle_before = wire.handle_seconds.count
+    step1_before = wire.sync_step_seconds.series_count(step="step1")
+    update_before = wire.sync_step_seconds.series_count(step="update")
+    auth_before = wire.auth_seconds.series_count(outcome="ok")
+
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics])
+    provider = new_provider(server, name="wire-doc")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "wire me")
+
+        def counted():
+            assert wire.sync_step_seconds.series_count(step="update") > update_before
+
+        await retryable_assertion(counted)
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+    assert _totals(wire.messages_in) > before_in
+    assert _totals(wire.messages_out) > before_out
+    assert _totals(wire.bytes_in) > before_bytes_in
+    assert wire.handle_seconds.count > handle_before
+    # the handshake exercised SyncStep1 and the auth hook chain
+    assert wire.sync_step_seconds.series_count(step="step1") > step1_before
+    assert wire.auth_seconds.series_count(outcome="ok") > auth_before
+    # per-type labels exist (Sync rides the handshake + the edit)
+    assert wire.messages_in.value(type="Sync") > 0
+
+
+async def test_connection_churn_close_codes_and_no_queue_leaks():
+    """Connection churn (sockets opened/closed by close code) is
+    counted, the send-queue depth gauge returns to zero after an abrupt
+    mid-session disconnect, and no transport leaks into the gauge's
+    tracked set (counter-leak regression for mid-message disconnects)."""
+    wire = get_wire_telemetry()
+    opened_before = _totals(wire.sockets_opened)
+    closed_before = _totals(wire.sockets_closed)
+
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics])
+    providers = [new_provider(server, name=f"churn-{i}") for i in range(3)]
+    try:
+        await wait_synced(*providers)
+        for i, provider in enumerate(providers):
+            provider.document.get_text("t").insert(0, f"edit {i}")
+        # abrupt teardown with edits potentially still in flight
+        for provider in providers:
+            provider.destroy()
+
+        def churned():
+            opened = _totals(wire.sockets_opened) - opened_before
+            closed = _totals(wire.sockets_closed) - closed_before
+            assert opened >= 3
+            # every socket this test opened was also counted closed —
+            # nothing leaks open in the churn accounting
+            assert closed >= opened
+
+        await retryable_assertion(churned)
+    finally:
+        for provider in providers:
+            provider.destroy()
+        await server.destroy()
+
+    def drained():
+        # the depth gauge reads live queues: after every socket died,
+        # it must return to zero (no stranded transports in the gauge)
+        assert wire.send_queue_depth.value() == 0
+
+    await retryable_assertion(drained)
+    # close codes are labelled: at least one labelled series exists and
+    # every label parses as an integer close code
+    codes = [dict(key).get("code") for key in wire.sockets_closed._values]
+    assert codes
+    assert all(code is None or code.lstrip("-").isdigit() for code in codes)
+
+
+async def test_flight_recorder_connect_disconnect_audience_history():
+    """GET /debug/docs/<name> shows connect/disconnect events with the
+    resulting connection count, next to the merge history."""
+    recorder = get_flight_recorder()
+    recorder.forget("audience-doc")
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics])
+    provider = new_provider(server, name="audience-doc")
+    second = None
+    try:
+        await wait_synced(provider)
+        second = new_provider(server, name="audience-doc")
+        await wait_synced(second)
+
+        def connected_twice():
+            events = [
+                e for e in recorder.events("audience-doc") if e["event"] == "connect"
+            ]
+            assert len(events) >= 2
+            return events
+
+        events = await retryable_assertion(connected_twice)
+        assert events[-1]["connections"] == 2
+        second.destroy()
+
+        def disconnected():
+            events = [
+                e
+                for e in recorder.events("audience-doc")
+                if e["event"] == "disconnect"
+            ]
+            assert events
+            return events
+
+        events = await retryable_assertion(disconnected)
+        assert events[-1]["connections"] == 1
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"{server.http_url}/debug/docs/audience-doc"
+            ) as response:
+                payload = json.loads(await response.text())
+        kinds = [e["event"] for e in payload["events"]]
+        assert "connect" in kinds and "disconnect" in kinds
+    finally:
+        provider.destroy()
+        if second is not None:
+            second.destroy()
+        await server.destroy()
+
+
+async def test_mini_redis_pubsub_fanout_counters():
+    from hocuspocus_tpu.net.mini_redis import MiniRedis
+
+    wire = get_wire_telemetry()
+    wire.enable()
+    publishes_before = _totals(wire.pubsub_publishes)
+    deliveries_before = _totals(wire.pubsub_deliveries)
+    dropped_before = _totals(wire.pubsub_dropped)
+
+    redis = await MiniRedis().start()
+    try:
+        sub_reader, sub_writer = await asyncio.open_connection("127.0.0.1", redis.port)
+        sub_writer.write(b"*2\r\n$9\r\nSUBSCRIBE\r\n$4\r\nchan\r\n")
+        await sub_writer.drain()
+        await sub_reader.readexactly(len(b"*3\r\n$9\r\nsubscribe\r\n$4\r\nchan\r\n:1\r\n"))
+
+        pub_reader, pub_writer = await asyncio.open_connection("127.0.0.1", redis.port)
+        pub_writer.write(b"*3\r\n$7\r\nPUBLISH\r\n$4\r\nchan\r\n$5\r\nhello\r\n")
+        await pub_writer.drain()
+        assert await pub_reader.readexactly(4) == b":1\r\n"
+
+        # injected fault: the next publish vanishes and is counted
+        redis.drop_publishes = 1
+        pub_writer.write(b"*3\r\n$7\r\nPUBLISH\r\n$4\r\nchan\r\n$5\r\nlost!\r\n")
+        await pub_writer.drain()
+        assert await pub_reader.readexactly(4) == b":0\r\n"
+
+        assert _totals(wire.pubsub_publishes) - publishes_before == 1
+        assert _totals(wire.pubsub_deliveries) - deliveries_before == 1
+        assert _totals(wire.pubsub_dropped) - dropped_before == 1
+        sub_writer.close()
+        pub_writer.close()
+    finally:
+        await redis.stop()
+
+
+# -- SLO engine (unit) ---------------------------------------------------------
+
+
+def _fake_clock():
+    state = {"now": 0.0}
+
+    def advance(seconds: float) -> None:
+        state["now"] += seconds
+
+    return (lambda: state["now"]), advance
+
+
+def test_slo_burn_rate_multi_window():
+    """30% of events bad against a 1% budget -> burn 30 on both windows
+    once an hour of samples exists; the multi-window rule breaches."""
+    clock, advance = _fake_clock()
+    hist = Histogram("h", "", buckets=(0.01, 0.05, 0.1))
+    engine = SloEngine(sample_interval_s=15.0, clock=clock)
+    engine.add(latency_slo("e2e", hist, threshold_s=0.05, objective=0.99))
+    for _ in range(250):
+        advance(15.0)
+        for _ in range(70):
+            hist.observe(0.005, stage="total")
+        for _ in range(30):
+            hist.observe(0.5, stage="total")
+        engine.sample()
+    status = engine.status()
+    windows = status["slos"]["e2e"]["windows"]
+    assert windows["5m"]["burn_rate"] == pytest.approx(30.0, rel=0.01)
+    assert windows["1h"]["burn_rate"] == pytest.approx(30.0, rel=0.01)
+    assert windows["5m"]["covered_s"] == pytest.approx(300.0, abs=16)
+    assert status["slos"]["e2e"]["breaching"] is True
+    assert status["healthy"] is False
+    # gauges updated at sample time, labelled per (slo, window)
+    assert engine.burn_gauge.value(slo="e2e", window="5m") == pytest.approx(
+        30.0, rel=0.01
+    )
+
+
+def test_slo_short_burst_does_not_breach_long_window():
+    """A 5-minute error burst trips the short window but not the hour
+    window -> no breach (the multi-window rule suppresses blips)."""
+    clock, advance = _fake_clock()
+    total, bad = Counter("t", ""), Counter("b", "")
+    engine = SloEngine(sample_interval_s=15.0, clock=clock)
+    engine.add(counter_ratio_slo("err", total, bad, objective=0.99))
+    for tick in range(240):  # one hour, clean
+        advance(15.0)
+        total.inc(100)
+        engine.sample()
+    for tick in range(20):  # five minutes, 100% bad
+        advance(15.0)
+        total.inc(100)
+        bad.inc(100)
+        engine.sample()
+    status = engine.status()["slos"]["err"]
+    assert status["windows"]["5m"]["burn_rate"] > 14.4
+    assert status["windows"]["1h"]["burn_rate"] < 14.4
+    assert status["breaching"] is False
+
+
+def test_slo_no_traffic_reports_none_and_never_breaches():
+    clock, advance = _fake_clock()
+    hist = Histogram("h", "")
+    engine = SloEngine(sample_interval_s=15.0, clock=clock)
+    engine.add(latency_slo("quiet", hist, threshold_s=0.05))
+    for _ in range(10):
+        advance(15.0)
+        engine.sample()
+    status = engine.status()["slos"]["quiet"]
+    assert status["windows"]["5m"]["burn_rate"] is None
+    assert status["breaching"] is False
+    assert engine.status()["healthy"] is True
+
+
+def test_slo_fraction_probe_counts_sampled_time():
+    clock, advance = _fake_clock()
+    state = {"open": False}
+    engine = SloEngine(sample_interval_s=15.0, clock=clock)
+    engine.add(fraction_slo("breaker", lambda: state["open"], objective=0.99))
+    for tick in range(40):
+        state["open"] = tick >= 20  # open for the second half
+        advance(15.0)
+        engine.sample()
+    stat = engine.status()["slos"]["breaker"]["windows"]["5m"]
+    # the last 5 minutes were fully open -> error rate 1.0, burn 100
+    assert stat["error_rate"] == pytest.approx(1.0)
+    assert stat["burn_rate"] == pytest.approx(100.0)
+
+
+def test_latency_slo_threshold_snaps_to_bucket_bound():
+    """An off-bound threshold snaps to the nearest bucket bound —
+    counting is exact at bounds and silently wrong everywhere else —
+    and the effective value is surfaced in the description."""
+    from hocuspocus_tpu.observability.slo import snap_to_bucket
+
+    hist = Histogram("h", "", buckets=(0.01, 0.05, 0.1))
+    assert snap_to_bucket(hist, 0.06) == 0.05
+    assert snap_to_bucket(hist, 0.09) == 0.1
+    assert snap_to_bucket(hist, 0.05) == 0.05
+    target = latency_slo("snapped", hist, threshold_s=0.06)
+    assert "snapped from 60ms" in target.description
+    # observations in (0.05, 0.06] would be miscounted at an unsnapped
+    # threshold; at the snapped 0.05 bound they are honestly bad
+    for _ in range(10):
+        hist.observe(0.02, stage="total")
+    total, bad = target.collect()
+    assert (total, bad) == (10, 0)
+
+
+async def test_redis_bus_messages_excluded_from_wire_ingress():
+    """Messages applied with connection=None (the redis fan-out path)
+    must not inflate the wire error-rate denominator."""
+    from hocuspocus_tpu.crdt import Doc, encode_state_as_update
+    from hocuspocus_tpu.protocol.message import IncomingMessage, OutgoingMessage
+    from hocuspocus_tpu.server.document import Document
+    from hocuspocus_tpu.server.message_receiver import MessageReceiver
+
+    wire = get_wire_telemetry()
+    wire.enable()
+    before = _totals(wire.messages_in)
+
+    source = Doc()
+    source.get_text("t").insert(0, "bus")
+    frame = (
+        OutgoingMessage("bus-doc")
+        .create_sync_message()
+        .write_update(encode_state_as_update(source))
+    )
+    message = IncomingMessage(frame.to_bytes())
+    message.read_var_string()  # document name, as the redis path does
+    document = Document("bus-doc")
+    await MessageReceiver(message).apply(document, None, reply=lambda data: None)
+    assert str(document.get_text("t")) == "bus"
+    assert _totals(wire.messages_in) == before  # not counted
+
+
+def test_egress_frame_parse_cached_by_identity():
+    """One broadcast frame sent to N connections parses its header
+    once; a different frame re-parses."""
+    from hocuspocus_tpu.protocol.frames import build_update_frame
+    from hocuspocus_tpu.observability.wire import WireTelemetry
+
+    wire = WireTelemetry()
+    wire.enable()
+    frame = build_update_frame("doc", b"\x00\x00")
+    for _ in range(5):
+        wire.record_egress_frame(frame)
+    assert wire.messages_out.value(type="Sync") == 5
+    assert wire._egress_last_frame is frame
+    other = build_update_frame("doc", b"\x01\x00")
+    wire.record_egress_frame(other)
+    assert wire._egress_last_frame is other
+    assert wire.messages_out.value(type="Sync") == 6
+
+
+def test_slo_maybe_sample_respects_cadence():
+    clock, advance = _fake_clock()
+    engine = SloEngine(sample_interval_s=15.0, clock=clock)
+    engine.add(fraction_slo("x", lambda: False))
+    assert engine.maybe_sample() is True
+    assert engine.maybe_sample() is False  # same instant
+    advance(5.0)
+    assert engine.maybe_sample() is False  # under the cadence
+    advance(15.0)
+    assert engine.maybe_sample() is True
+
+
+# -- /debug/slo + health folding (live server) ---------------------------------
+
+
+async def test_debug_slo_endpoint_and_health_agree():
+    """Acceptance: GET /debug/slo returns computed 5m/1h burn rates for
+    the e2e-latency and error-rate SLOs, and /healthz folds the same
+    verdict into the health payload."""
+    metrics = Metrics(slo_sample_interval_s=0.0)  # sample on every read
+    server = await new_hocuspocus(extensions=[metrics])
+    provider = new_provider(server, name="slo-doc")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "healthy traffic")
+        await asyncio.sleep(0)
+        metrics.slo.maybe_sample()  # anchor sample
+        provider.document.get_text("t").insert(5, " more")
+
+        def more_messages():
+            # traffic must exist between two samples for a window delta
+            assert get_wire_telemetry().messages_in.value(type="Sync") > 0
+
+        await retryable_assertion(more_messages)
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/debug/slo") as response:
+                assert response.status == 200
+                payload = json.loads(await response.text())
+
+        assert payload["healthy"] is True
+        for name in ("update_e2e_latency", "wire_error_rate"):
+            slo = payload["slos"][name]
+            assert set(slo["windows"]) == {"5m", "1h"}
+            assert "burn_rate" in slo["windows"]["5m"]
+            assert "burn_rate" in slo["windows"]["1h"]
+            assert slo["breaching"] is False
+        # the error-rate SLO saw real traffic and computed a number
+        err_5m = payload["slos"]["wire_error_rate"]["windows"]["5m"]
+        assert err_5m["total"] > 0
+        assert err_5m["burn_rate"] is not None
+
+        # health folding: the Metrics extension contributes an SLO
+        # section and the top-level verdict agrees
+        health = server.hocuspocus.get_health()
+        assert health["status"] == "ok"
+        slo_health = health["extensions"]["Metrics"]
+        assert slo_health["state"] == "ok"
+        assert slo_health["degraded"] is False
+        assert "update_e2e_latency" in slo_health["slos"]
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+async def test_breaching_slo_degrades_health():
+    """A sustained burning SLO downgrades get_health() to degraded —
+    the SLO story and the supervisor/healthz story agree."""
+    metrics = Metrics(slo_sample_interval_s=0.0)
+    server = await new_hocuspocus(extensions=[metrics])
+    try:
+        await server.hocuspocus.ensure_configured()
+        # synthetic sustained burn: a fake clock walks a full hour of
+        # bad samples (coverage-gated breaching needs real history)
+        clock, advance = _fake_clock()
+        metrics.slo._clock = clock
+        metrics.slo.sample_interval_s = 15.0
+        total, bad = Counter("syn_t", ""), Counter("syn_b", "")
+        metrics.slo.add(counter_ratio_slo("synthetic_burn", total, bad, objective=0.99))
+        for _ in range(250):
+            advance(15.0)
+            total.inc(100)
+            bad.inc(100)
+            metrics.slo.sample()
+        health = server.hocuspocus.get_health()
+        assert health["status"] == "degraded"
+        assert "synthetic_burn" in health["extensions"]["Metrics"]["breaching"]
+    finally:
+        await server.destroy()
+
+
+def test_startup_blip_cannot_breach_without_full_window_coverage():
+    """60s after boot, an error burst must NOT mark the server degraded:
+    the 1h window has no coverage yet, so it can't vote — a load
+    balancer must never drain a freshly restarted instance over a
+    transient reconnect blip."""
+    clock, advance = _fake_clock()
+    total, bad = Counter("t", ""), Counter("b", "")
+    engine = SloEngine(sample_interval_s=15.0, clock=clock)
+    engine.add(counter_ratio_slo("err", total, bad, objective=0.999))
+    for _ in range(4):  # one minute of uptime, 2% errors (burn 20)
+        advance(15.0)
+        total.inc(25)
+        bad.inc(1)
+        engine.sample()
+    status = engine.status()["slos"]["err"]
+    assert status["windows"]["1h"]["burn_rate"] is not None  # burning...
+    assert status["windows"]["1h"]["covered_s"] < 3600
+    assert status["breaching"] is False  # ...but can't page yet
+    # once a full hour of sustained burn exists, it DOES page
+    for _ in range(240):
+        advance(15.0)
+        total.inc(25)
+        bad.inc(1)
+        engine.sample()
+    assert engine.status()["slos"]["err"]["breaching"] is True
+
+
+# -- build info, exposition conformance ----------------------------------------
+
+
+async def test_build_info_and_exposition_content_type():
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics])
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/metrics") as response:
+                assert response.status == 200
+                content_type = response.headers["Content-Type"]
+                body = await response.text()
+        # Prometheus text exposition format version on the wire
+        assert "text/plain" in content_type
+        assert "version=0.0.4" in content_type
+        build_line = next(
+            line
+            for line in body.splitlines()
+            if line.startswith("hocuspocus_tpu_build_info{")
+        )
+        assert 'version="' in build_line
+        assert 'backend="' in build_line
+        assert 'device_count="' in build_line
+        assert build_line.endswith(" 1")
+        # wire + SLO families made it into the exposition
+        assert "hocuspocus_wire_messages_in_total" in body
+        assert "hocuspocus_tpu_slo_burn_rate" in body
+        assert "hocuspocus_tpu_compile_seconds" in body
+    finally:
+        await server.destroy()
+
+
+def test_exposition_order_is_deterministic():
+    """Labelled series render sorted regardless of insertion order, so
+    consecutive scrapes diff cleanly."""
+    def build(order):
+        reg = MetricsRegistry()
+        counter = reg.counter("zz_total", "Z")
+        gauge = reg.gauge("aa_current", "A")
+        for label in order:
+            counter.inc(3, shard=label)
+            gauge.set(1.0, slo=label, window="5m")
+        return reg.expose()
+
+    forward = build(["a", "b", "c"])
+    backward = build(["c", "b", "a"])
+    assert forward == backward
+    lines = [l for l in forward.splitlines() if not l.startswith("#")]
+    assert lines == sorted(lines)  # names + sorted labels sort stably
+
+
+def test_gauge_label_series():
+    gauge = Gauge("g", "labelled gauge")
+    gauge.set(2.5, slo="a", window="5m")
+    gauge.set(1.0, window="1h", slo="a")  # kwargs order must not matter
+    gauge.inc(0.5, slo="a", window="1h")
+    assert gauge.value(slo="a", window="5m") == 2.5
+    assert gauge.value(slo="a", window="1h") == 1.5
+    lines = list(gauge.expose())
+    assert 'g{slo="a",window="1h"} 1.5' in lines
+    assert 'g{slo="a",window="5m"} 2.5' in lines
+    # unlabelled compatibility: fresh gauge still exposes a zero sample
+    empty = Gauge("e", "")
+    assert list(empty.expose())[-1] == "e 0"
+
+
+def test_registry_register_adopts_and_rejects_collisions():
+    reg = MetricsRegistry()
+    counter = Counter("adopted_total", "")
+    reg.register(counter)
+    reg.register(counter)  # same object: idempotent
+    counter.inc(2)
+    assert "adopted_total 2" in reg.expose()
+    with pytest.raises(ValueError):
+        reg.register(Counter("adopted_total", ""))

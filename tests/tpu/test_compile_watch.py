@@ -1,0 +1,140 @@
+"""Compile-event tracker: warm-grid differentials, cache-hit
+classification, recompile-storm detection, HBM/stall stats.
+
+Acceptance (ISSUE 6): the tracker must PROVABLY distinguish warm-grid
+cache hits from fresh compiles — the warm grid pays every compile once,
+and live flushes at warmed shapes never register as fresh again.
+"""
+
+from __future__ import annotations
+
+from hocuspocus_tpu.crdt import Doc, encode_state_as_update
+from hocuspocus_tpu.observability import get_flight_recorder
+from hocuspocus_tpu.observability.device_watch import (
+    CompileTracker,
+    pytree_nbytes,
+    shape_label,
+)
+from hocuspocus_tpu.tpu.merge_plane import MergePlane
+
+
+def _make_update(text: str) -> bytes:
+    doc = Doc()
+    doc.get_text("t").insert(0, text)
+    return encode_state_as_update(doc)
+
+
+def test_warm_grid_compiles_once_then_only_hits():
+    """warmup_compiles() pays one fresh compile per (k, b) grid shape;
+    a second warmup over the same grid is all cache hits — the
+    differential against the existing warmup-grid behavior."""
+    plane = MergePlane(num_docs=8, capacity=256, max_slots_per_flush=4)
+    watch = plane.compile_watch
+    grid = plane.warmup_shapes()
+    assert watch.fresh_compiles == 0
+
+    plane.warmup_compiles()
+    assert watch.fresh_compiles == len(grid)
+    assert watch.cache_hits == 0
+    assert watch._warmed is True  # full grid -> warmed
+
+    plane.warmup_compiles()
+    assert watch.fresh_compiles == len(grid)  # nothing new
+    assert watch.cache_hits == len(grid)  # every shape re-dispatched warm
+    # re-warming warmed shapes never counts as a storm
+    assert watch.snapshot()["warmed"] is True
+
+
+def test_live_flush_at_warmed_shape_is_a_cache_hit():
+    plane = MergePlane(num_docs=8, capacity=256, max_slots_per_flush=4)
+    plane.warmup_compiles()
+    fresh_after_warmup = plane.compile_watch.fresh_compiles
+    hits_after_warmup = plane.compile_watch.cache_hits
+
+    plane.register("hot")
+    plane.enqueue_update("hot", _make_update("hello"))
+    assert plane.flush() > 0
+    assert plane.compile_watch.fresh_compiles == fresh_after_warmup
+    assert plane.compile_watch.cache_hits > hits_after_warmup
+
+
+def test_canary_probe_shape_is_covered_by_the_warm_grid():
+    """The canary's (K_max, 1) program is the warm grid's first entry:
+    a warmed plane's probes never pay a compile."""
+    plane = MergePlane(num_docs=8, capacity=256, max_slots_per_flush=4)
+    plane.warmup_compiles()
+    fresh = plane.compile_watch.fresh_compiles
+    plane.canary_probe()
+    assert plane.compile_watch.fresh_compiles == fresh
+
+
+def test_compile_event_labels_and_exposition():
+    tracker = CompileTracker()
+    before_compile = tracker.compile_events.value(
+        kind="compile", site="test_site", shape="4x2"
+    )
+    assert tracker.observe("test_site", (4, 2), 1.25) == "compile"
+    assert tracker.observe("test_site", (4, 2), 0.001) == "hit"
+    assert tracker.observe("test_site", (4, 8), 0.9) == "compile"
+    assert (
+        tracker.compile_events.value(kind="compile", site="test_site", shape="4x2")
+        == before_compile + 1
+    )
+    assert tracker.compile_events.value(kind="hit", site="test_site", shape="4x2") >= 1
+    assert tracker.seen("test_site", (4, 2))
+    assert not tracker.seen("test_site", (16, 2))
+    assert shape_label((16, 4)) == "16x4"
+
+
+def test_recompile_storm_logged_and_recorded():
+    """Fresh compiles past the warm grid raise the storm alarm: a
+    structured log plus a compile_storm flight-recorder event under
+    __plane__."""
+    recorder = get_flight_recorder()
+    recorder.forget("__plane__")
+    tracker = CompileTracker(storm_window_s=60.0, storm_threshold=3)
+    storms_before = sum(tracker.storms._values.values())
+
+    # pre-warm phase: grid compiles never count toward the storm
+    tracker.observe("integrate_sparse", (4, 1), 0.5, warmup=True)
+    tracker.mark_warmed()
+
+    tracker.observe("integrate_sparse", (4, 2), 0.5)
+    tracker.observe("integrate_sparse", (4, 4), 0.5)
+    assert sum(tracker.storms._values.values()) == storms_before  # under threshold
+    tracker.observe("integrate_sparse", (4, 16), 0.5)  # third unexpected compile
+    assert sum(tracker.storms._values.values()) == storms_before + 1
+    events = [e for e in recorder.events("__plane__") if e["event"] == "compile_storm"]
+    assert events
+    assert events[-1]["compiles"] == 3
+    # the detector re-arms: the burst was consumed
+    tracker.observe("integrate_sparse", (4, 32), 0.5)
+    assert sum(tracker.storms._values.values()) == storms_before + 1
+
+
+def test_memory_stats_report_arena_and_staging_bytes():
+    plane = MergePlane(num_docs=8, capacity=256, max_slots_per_flush=4)
+    stats = plane.memory_stats()
+    assert stats["arena_bytes"] > 0
+    assert stats["staging_bytes"] == 0  # no flush yet -> no staging
+    assert stats["readback_stall_ms_total"] == 0.0
+
+    plane.register("mem")
+    plane.enqueue_update("mem", _make_update("bytes"))
+    plane.flush()
+    stats = plane.memory_stats()
+    assert stats["staging_bytes"] > 0  # double-buffered staging allocated
+    assert stats["upload_bytes_peak"] > 0
+    assert stats["readback_stall_ms_total"] > 0.0
+    assert stats["readback_stalls"] >= 1
+
+
+def test_pytree_nbytes_walks_nested_structures():
+    import numpy as np
+
+    tree = {
+        "a": np.zeros((4, 4), np.int32),
+        "b": (np.zeros(8, np.int64), [np.zeros(2, np.uint8)]),
+        "c": "not an array",
+    }
+    assert pytree_nbytes(tree) == 4 * 4 * 4 + 8 * 8 + 2

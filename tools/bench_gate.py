@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Latency regression gate over bench rounds.
+
+Compares the newest two `BENCH_*.json` artifacts (or two explicit
+files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms` and
+`extra.wire_load.ingress.p99_ms` — and exits nonzero when any stage
+regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
+(latency on shared CPU runners is noisy; the gate is for on-chip
+rounds and deliberate local runs):
+
+    python tools/bench_gate.py                 # newest two BENCH_*.json
+    python tools/bench_gate.py --tolerance 0.5 # allow +50% per stage
+    python tools/bench_gate.py --current BENCH_r06.json --previous BENCH_r05.json
+
+Safety rails (exit 0 with a SKIP note, never a false alarm):
+- fewer than two artifacts, or either file unreadable/unparseable,
+- the two rounds ran on different backends (a CPU-fallback round must
+  not be compared against an on-chip round),
+- a stage present in only one round (new stages are informational).
+
+A stage regresses when `current_p99 > previous_p99 * (1 + tolerance) +
+floor_ms` — the absolute floor keeps micro-stage jitter (fractions of a
+millisecond) from tripping the relative check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _artifact_key(path: str) -> "tuple[float, int, str]":
+    """Newest-last ordering by mtime (a fresh `BENCH_next.json` from the
+    documented workflow MUST outrank older numbered rounds), tie-broken
+    by the BENCH_r<N> round number for same-second writes."""
+    match = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    round_no = int(match.group(1)) if match else -1
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    return (mtime, round_no, path)
+
+
+def find_artifacts(directory: str) -> "list[str]":
+    return sorted(glob.glob(os.path.join(directory, "BENCH_*.json")), key=_artifact_key)
+
+
+def load_round(path: str) -> "dict | None":
+    """Parse one artifact. Artifacts come in two shapes: the bench's
+    own JSON line, or the driver's wrapper with the real payload under
+    "parsed"."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except Exception:
+        return None
+    if isinstance(data, dict) and "parsed" in data and isinstance(data["parsed"], dict):
+        data = data["parsed"]
+    return data if isinstance(data, dict) else None
+
+
+def stage_p99s(payload: dict) -> "dict[str, float]":
+    """Flatten every gated p99 out of one round's extra section."""
+    extra = payload.get("extra") or {}
+    stages: "dict[str, float]" = {}
+    update_e2e = extra.get("update_e2e")
+    if isinstance(update_e2e, dict):
+        for stage, stats in update_e2e.items():
+            if not isinstance(stats, dict):
+                continue  # scalar siblings are not stages
+            p99 = stats.get("p99_ms")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages[f"update_e2e.{stage}"] = float(p99)
+    wire = extra.get("wire_load")
+    if isinstance(wire, dict):
+        ingress = wire.get("ingress")
+        if isinstance(ingress, dict) and isinstance(
+            ingress.get("p99_ms"), (int, float)
+        ):
+            stages["wire_load.ingress"] = float(ingress["p99_ms"])
+    return stages
+
+
+def backend_of(payload: dict) -> "str | None":
+    extra = payload.get("extra") or {}
+    return extra.get("backend")
+
+
+def compare(
+    previous: dict,
+    current: dict,
+    tolerance: float,
+    floor_ms: float,
+) -> "tuple[list[str], list[str]]":
+    """-> (regressions, notes)."""
+    notes: "list[str]" = []
+    prev_backend, cur_backend = backend_of(previous), backend_of(current)
+    if prev_backend != cur_backend:
+        notes.append(
+            f"SKIP: backend changed ({prev_backend!r} -> {cur_backend!r}); "
+            "cross-backend latencies are not comparable"
+        )
+        return [], notes
+    prev_stages = stage_p99s(previous)
+    cur_stages = stage_p99s(current)
+    if not prev_stages or not cur_stages:
+        notes.append("SKIP: per-stage p99 data missing from one or both rounds")
+        return [], notes
+    regressions: "list[str]" = []
+    for stage in sorted(cur_stages):
+        if stage not in prev_stages:
+            notes.append(f"NEW  {stage}: {cur_stages[stage]:.3f}ms (no baseline)")
+            continue
+        prev, cur = prev_stages[stage], cur_stages[stage]
+        budget = prev * (1.0 + tolerance) + floor_ms
+        verdict = "OK  "
+        if cur > budget:
+            verdict = "FAIL"
+            regressions.append(
+                f"{stage}: {prev:.3f}ms -> {cur:.3f}ms "
+                f"(budget {budget:.3f}ms at +{tolerance:.0%} +{floor_ms:g}ms)"
+            )
+        notes.append(
+            f"{verdict} {stage}: {prev:.3f}ms -> {cur:.3f}ms"
+            f" ({'+' if cur >= prev else ''}{(cur - prev):.3f})"
+        )
+    return regressions, notes
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Gate on per-stage p99 regressions between bench rounds."
+    )
+    parser.add_argument(
+        "--tolerance",
+        type=float,
+        default=float(os.environ.get("BENCH_GATE_TOLERANCE", 0.25)),
+        help="allowed relative growth per stage (default 0.25 = +25%%)",
+    )
+    parser.add_argument(
+        "--floor-ms",
+        type=float,
+        default=float(os.environ.get("BENCH_GATE_FLOOR_MS", 0.25)),
+        help="absolute slack added to every budget (default 0.25ms)",
+    )
+    parser.add_argument("--current", help="explicit current-round artifact")
+    parser.add_argument("--previous", help="explicit previous-round artifact")
+    parser.add_argument(
+        "--dir", default=_REPO_DIR, help="directory holding BENCH_*.json"
+    )
+    args = parser.parse_args(argv)
+
+    if bool(args.current) != bool(args.previous):
+        # a half-pinned comparison would silently fall through to the
+        # newest-two scan and gate a pair the user did not ask about
+        parser.error("--current and --previous must be given together")
+    if args.current and args.previous:
+        prev_path, cur_path = args.previous, args.current
+    else:
+        artifacts = find_artifacts(args.dir)
+        if len(artifacts) < 2:
+            print(f"SKIP: fewer than two BENCH_*.json under {args.dir}")
+            return 0
+        prev_path, cur_path = artifacts[-2], artifacts[-1]
+
+    previous = load_round(prev_path)
+    current = load_round(cur_path)
+    if previous is None or current is None:
+        print("SKIP: could not parse one or both artifacts")
+        return 0
+
+    print(f"bench_gate: {os.path.basename(prev_path)} -> {os.path.basename(cur_path)}")
+    regressions, notes = compare(previous, current, args.tolerance, args.floor_ms)
+    for note in notes:
+        print(f"  {note}")
+    if regressions:
+        print(f"REGRESSION: {len(regressions)} stage(s) over budget")
+        return 1
+    print("PASS: no stage regressed beyond tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
