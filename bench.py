@@ -197,6 +197,7 @@ def main() -> None:
         os.environ.setdefault("BENCH_CATCHUP", "0")
         os.environ.setdefault("BENCH_RLE", "0")
         os.environ.setdefault("BENCH_WIRE", "0")
+        os.environ.setdefault("BENCH_FANOUT", "0")
     cpu_smoke = None
     for attempt in range(2):
         cpu_smoke = _run_inner("cpu")
@@ -616,6 +617,16 @@ def run_bench() -> None:
             wire_load = _measure_wire_load()
         except Exception as error:
             wire_load = {"error": repr(error)[:300]}
+
+    # broadcast fan-out storm (server/fanout.py): frames saved by
+    # per-tick coalescing, catch-up tiering, join-storm cache hit rate
+    fanout = None
+    if os.environ.get("BENCH_FANOUT", "1") != "0":
+        _log("inner: fanout-storm pass ...")
+        try:
+            fanout = _measure_fanout_storm()
+        except Exception as error:
+            fanout = {"error": repr(error)[:300]}
     _log("inner: all passes done")
 
     merges_per_sec = total_ops / elapsed
@@ -661,6 +672,8 @@ def run_bench() -> None:
         result["extra"]["catchup_storm"] = storm
     if wire_load is not None:
         result["extra"]["wire_load"] = wire_load
+    if fanout is not None:
+        result["extra"]["fanout_storm"] = fanout
     if jax.default_backend() != "tpu":
         onchip = _latest_onchip_capture()
         result["extra"]["note"] = (
@@ -960,6 +973,133 @@ def _measure_wire_load() -> dict:
         },
         "served_p99_ms": served["value"],
         "elapsed_s": round(elapsed, 1),
+    }
+
+
+def _measure_fanout_storm() -> dict:
+    """Broadcast fan-out engine under two storm shapes (all production
+    code: real Documents, Connections, CallbackWebSocketTransports and
+    the per-tick coalescing engine — only the network framing is
+    absent):
+
+    - hot_doc: 1 document x N connections, bursty writers — the shape
+      where per-update fan-out melts the event loop. Reports the
+      frames-saved ratio vs per-update fan-out (acceptance: >=2x) and
+      the merge -> LAST-socket-write p99.
+    - wide: M documents x few connections each — the sharded steady
+      state; reports aggregate frames/s.
+    - cache: a cold join storm against a served plane; reports the
+      join-storm sync cache hit rate.
+    """
+    import asyncio
+
+    from hocuspocus_tpu.observability.wire import get_wire_telemetry
+    from hocuspocus_tpu.server.connection import Connection
+    from hocuspocus_tpu.server.document import Document
+    from hocuspocus_tpu.server.transports import CallbackWebSocketTransport
+
+    hot_conns = int(os.environ.get("BENCH_FANOUT_CONNS", 512))
+    wide_docs = int(os.environ.get("BENCH_FANOUT_DOCS", 256))
+    wide_conns = int(os.environ.get("BENCH_FANOUT_WIDE_CONNS", 8))
+    rounds = int(os.environ.get("BENCH_FANOUT_ROUNDS", 24))
+    burst = int(os.environ.get("BENCH_FANOUT_BURST", 4))
+
+    wire = get_wire_telemetry()
+    wire.enable()
+    before = wire.totals()
+
+    async def storm(num_docs: int, conns_per_doc: int) -> dict:
+        documents = [Document(f"storm-{i}") for i in range(num_docs)]
+        writes = {"count": 0, "t_last": 0.0}
+        pending = asyncio.Event()
+
+        async def send_async(data: bytes) -> None:
+            writes["count"] += 1
+            writes["t_last"] = time.perf_counter()
+            if writes["count"] >= writes.get("target", 1 << 62):
+                pending.set()
+
+        async def close_async(code: int, reason: str) -> None:
+            pass
+
+        transports = []
+        for document in documents:
+            for c in range(conns_per_doc):
+                transport = CallbackWebSocketTransport(send_async, close_async)
+                Connection(transport, None, document, f"s{c}", {})
+                transports.append(transport)
+        total_conns = num_docs * conns_per_doc
+        latencies = []
+        t_start = time.perf_counter()
+        for _ in range(rounds):
+            # bursty writers: `burst` updates per doc land in ONE tick
+            writes["target"] = writes["count"] + total_conns
+            pending.clear()
+            t0 = time.perf_counter()
+            for document in documents:
+                text = document.get_text("t")
+                for _ in range(burst):
+                    text.insert(len(text), "x" * 24)
+            await asyncio.wait_for(pending.wait(), timeout=60)
+            latencies.append(writes["t_last"] - t0)
+        elapsed = max(time.perf_counter() - t_start, 1e-9)
+        for transport in transports:
+            transport.abort()
+        lat_ms = np.array(latencies) * 1000
+        return {
+            "docs": num_docs,
+            "connections": total_conns,
+            "rounds": rounds,
+            "burst": burst,
+            "frames_sent": writes["count"],
+            "frames_per_sec": round(writes["count"] / elapsed, 1),
+            "sends_baseline_per_update": rounds * burst * total_conns,
+            "frames_saved_ratio": round(
+                (rounds * burst * total_conns) / max(writes["count"], 1), 2
+            ),
+            "merge_to_last_write_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "merge_to_last_write_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        }
+
+    hot = asyncio.run(storm(1, hot_conns))
+    wide = asyncio.run(storm(wide_docs, wide_conns))
+
+    # join-storm sync cache hit rate (serving path, CPU or chip alike)
+    from hocuspocus_tpu.crdt import Doc, encode_state_as_update
+    from hocuspocus_tpu.tpu.merge_plane import MergePlane
+    from hocuspocus_tpu.tpu.serving import PlaneServing
+
+    plane = MergePlane(num_docs=4, capacity=1024)
+    serving = PlaneServing(plane)
+    ref = Doc()
+    ref.get_text("t").insert(0, "join-storm payload " * 8)
+    plane.register("joiner")
+    plane.enqueue_update("joiner", encode_state_as_update(ref))
+    joiners = int(os.environ.get("BENCH_FANOUT_JOINERS", 256))
+    for _ in range(joiners):
+        serving.encode_state_as_update("joiner", ref, None)
+    hits = plane.counters["sync_cache_hits"]
+    misses = plane.counters["sync_cache_misses"]
+
+    after = wire.totals()
+    return {
+        "hot_doc": hot,
+        "wide": wide,
+        "sends_elided_coalesce": int(
+            after["sends_elided_coalesce"] - before["sends_elided_coalesce"]
+        ),
+        "sends_elided_catchup": int(
+            after["sends_elided_catchup"] - before["sends_elided_catchup"]
+        ),
+        "tier_entries": int(after["tier_entries"] - before["tier_entries"]),
+        "cache": {
+            "joiners": joiners,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / max(hits + misses, 1), 4),
+        },
+        # the gated headline: the hot-doc shape is the pathological one
+        "merge_to_last_write_p99_ms": hot["merge_to_last_write_p99_ms"],
     }
 
 

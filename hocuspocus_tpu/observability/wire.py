@@ -115,6 +115,29 @@ class WireTelemetry:
             "hocuspocus_wire_backpressure_total",
             "Send-queue watermark crossings (queue climbed past the watermark)",
         )
+        self.fanout_coalesced = Histogram(
+            "hocuspocus_wire_fanout_coalesced_updates",
+            "Updates merged into one broadcast frame per document tick",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),  # counts, not seconds
+        )
+        self.fanout_sends_elided = Counter(
+            "hocuspocus_wire_fanout_sends_elided_total",
+            "Per-connection sends avoided by the fan-out engine, by reason "
+            "(coalesce: burst merged into one frame; catchup: frame dropped "
+            "for a connection in catch-up tier)",
+        )
+        self.catchup_tier_transitions = Counter(
+            "hocuspocus_wire_catchup_tier_total",
+            "Slow-consumer catch-up tier transitions (enter/exit)",
+        )
+        self.sync_cache_events = Counter(
+            "hocuspocus_wire_sync_cache_total",
+            "Join-storm sync cache lookups by result (hit/miss/eviction)",
+        )
+        self.send_queue_overflows = Counter(
+            "hocuspocus_wire_send_queue_overflow_total",
+            "Transports closed because their send queue hit the bound",
+        )
         self.pubsub_publishes = Counter(
             "hocuspocus_wire_pubsub_publishes_total",
             "mini_redis PUBLISH commands handled",
@@ -174,6 +197,28 @@ class WireTelemetry:
             self._egress_last_frame = data
             self._egress_last_type = message_type
         self.record_egress(message_type, len(data))
+
+    # -- broadcast fan-out engine (server/fanout.py) -------------------------
+
+    def record_fanout_frame(self, coalesced: int, sends_saved: int) -> None:
+        """One broadcast tick shipped `coalesced` merged updates as one
+        frame, saving `sends_saved` per-connection sends vs per-update
+        fan-out."""
+        self.fanout_coalesced.observe(float(coalesced))
+        if sends_saved > 0:
+            self.fanout_sends_elided.inc(sends_saved, reason="coalesce")
+
+    def record_catchup_elided(self, count: int = 1) -> None:
+        self.fanout_sends_elided.inc(count, reason="catchup")
+
+    def record_tier(self, transition: str) -> None:
+        self.catchup_tier_transitions.inc(transition=transition)
+
+    def record_sync_cache(self, result: str, count: int = 1) -> None:
+        self.sync_cache_events.inc(count, result=result)
+
+    def record_queue_overflow(self) -> None:
+        self.send_queue_overflows.inc()
 
     def record_sync_step(self, sync_type: int, seconds: float) -> None:
         step = _SYNC_STEP_NAMES.get(int(sync_type), f"unknown_{int(sync_type)}")
@@ -264,6 +309,11 @@ class WireTelemetry:
             self.send_queue_depth,
             self.send_queue_peak,
             self.backpressure_events,
+            self.fanout_coalesced,
+            self.fanout_sends_elided,
+            self.catchup_tier_transitions,
+            self.sync_cache_events,
+            self.send_queue_overflows,
             self.pubsub_publishes,
             self.pubsub_deliveries,
             self.pubsub_dropped,
@@ -281,6 +331,13 @@ class WireTelemetry:
             "send_queue_peak": self.send_queue_peak.value(),
             "backpressure_events": sum(self.backpressure_events._values.values()),
             "errors": sum(self.errors._values.values()),
+            "sends_elided_coalesce": self.fanout_sends_elided.value(reason="coalesce"),
+            "sends_elided_catchup": self.fanout_sends_elided.value(reason="catchup"),
+            "tier_entries": self.catchup_tier_transitions.value(transition="enter"),
+            "tier_exits": self.catchup_tier_transitions.value(transition="exit"),
+            "sync_cache_hits": self.sync_cache_events.value(result="hit"),
+            "sync_cache_misses": self.sync_cache_events.value(result="miss"),
+            "queue_overflows": sum(self.send_queue_overflows._values.values()),
         }
 
 

@@ -35,6 +35,21 @@ def write_update(encoder: Encoder, update: bytes) -> None:
     encoder.write_var_uint8_array(update)
 
 
+def coalesce_updates(updates: "list[bytes]") -> Optional[bytes]:
+    """Merge one broadcast tick's captured updates into ONE equivalent
+    update payload (the fan-out engine's per-tick frame — see
+    server/fanout.py). Returns None when the merge fails; the caller
+    must then fall back to per-update fan-out so no update is lost."""
+    if len(updates) == 1:
+        return updates[0]
+    from ..crdt.update import merge_updates
+
+    try:
+        return merge_updates(updates)
+    except Exception:
+        return None
+
+
 read_update = read_sync_step2
 
 

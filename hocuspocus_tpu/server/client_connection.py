@@ -74,6 +74,12 @@ class ClientConnection:
             wire.record_socket_closed(code)
             wire.untrack_transport(self.transport)
         self.close(CloseEvent(code, reason))
+        # a socket that died mid-handshake leaves queued frame BYTES for
+        # channels that never established; drop them eagerly instead of
+        # pinning them until this session is GC'd. hook_payloads stays:
+        # an in-flight auth handshake re-reads its payload after the
+        # hook await resumes, and the dicts themselves are tiny.
+        self.incoming_message_queue.clear()
 
     # -- connection establishment -----------------------------------------
 

@@ -11,6 +11,7 @@ from ..protocol.close_events import CloseError, CloseEvent, RESET_CONNECTION
 from ..protocol.message import IncomingMessage, OutgoingMessage
 from . import logger
 from .document import Document
+from .fanout import CatchupTier
 from .message_receiver import MessageReceiver
 
 
@@ -42,6 +43,11 @@ class Connection:
             "before_sync": _default_async_callback,
             "stateless": _default_async_callback,
         }
+        # slow-consumer catch-up tier (server/fanout.py): the broadcast
+        # tick elides frames for this channel while its transport queue
+        # is past the backpressure watermark, then heals it with one
+        # SV-diff frame at drain time
+        self.catchup = CatchupTier(self)
         self.document.add_connection(self)
         self.send_current_awareness()
 
@@ -89,6 +95,9 @@ class Connection:
                 wire.record_channel_close(
                     event.code if event is not None else None
                 )
+            # a catch-up tier mid-excursion must not fire its drain
+            # exit into a closing channel
+            self.catchup.deactivate()
             self.document.remove_connection(self)
             for callback in self.callbacks["on_close"]:
                 callback(self.document, event)

@@ -18,6 +18,7 @@ from ..protocol.awareness import (
 )
 from ..protocol.frames import build_update_frame
 from ..protocol.message import OutgoingMessage
+from .fanout import DocumentFanout
 
 
 class Document(Doc):
@@ -42,14 +43,10 @@ class Document(Doc):
         # broadcast_source claims updates for batched device broadcast
         self.sync_source = None
         self.broadcast_source = None
-        # same-tick awareness coalescing (see _handle_awareness_update)
-        self._pending_awareness: set[int] = set()
-        self._awareness_scheduled = False
-        # same-tick UPDATE coalescing (see _handle_update): concurrent
-        # senders whose updates land in one loop iteration fan out as
-        # ONE merged frame instead of one frame each
-        self._pending_update_broadcast: list[bytes] = []
-        self._update_broadcast_scheduled = False
+        # broadcast fan-out engine (server/fanout.py): per-tick frame
+        # coalescing, one audience snapshot per tick, catch-up tiering
+        # for slow consumers — updates AND awareness share the tick
+        self.fanout = DocumentFanout(self)
         self.awareness.on("update", self._handle_awareness_update)
         self.on("update", self._handle_update)
 
@@ -132,29 +129,7 @@ class Document(Doc):
         # latency (call_soon, no timer), 1/N the fan-out encodes+sends
         # the reference pays (`packages/server/src/Document.ts:199-226`
         # re-encodes and fans out per update)
-        self._pending_awareness.update(changed_clients)
-        if self._awareness_scheduled:
-            return
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:
-            self._flush_awareness()  # no loop (direct/test use): immediate
-            return
-        self._awareness_scheduled = True
-        loop.call_soon(self._flush_awareness)
-
-    def _flush_awareness(self) -> None:
-        self._awareness_scheduled = False
-        changed = list(self._pending_awareness)
-        self._pending_awareness.clear()
-        if not changed:
-            return
-        message = OutgoingMessage(self.name).create_awareness_update_message(
-            self.awareness, changed
-        )
-        data = message.to_bytes()
-        for connection in self.get_connections():
-            connection.send(data)
+        self.fanout.queue_awareness(changed_clients)
 
     # -- updates -----------------------------------------------------------
 
@@ -177,52 +152,45 @@ class Document(Doc):
         # update; here bursts within one event-loop iteration coalesce
         # into ONE merged frame — same latency via call_soon, 1/N the
         # frame builds + websocket sends + receiver applies)
-        self._pending_update_broadcast.append(update)
-        if self._update_broadcast_scheduled:
-            return
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:
-            self._flush_update_broadcast()  # no loop (direct/test use)
-            return
-        self._update_broadcast_scheduled = True
-        loop.call_soon(self._flush_update_broadcast)
+        self.fanout.queue_update(update)
 
-    def _flush_update_broadcast(self) -> None:
-        self._update_broadcast_scheduled = False
-        pending = self._pending_update_broadcast
-        if not pending:
-            return
-        self._pending_update_broadcast = []
-        if len(pending) == 1:
-            update = pending[0]
-        else:
-            from ..crdt.update import merge_updates
-
-            try:
-                update = merge_updates(pending)
-            except Exception:
-                # a merge failure must not lose updates: fall back to
-                # the per-update fan-out
-                for u in pending:
-                    self.broadcast_update_frame(u)
-                return
-        self.broadcast_update_frame(update)
+    def queue_broadcast(self, update: bytes, on_complete=None) -> None:
+        """Enqueue a ready update payload onto the current broadcast
+        tick (the plane's window broadcasts ride this). `on_complete`
+        is invoked with the last-socket-enqueue timestamp once the
+        tick's fan-out finished — where the lifecycle trace's fan-out
+        stage closes."""
+        self.fanout.queue_update(update, on_complete)
 
     def broadcast_update_frame(self, update: bytes) -> None:
+        """Immediate (tickless) fan-out of one update — the degrade
+        paths' full-state broadcasts. Shares one frame across the
+        audience and still honors catch-up tiering."""
         data = build_update_frame(self.name, update)
-        for connection in self.get_connections():
-            connection.send(data)
+        elided = self.fanout.deliver(self.get_connections(), data)
+        if elided:
+            from ..observability.wire import get_wire_telemetry
+
+            wire = get_wire_telemetry()
+            if wire.enabled:
+                wire.record_catchup_elided(elided)
 
     def broadcast_stateless(self, payload: str, filter: Optional[Callable] = None) -> None:
         self.callbacks["before_broadcast_stateless"](self, payload)
         connections = self.get_connections()
         if filter is not None:
             connections = [c for c in connections if filter(c)]
-        for connection in connections:
-            connection.send_stateless(payload)
+        if not connections:
+            return
+        # ONE frame, shared immutably by the whole audience (the
+        # per-connection send_stateless re-encoded the same payload
+        # once per socket). Stateless frames are app-level messages
+        # with no CRDT recovery path, so they bypass catch-up tiering.
+        data = OutgoingMessage(self.name).write_stateless(payload).to_bytes()
+        self.fanout.deliver(connections, data, tierable=False)
 
     def destroy(self) -> None:
+        self.fanout.close()
         self.awareness.destroy()
         super().destroy()
         self.is_destroyed = True

@@ -1,0 +1,309 @@
+"""Broadcast fan-out engine: per-tick frame coalescing + slow-consumer
+catch-up tiering.
+
+The wire side of a merged update used to be O(updates x connections):
+every update fanned out as its own frame build plus a per-connection
+Python `send()` loop (reference `packages/server/src/Document.ts:228-240`
+does exactly that). This module makes it O(ticks x audiences):
+
+- **Tick model.** Each document owns a `DocumentFanout`. Updates and
+  awareness changes queue into the CURRENT tick; the tick flushes via
+  `loop.call_soon` (same latency as the old per-update path — no timer,
+  just the end of the current loop iteration; with no running loop the
+  flush is immediate, for direct/test use). One flush merges every
+  captured update into ONE Y-update (`protocol.sync.coalesce_updates`),
+  builds ONE wire frame, snapshots the audience ONCE, and enqueues the
+  same immutable bytes object to every connection — update pass and
+  awareness pass share the snapshot.
+
+- **Catch-up tiering.** A connection whose transport send queue crosses
+  the backpressure watermark (`WireTelemetry.backpressure_watermark`,
+  the PR-6 signal) is switched from per-frame streaming to catch-up
+  mode: subsequent update/awareness frames are elided for that
+  connection (counted), and when the transport reports its queue
+  drained the tier exits — streaming resumes at once and ONE catch-up
+  frame (an empty-baseline state diff: see `CatchupTier` for why any
+  doc-derived entry snapshot would be unsafe) is computed
+  asynchronously, served from the plane via the batched
+  `document.sync_source` path — where the join-storm cache makes it
+  one encode per epoch — with the CPU document as fallback, plus one
+  full awareness frame. A slow socket therefore costs O(1) queued
+  frames per drain cycle instead of O(updates), and can never stall
+  the tick: the tick never awaits any transport.
+
+- **Trace closure.** Plane broadcasts pass an `on_complete` callback
+  (`Document.queue_broadcast`); the tick invokes it with the
+  last-socket-enqueue timestamp, which is where the PR-4 lifecycle
+  trace's fan-out stage closes — the span-sum invariant (stages sum
+  exactly to the e2e latency) holds with the tick in the path.
+
+Delivery-order guarantee: frames for one connection are enqueued in
+document order on the event loop thread and the transport writer drains
+in order, so coalescing never reorders a client's view. Catch-up exits
+are CRDT-safe by construction: the diff-since-entry-SV is a superset of
+every elided update, and re-delivery is idempotent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from ..crdt import encode_state_as_update
+from ..observability.wire import get_wire_telemetry
+from ..protocol.frames import build_update_frame
+from ..protocol.message import OutgoingMessage
+from ..protocol.sync import coalesce_updates
+
+
+class CatchupTier:
+    """Per-(socket, document) slow-consumer state machine.
+
+    States: STREAMING (default; every broadcast frame is enqueued) and
+    CATCH_UP (broadcast update/awareness frames are elided). Entry:
+    transport queue depth at/above the watermark right after a frame
+    enqueue. Exit: the transport's drain notification — streaming
+    resumes immediately and ONE catch-up frame is computed
+    asynchronously and enqueued when ready. Only queue-backed
+    transports that expose `add_drain_listener` participate; anything
+    else streams forever (never elided).
+
+    Why the catch-up frame carries FULL state (an empty-baseline
+    SV-diff) rather than a diff from an entry-time snapshot: updates
+    are applied to the CPU document the moment they arrive, but their
+    broadcast frames can trail — plane-captured updates fan out on the
+    flush/broadcast timers, ticks defer to call_soon — so ANY state
+    vector read off the document can include updates whose frames were
+    never enqueued to this connection, and a diff from it would omit
+    them forever. The empty baseline is unconditionally a lower bound
+    of the client's state, re-delivery is idempotent, and the
+    join-storm sync cache (tpu/serving.py) makes the encode O(1) per
+    (doc, epoch) — the cold payload is the cache's hottest entry.
+    Ordering is safe too: frames streamed between drain and the async
+    encode resolving may reference structs the client hasn't seen, and
+    the CRDT's pending-structs machinery holds them until the catch-up
+    frame lands.
+    """
+
+    __slots__ = ("connection", "active", "_exit_task")
+
+    def __init__(self, connection) -> None:
+        self.connection = connection
+        self.active = False
+        self._exit_task = None
+
+    def maybe_enter(self) -> bool:
+        """Called right AFTER a frame was enqueued to this connection —
+        depth at/above the watermark flips the channel to catch-up."""
+        if self.active:
+            return False
+        transport = self.connection.transport
+        add_listener = getattr(transport, "add_drain_listener", None)
+        queue = getattr(transport, "queue", None)
+        if add_listener is None or queue is None:
+            return False
+        try:
+            depth = queue.qsize()
+        except Exception:
+            return False
+        wire = get_wire_telemetry()
+        if depth < wire.backpressure_watermark:
+            return False
+        self.active = True
+        add_listener(self._on_drain)
+        if wire.enabled:
+            wire.record_tier("enter")
+        return True
+
+    def deactivate(self) -> None:
+        """Forget tier state (connection/channel closing). A drain
+        listener still registered fires into the inactive check below
+        and no-ops; an in-flight exit task sees the dead channel and
+        drops its payload."""
+        self.active = False
+
+    def _on_drain(self) -> None:
+        if not self.active:
+            return
+        # resume streaming NOW: frames from here on are enqueued in
+        # order, and anything they might depend on arrives in the
+        # catch-up frame (pending-structs buffering client-side)
+        self.active = False
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            wire.record_tier("exit")
+        connection = self.connection
+        document = connection.document
+        if (
+            connection.transport.is_closed
+            or document.is_destroyed
+            or not document.has_connection(connection)
+        ):
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            self._send_catchup(self._encode_sync())
+            return
+        # strong ref: a GC'd task would silently drop the catch-up
+        self._exit_task = asyncio.ensure_future(self._exit_async())
+
+    async def _exit_async(self) -> None:
+        document = self.connection.document
+        update = None
+        source = getattr(document, "sync_source", None)
+        batched = getattr(source, "encode_state_as_update_async", None)
+        if batched is not None:
+            # plane-served catch-up OFF the event loop: the batched
+            # serve runs its device flush in the executor and shares
+            # one state-vector-diff triage with any concurrent joiners
+            try:
+                update = await batched(None)
+            except Exception:
+                update = None
+        if update is None:
+            update = self._encode_sync()
+        self._send_catchup(update)
+        self._exit_task = None
+
+    def _encode_sync(self):
+        """Host-side full-state encode (CPU document): the no-loop and
+        plane-degraded fallback."""
+        try:
+            return encode_state_as_update(self.connection.document)
+        except Exception:
+            return None  # client heals via its next sync handshake
+
+    def _send_catchup(self, update) -> None:
+        connection = self.connection
+        document = connection.document
+        if (
+            update is None
+            or connection.transport.is_closed
+            or document.is_destroyed
+            or not document.has_connection(connection)
+        ):
+            return
+        connection.send(build_update_frame(document.name, update))
+        # elided awareness frames carried per-client LWW state: one full
+        # awareness snapshot reconverges presence
+        if document.has_awareness_states():
+            message = OutgoingMessage(document.name).create_awareness_update_message(
+                document.awareness
+            )
+            connection.send(message.to_bytes())
+
+
+class DocumentFanout:
+    """One document's broadcast tick: pending update payloads, pending
+    awareness clients, and the completion callbacks that close
+    lifecycle traces at last-socket-enqueue."""
+
+    def __init__(self, document) -> None:
+        self.document = document
+        self._pending_updates: list[bytes] = []
+        self._pending_awareness: set[int] = set()
+        self._on_complete: list[Callable[[float], Any]] = []
+        self._scheduled = False
+
+    # -- enqueue -----------------------------------------------------------
+
+    def queue_update(
+        self, update: bytes, on_complete: Optional[Callable[[float], Any]] = None
+    ) -> None:
+        self._pending_updates.append(update)
+        if on_complete is not None:
+            self._on_complete.append(on_complete)
+        self._schedule()
+
+    def queue_awareness(self, changed_clients: Iterable[int]) -> None:
+        self._pending_awareness.update(changed_clients)
+        self._schedule()
+
+    def _schedule(self) -> None:
+        if self._scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self.flush()  # no loop (direct/test use): immediate
+            return
+        self._scheduled = True
+        loop.call_soon(self.flush)
+
+    # -- the tick ----------------------------------------------------------
+
+    def flush(self) -> None:
+        self._scheduled = False
+        pending = self._pending_updates
+        awareness_clients = self._pending_awareness
+        callbacks = self._on_complete
+        if pending:
+            self._pending_updates = []
+        if awareness_clients:
+            self._pending_awareness = set()
+        if callbacks:
+            self._on_complete = []
+        if not pending and not awareness_clients:
+            return
+        document = self.document
+        # audience snapshot: ONE registry copy serves the update pass
+        # AND the awareness pass of this tick
+        audience = document.get_connections()
+        wire = get_wire_telemetry()
+        elided = 0
+        if pending:
+            update = coalesce_updates(pending)
+            if update is None:
+                # merge failure must not lose updates: per-update frames
+                for u in pending:
+                    elided += self.deliver(
+                        audience, build_update_frame(document.name, u)
+                    )
+            else:
+                elided += self.deliver(
+                    audience, build_update_frame(document.name, update)
+                )
+                if wire.enabled and audience:
+                    wire.record_fanout_frame(
+                        len(pending), (len(pending) - 1) * len(audience)
+                    )
+        if awareness_clients and audience:
+            message = OutgoingMessage(document.name).create_awareness_update_message(
+                document.awareness, list(awareness_clients)
+            )
+            elided += self.deliver(audience, message.to_bytes())
+        if wire.enabled and elided:
+            wire.record_catchup_elided(elided)
+        if callbacks:
+            # last-socket-enqueue: where the lifecycle trace's fan-out
+            # stage closes
+            t_last = time.perf_counter()
+            for callback in callbacks:
+                try:
+                    callback(t_last)
+                except Exception:
+                    pass
+
+    def deliver(self, audience, frame: bytes, tierable: bool = True) -> int:
+        """Enqueue one shared frame to every connection; returns the
+        number of catch-up-tier elisions."""
+        elided = 0
+        for connection in audience:
+            tier = getattr(connection, "catchup", None)
+            if tier is not None and tierable:
+                if tier.active:
+                    elided += 1
+                    continue
+                connection.send(frame)
+                tier.maybe_enter()
+            else:
+                connection.send(frame)
+        return elided
+
+    def close(self) -> None:
+        """Drop pending work (document destroyed)."""
+        self._pending_updates = []
+        self._pending_awareness = set()
+        self._on_complete = []

@@ -127,8 +127,13 @@ class MessageReceiver:
                 )
         elif message_type == MessageType.BroadcastStateless:
             payload = message.read_var_string()
-            for conn in document.get_connections():
-                conn.send_stateless(payload)
+            # ONE shared frame for the whole audience (snapshotted
+            # once), matching the fan-out engine's encode-once idiom —
+            # send_stateless re-encoded the payload per connection
+            data = OutgoingMessage(document.name).write_stateless(payload).to_bytes()
+            document.fanout.deliver(
+                document.get_connections(), data, tierable=False
+            )
         elif message_type == MessageType.CLOSE:
             if connection is not None:
                 from ..protocol.close_events import CloseEvent

@@ -298,6 +298,12 @@ class MergePlane:
             "hydrations_declined": 0,
             "compactions_declined": 0,
             "sync_serves": 0,
+            # join-storm sync cache (serving.SyncFrameCache): joiners
+            # sharing a (doc, state-vector) within one flush epoch pay
+            # one encode, not one each
+            "sync_cache_hits": 0,
+            "sync_cache_misses": 0,
+            "sync_cache_evictions": 0,
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
             # flush-engine accounting: staging buffers are allocated
@@ -2406,10 +2412,18 @@ class TpuMergeExtension(Extension):
                     book.finish(name)
                     continue
                 update, cross_update = pair
-                document.broadcast_update_frame(update)
-                # broadcast completion closes the lifecycle trace: the
-                # fan-out stage span + the end-to-end observation
-                book.finish(name)
+                # window frames ride the document's broadcast tick
+                # (server/fanout.py): one merged frame per audience,
+                # catch-up tiering for slow sockets — and the lifecycle
+                # trace closes at LAST-SOCKET-ENQUEUE via the tick's
+                # completion callback, keeping the span-sum invariant
+                # honest about when fan-out actually finished
+                document.queue_broadcast(
+                    update,
+                    on_complete=(
+                        lambda t_last, _name=name: book.finish(_name, t_last)
+                    ),
+                )
                 if (
                     cross_instance
                     and cross_update is not None

@@ -29,6 +29,7 @@ Safety model:
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -40,9 +41,73 @@ from ..crdt.ids import ID
 from ..crdt.structs import GC, Item
 from ..crdt.update import _write_structs, decode_state_vector
 from ..observability.tracing import get_tracer
+from ..observability.wire import get_wire_telemetry
 from .kernels import KIND_DELETE, KIND_INSERT, NONE_CLIENT
 from .lowering import DenseOp, units_to_text
 from .merge_plane import LogRec, MergePlane, PlaneDoc
+
+
+class SyncFrameCache:
+    """Join-storm sync cache: (doc, state-vector) -> encoded SyncStep2
+    payload, scoped to the serve-log/flush epoch.
+
+    A join storm is N clients asking for the same diff between two
+    flushes — cold joiners (empty state vector) after a deploy, or a
+    partitioned building's worth of tabs reconnecting with the same
+    stale SV. Entries key on the doc name + the CUTOFF MAP actually
+    encoded (canonical: sorted (client, clock) pairs — two wire SVs
+    that trim to the same cutoffs share one entry) and validate against
+    (PlaneDoc identity, serve-log key, plane flush epoch): any
+    integrated op (log grows), device flush (epoch bump), compaction
+    (epoch bump + `forget`), or re-registration (fresh PlaneDoc) misses
+    naturally. `forget(name)` — unload/evict/degrade — drops a doc's
+    entries outright. Bounded per doc (LRU): distinct stale SVs are
+    unbounded in principle, and one hot doc must not evict another
+    doc's storm entry.
+    """
+
+    PER_DOC_CAP = 32
+
+    def __init__(self) -> None:
+        # name -> OrderedDict[sv_key -> (PlaneDoc, epoch_key, payload)]
+        self._by_name: "dict[str, OrderedDict]" = {}
+        self.evictions = 0
+
+    def get(self, name: str, doc, epoch_key, sv_key) -> Optional[bytes]:
+        entries = self._by_name.get(name)
+        if entries is None:
+            return None
+        entry = entries.get(sv_key)
+        if entry is None:
+            return None
+        if entry[0] is not doc or entry[1] != epoch_key:
+            del entries[sv_key]  # stale epoch: drop eagerly
+            return None
+        entries.move_to_end(sv_key)
+        return entry[2]
+
+    def put(self, name: str, doc, epoch_key, sv_key, payload: bytes) -> None:
+        entries = self._by_name.setdefault(name, OrderedDict())
+        entries[sv_key] = (doc, epoch_key, payload)
+        entries.move_to_end(sv_key)
+        while len(entries) > self.PER_DOC_CAP:
+            entries.popitem(last=False)
+            self.evictions += 1
+
+    def forget(self, name: str) -> None:
+        entries = self._by_name.pop(name, None)
+        if entries:
+            self.evictions += len(entries)
+
+    # dict-like surface for tests / debugging
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def __bool__(self) -> bool:
+        return bool(self._by_name)
+
+    def __len__(self) -> int:
+        return sum(len(entries) for entries in self._by_name.values())
 
 
 def _wire_parent(parent: Optional[tuple]):
@@ -98,12 +163,13 @@ class PlaneServing:
         # slot -> ((slot_gen, flush_epoch), sorted merged deleted
         # (client, clock, length) ranges): see _slot_deleted_ranges
         self._tombstone_cache: dict[int, tuple] = {}
-        # doc name -> (PlaneDoc identity, (log_len, tomb_len), bytes):
-        # every cold joiner of a doc receives the SAME SyncStep2 (sync
+        # join-storm sync cache: every joiner asking for the same diff
+        # of the same epoch receives the SAME SyncStep2 bytes (sync
         # serves drain the queues first, so the payload is a pure
-        # function of the serve log) — a reconnect storm re-encodes
-        # once per doc state, not once per joiner
-        self._cold_sync_cache: dict[str, tuple] = {}
+        # function of the serve log + cutoff map) — a reconnect storm
+        # re-encodes once per (doc state, SV), not once per joiner.
+        # Generalizes the old cold-only cache to arbitrary stale SVs.
+        self._sync_cache = SyncFrameCache()
         # catch-up batching: SyncStep1s that arrive in the same storm
         # window are triaged by ONE state_vector_diff kernel call
         self._catchup_queue: list[tuple] = []  # (name, document, sv_bytes, future)
@@ -166,12 +232,12 @@ class PlaneServing:
     def forget(self, name: str, doc: Optional[PlaneDoc]) -> None:
         """Drop every per-doc serving cache at unload/degrade time.
 
-        The cold-sync cache holds a strong ref to the PlaneDoc (and its
+        The sync cache holds a strong ref to the PlaneDoc (and its
         whole serve log); without eviction a server that churns through
         transient doc names leaks each one forever.
         """
         self.broadcast_cursor.pop(name, None)
-        self._cold_sync_cache.pop(name, None)
+        self._sync_cache.forget(name)
         if doc is not None:
             for slot in doc.seqs.values():
                 self._tombstone_cache.pop(slot, None)
@@ -606,32 +672,50 @@ class PlaneServing:
             ):
                 sm[client] = sm[client] - 1
 
-    def _encode_from_sm(
-        self,
-        doc: PlaneDoc,
-        sm: dict[int, int],
-        local_sv: "Optional[dict]" = None,
-    ) -> bytes:
+    def _cache_lookup(self, doc: PlaneDoc, epoch_key, sv_key) -> Optional[bytes]:
+        payload = self._sync_cache.get(doc.name, doc, epoch_key, sv_key)
+        counters = self.plane.counters
+        wire = get_wire_telemetry()
+        if payload is not None:
+            counters["sync_cache_hits"] += 1
+            if wire.enabled:
+                wire.record_sync_cache("hit")
+        else:
+            counters["sync_cache_misses"] += 1
+            if wire.enabled:
+                wire.record_sync_cache("miss")
+        return payload
+
+    def _cache_store(self, doc: PlaneDoc, epoch_key, sv_key, payload: bytes) -> None:
+        before = self._sync_cache.evictions
+        self._sync_cache.put(doc.name, doc, epoch_key, sv_key, payload)
+        evicted = self._sync_cache.evictions - before
+        if evicted:
+            self.plane.counters["sync_cache_evictions"] += evicted
+            wire = get_wire_telemetry()
+            if wire.enabled:
+                wire.record_sync_cache("eviction", evicted)
+
+    def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
         """SyncStep2 bytes for a doc given the per-client cutoff map.
 
-        local_sv: the plane's integrated clocks when the caller already
-        computed them (both sync paths do) — saves a second native
-        known-map fetch per serve on the storm hot path."""
+        Both paths consult the join-storm sync cache first: the payload
+        is a pure function of (serve log, cutoff map) within one flush
+        epoch, so N joiners sharing a state vector pay ONE encode."""
         plane = self.plane
         if doc.lane_slot is not None and plane._lane is not None:
             # native path: cutoff trimming, offset origin-rewrite and
             # surrogate widening all happen in C — no materialization,
             # so a reconnect storm never exports the log
-            known = local_sv if local_sv is not None else self._local_sv(doc)
-            cold = len(sm) == len(known) and all(
-                clock == 0 for clock in sm.values()
+            epoch_key = (
+                plane._lane_codec.lane_log_len(plane._lane, doc.lane_slot),
+                plane.flush_epoch,
             )
-            key = plane._lane_codec.lane_log_len(plane._lane, doc.lane_slot)
-            if cold:
-                cached = self._cold_sync_cache.get(doc.name)
-                if cached is not None and cached[0] is doc and cached[1] == key:
-                    plane.counters["sync_serves"] += 1
-                    return cached[2]
+            sv_key = tuple(sorted(sm.items()))
+            cached = self._cache_lookup(doc, epoch_key, sv_key)
+            if cached is not None:
+                plane.counters["sync_serves"] += 1
+                return cached
             encoder = Encoder()
             encoder.write_bytes(
                 plane._lane_codec.lane_window_sm(
@@ -641,21 +725,23 @@ class PlaneServing:
             self._device_delete_set(doc).write(encoder)
             plane.counters["sync_serves"] += 1
             payload = encoder.to_bytes()
-            if cold:
-                self._cold_sync_cache[doc.name] = (doc, key, payload)
+            self._cache_store(doc, epoch_key, sv_key, payload)
             return payload
         self.plane.materialize_lane(doc)
-        cold = len(sm) == len(doc.lowerer.known) and all(
-            clock == 0 for clock in sm.values()
-        )
-        if not cold:
+        if any(clock > 0 for clock in sm.values()):
+            # zero cutoffs can't slice a run, so cold serves skip the
+            # widening walk entirely
             self._widen_surrogate_cutoffs(doc.serve_log, sm)
-        key = (len(doc.serve_log), len(doc.map_tombstones))
-        if cold:
-            cached = self._cold_sync_cache.get(doc.name)
-            if cached is not None and cached[0] is doc and cached[1] == key:
-                self.plane.counters["sync_serves"] += 1
-                return cached[2]
+        epoch_key = (
+            len(doc.serve_log),
+            len(doc.map_tombstones),
+            plane.flush_epoch,
+        )
+        sv_key = tuple(sorted(sm.items()))
+        cached = self._cache_lookup(doc, epoch_key, sv_key)
+        if cached is not None:
+            plane.counters["sync_serves"] += 1
+            return cached
         encoder = Encoder()
         body = self._encode_window_native(doc, doc.serve_log, sm)
         if body is not None:
@@ -668,8 +754,7 @@ class PlaneServing:
         self._device_delete_set(doc).write(encoder)
         self.plane.counters["sync_serves"] += 1
         payload = encoder.to_bytes()
-        if cold:
-            self._cold_sync_cache[doc.name] = (doc, key, payload)
+        self._cache_store(doc, epoch_key, sv_key, payload)
         return payload
 
     def encode_state_as_update(
@@ -715,7 +800,7 @@ class PlaneServing:
             for client in local_sv:
                 if client not in target_sv:
                     sm[client] = 0
-            return self._encode_from_sm(doc, sm, local_sv)
+            return self._encode_from_sm(doc, sm)
 
     # -- batched catch-up (the storm path) -----------------------------------
 
@@ -842,7 +927,7 @@ class PlaneServing:
                         sm[cid] = 0
                 if not future.done():
                     try:
-                        future.set_result(self._encode_from_sm(doc, sm, local_sv))
+                        future.set_result(self._encode_from_sm(doc, sm))
                     except Exception:
                         future.set_result(None)
                 return
@@ -874,7 +959,7 @@ class PlaneServing:
                         for j, cid in enumerate(columns)
                         if missing_len[i, j] > 0
                     }
-                    future.set_result(self._encode_from_sm(doc, sm, local_sv))
+                    future.set_result(self._encode_from_sm(doc, sm))
                 except Exception:
                     future.set_result(None)  # degrade this request to CPU
         except Exception:

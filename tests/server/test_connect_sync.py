@@ -161,13 +161,13 @@ async def test_awareness_burst_coalesces_to_one_frame_per_tick():
         await wait_synced(*providers, observer)
         document = server.documents["aware-burst"]
         sends = {"n": 0}
-        real_flush = document._flush_awareness
+        real_flush = document.fanout.flush
 
         def counting_flush():
             sends["n"] += 1
             real_flush()
 
-        document._flush_awareness = counting_flush
+        document.fanout.flush = counting_flush
 
         # burst: each provider's awareness message arrives separately,
         # but several get applied within the same loop iterations

@@ -80,11 +80,11 @@ async def test_unload_drains_plane_rows_and_serving_caches():
         # cold joiner populates the cold-sync byte cache
         joiner = new_provider(server, name="transient")
         await wait_synced(joiner)
-        assert "transient" in ext.serving._cold_sync_cache
+        assert "transient" in ext.serving._sync_cache
         writer.destroy()
         joiner.destroy()
         await _wait(
-            lambda: not ext.plane.docs and not ext.serving._cold_sync_cache
+            lambda: not ext.plane.docs and not ext.serving._sync_cache
         )
         assert len(ext.plane.free) == 8
         assert not ext.plane.queues and not ext.plane.unit_logs
@@ -125,7 +125,7 @@ async def test_failed_reload_during_unload_still_drains():
         rejoin.destroy()
         await _wait(lambda: not ext.plane.docs, 10)
         assert len(ext.plane.free) == 8
-        assert not ext.serving._cold_sync_cache
+        assert not ext.serving._sync_cache
     finally:
         fail["on"] = False
         provider.destroy()

@@ -1,0 +1,179 @@
+"""Join-storm sync cache (tpu/serving.SyncFrameCache).
+
+N clients joining the same doc with the same state vector between
+flushes must pay ONE encode; any state change — integrated ops, a
+flush-epoch bump, compaction, eviction/unload — must invalidate.
+"""
+
+import asyncio
+
+from hocuspocus_tpu.crdt import Doc, encode_state_as_update, encode_state_vector
+from hocuspocus_tpu.tpu import TpuMergeExtension
+from hocuspocus_tpu.tpu.merge_plane import MergePlane
+from hocuspocus_tpu.tpu.serving import PlaneServing, SyncFrameCache
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+
+def _assert(cond):
+    assert cond
+
+
+def _plane_with_doc(name="cached", text="the cached payload "):
+    plane = MergePlane(num_docs=4, capacity=512)
+    serving = PlaneServing(plane)
+    ref = Doc()
+    ref.get_text("t").insert(0, text)
+    plane.register(name)
+    plane.enqueue_update(name, encode_state_as_update(ref))
+    return plane, serving, ref
+
+
+def test_join_storm_pays_one_encode():
+    plane, serving, ref = _plane_with_doc()
+    payloads = [
+        serving.encode_state_as_update("cached", ref, None) for _ in range(8)
+    ]
+    assert payloads[0] is not None
+    assert all(p == payloads[0] for p in payloads)
+    assert plane.counters["sync_cache_misses"] == 1
+    assert plane.counters["sync_cache_hits"] == 7
+
+
+def test_stale_sv_joiners_share_an_entry_distinct_from_cold():
+    """The cache keys on the cutoff map, not just 'cold': N stale
+    reconnects with the same SV share one encode, and don't collide
+    with cold joiners."""
+    plane, serving, ref = _plane_with_doc()
+    stale_sv = encode_state_vector(ref)  # fully current -> empty diff
+    ref.get_text("t").insert(0, "tail ")
+    plane.enqueue_update("cached", encode_state_as_update(ref, stale_sv))
+    cold = [serving.encode_state_as_update("cached", ref, None) for _ in range(3)]
+    stale = [
+        serving.encode_state_as_update("cached", ref, stale_sv) for _ in range(3)
+    ]
+    assert all(p == cold[0] for p in cold)
+    assert all(p == stale[0] for p in stale)
+    assert cold[0] != stale[0], "different SVs must not share bytes"
+    assert plane.counters["sync_cache_misses"] == 2  # one per distinct SV
+    assert plane.counters["sync_cache_hits"] == 4
+
+
+def test_cache_invalidates_on_new_ops_and_flush_epoch_bump():
+    plane, serving, ref = _plane_with_doc()
+    first = serving.encode_state_as_update("cached", ref, None)
+    assert plane.counters["sync_cache_misses"] == 1
+
+    # integrated ops + the flush they ride bump the epoch: next serve
+    # must re-encode (and carry the new content)
+    ref.get_text("t").insert(0, "fresh ")
+    tail = encode_state_as_update(ref)
+    plane.enqueue_update("cached", tail)
+    epoch_before = plane.flush_epoch
+    second = serving.encode_state_as_update("cached", ref, None)
+    assert plane.flush_epoch > epoch_before
+    assert second != first
+    assert plane.counters["sync_cache_misses"] == 2
+
+    # a pure epoch bump (no log change) also invalidates: the key is
+    # epoch-scoped by construction
+    plane.flush_epoch += 1
+    third = serving.encode_state_as_update("cached", ref, None)
+    assert third == second  # same bytes, but re-encoded
+    assert plane.counters["sync_cache_misses"] == 3
+
+
+def test_forget_drops_doc_entries_eviction_path():
+    """serving.forget — the eviction/unload/degrade teardown — must
+    drop the doc's cache entries (and count them as evictions)."""
+    plane, serving, ref = _plane_with_doc()
+    serving.encode_state_as_update("cached", ref, None)
+    assert "cached" in serving._sync_cache
+    serving.forget("cached", plane.docs.get("cached"))
+    assert "cached" not in serving._sync_cache
+    assert not serving._sync_cache
+    assert serving._sync_cache.evictions == 1
+
+
+def test_per_doc_lru_bound():
+    cache = SyncFrameCache()
+    doc = object()
+    for i in range(cache.PER_DOC_CAP + 5):
+        cache.put("doc", doc, ("epoch",), (("sv", i),), b"payload-%d" % i)
+    assert len(cache) == cache.PER_DOC_CAP
+    assert cache.evictions == 5
+    # oldest evicted, newest retained
+    assert cache.get("doc", doc, ("epoch",), (("sv", 0),)) is None
+    assert cache.get("doc", doc, ("epoch",), (("sv", cache.PER_DOC_CAP + 4),)) is not None
+
+
+def test_stale_doc_identity_misses():
+    """A re-registered doc (fresh PlaneDoc) must never serve the old
+    registration's bytes."""
+    cache = SyncFrameCache()
+    old_doc, new_doc = object(), object()
+    cache.put("doc", old_doc, ("e",), (), b"old")
+    assert cache.get("doc", new_doc, ("e",), ()) is None
+    assert cache.get("doc", old_doc, ("e",), ()) is None, "stale entry dropped"
+
+
+async def test_cache_invalidates_on_compaction():
+    """On-device compaction rebuilds the serve log and re-binds slots:
+    the post-compaction serve must re-encode, not replay cached bytes
+    from the pre-compaction layout."""
+    from hocuspocus_tpu.tpu.residency import ResidencyManager
+
+    plane = MergePlane(num_docs=4, capacity=64)
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(plane=plane, serving=serving)
+    ref = Doc()
+    text = ref.get_text("t")
+    text.insert(0, "abcdefghij" * 3)
+    plane.register("compactee")
+    plane.enqueue_update("compactee", encode_state_as_update(ref))
+    # tombstone most of the row so compaction has something to reclaim
+    text.delete(0, 25)
+    plane.enqueue_update("compactee", encode_state_as_update(ref))
+    plane.flush()
+    serving.refresh()
+    before = serving.encode_state_as_update("compactee", ref, None)
+    assert before is not None
+    assert "compactee" in serving._sync_cache
+    compacted = await mgr.compact_doc_locked("compactee")
+    assert compacted, "test setup: compaction should have run"
+    assert "compactee" not in serving._sync_cache, "compaction must forget"
+    serving.refresh()
+    after = serving.encode_state_as_update("compactee", ref, None)
+    assert after is not None
+    applied = Doc()
+    from hocuspocus_tpu.crdt import apply_update
+
+    apply_update(applied, after)
+    assert applied.get_text("t").to_string() == text.to_string()
+
+
+async def test_e2e_join_storm_hits_cache(monkeypatch):
+    """Through the real server: concurrent cold joiners of one served
+    doc share the cached SyncStep2 payload."""
+    ext = TpuMergeExtension(num_docs=8, capacity=1024, flush_interval_ms=1, serve=True)
+    server = await new_hocuspocus(extensions=[ext])
+    writer = new_provider(server, name="stormed")
+    joiners = []
+    try:
+        await wait_synced(writer)
+        writer.document.get_text("body").insert(0, "storm payload")
+        await retryable_assertion(
+            lambda: _assert(ext.plane.text("stormed") == "storm payload")
+        )
+        misses_before = ext.plane.counters["sync_cache_misses"]
+        joiners = [new_provider(server, name="stormed") for _ in range(6)]
+        await wait_synced(*joiners)
+        for joiner in joiners:
+            assert joiner.document.get_text("body").to_string() == "storm payload"
+        assert ext.plane.counters["sync_cache_hits"] >= 3
+        # one encode per distinct state the storm observed, not per joiner
+        assert ext.plane.counters["sync_cache_misses"] - misses_before <= 3
+    finally:
+        writer.destroy()
+        for joiner in joiners:
+            joiner.destroy()
+        await server.destroy()

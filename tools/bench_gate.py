@@ -2,9 +2,10 @@
 """Latency regression gate over bench rounds.
 
 Compares the newest two `BENCH_*.json` artifacts (or two explicit
-files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms` and
-`extra.wire_load.ingress.p99_ms` — and exits nonzero when any stage
-regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
+files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms`,
+`extra.wire_load.ingress.p99_ms` and
+`extra.fanout_storm.merge_to_last_write_p99_ms` — and exits nonzero
+when any stage regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
 (latency on shared CPU runners is noisy; the gate is for on-chip
 rounds and deliberate local runs):
 
@@ -85,6 +86,11 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
             ingress.get("p99_ms"), (int, float)
         ):
             stages["wire_load.ingress"] = float(ingress["p99_ms"])
+    fanout = extra.get("fanout_storm")
+    if isinstance(fanout, dict):
+        p99 = fanout.get("merge_to_last_write_p99_ms")
+        if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+            stages["fanout_storm.merge_to_last_write"] = float(p99)
     return stages
 
 
