@@ -198,6 +198,7 @@ def main() -> None:
         os.environ.setdefault("BENCH_RLE", "0")
         os.environ.setdefault("BENCH_WIRE", "0")
         os.environ.setdefault("BENCH_FANOUT", "0")
+        os.environ.setdefault("BENCH_REPLICA", "0")
     cpu_smoke = None
     for attempt in range(2):
         cpu_smoke = _run_inner("cpu")
@@ -627,6 +628,17 @@ def run_bench() -> None:
             fanout = _measure_fanout_storm()
         except Exception as error:
             fanout = {"error": repr(error)[:300]}
+
+    # cross-instance replication storm (net/resp.py pipelined lane +
+    # extensions/redis.py per-tick coalescing): publishes/s, frames
+    # saved vs per-update publishing, merge -> remote-broadcast p50/p99
+    replica = None
+    if os.environ.get("BENCH_REPLICA", "1") != "0":
+        _log("inner: replica-storm pass ...")
+        try:
+            replica = _measure_replica_storm()
+        except Exception as error:
+            replica = {"error": repr(error)[:300]}
     _log("inner: all passes done")
 
     merges_per_sec = total_ops / elapsed
@@ -674,6 +686,8 @@ def run_bench() -> None:
         result["extra"]["wire_load"] = wire_load
     if fanout is not None:
         result["extra"]["fanout_storm"] = fanout
+    if replica is not None:
+        result["extra"]["replica_storm"] = replica
     if jax.default_backend() != "tpu":
         onchip = _latest_onchip_capture()
         result["extra"]["note"] = (
@@ -1101,6 +1115,168 @@ def _measure_fanout_storm() -> dict:
         # the gated headline: the hot-doc shape is the pathological one
         "merge_to_last_write_p99_ms": hot["merge_to_last_write_p99_ms"],
     }
+
+
+def _measure_replica_storm() -> dict:
+    """Cross-instance replication lane under storm load (all production
+    code: two real Server instances, full provider pipeline, a real
+    MiniRedis between them — only websocket framing is absent, via the
+    in-process provider socket):
+
+    2 instances x N docs, every doc with a writer on instance A and a
+    reader on instance B, bursty concurrent edits. Reports publishes/s,
+    the pipelined flush batch profile (publishes-per-RTT), the
+    frames-saved ratio vs per-update publishing (one publish per local
+    update, what the extension did before the lane), and the
+    merge -> remote-broadcast p50/p99 (writer insert at A to the
+    reader's CPU doc reflecting it at B, through redis).
+    """
+    import asyncio
+
+    from hocuspocus_tpu.aio import await_synced
+    from hocuspocus_tpu.extensions import Redis
+    from hocuspocus_tpu.net.mini_redis import MiniRedis
+    from hocuspocus_tpu.observability.wire import get_wire_telemetry
+    from hocuspocus_tpu.provider import HocuspocusProvider
+    from hocuspocus_tpu.provider.inprocess import InProcessProviderSocket
+    from hocuspocus_tpu.server import Configuration, Server
+
+    num_docs = int(os.environ.get("BENCH_REPLICA_DOCS", 256))
+    rounds = int(os.environ.get("BENCH_REPLICA_ROUNDS", 12))
+    burst = int(os.environ.get("BENCH_REPLICA_BURST", 4))
+    docs_per_socket = int(os.environ.get("BENCH_REPLICA_DOCS_PER_SOCKET", 128))
+
+    async def run() -> dict:
+        redis = await MiniRedis().start()
+        ext_a = Redis(port=redis.port, identifier="replica-a", disconnect_delay=100)
+        ext_b = Redis(port=redis.port, identifier="replica-b", disconnect_delay=100)
+        server_a = Server(Configuration(quiet=True, extensions=[ext_a]))
+        await server_a.listen(port=0)
+        server_b = Server(Configuration(quiet=True, extensions=[ext_b]))
+        await server_b.listen(port=0)
+        writers: list = []
+        readers: list = []
+        for base in range(0, num_docs, docs_per_socket):
+            hi = min(base + docs_per_socket, num_docs)
+            socket_a = InProcessProviderSocket(server_a)
+            socket_b = InProcessProviderSocket(server_b)
+            chunk_w = []
+            for d in range(base, hi):
+                p = HocuspocusProvider(name=f"rep-{d}", websocket_provider=socket_a)
+                p.attach()
+                chunk_w.append(p)
+            await await_synced(chunk_w, 300, f"replica writers @{base}")
+            chunk_r = []
+            for d in range(base, hi):
+                p = HocuspocusProvider(name=f"rep-{d}", websocket_provider=socket_b)
+                p.attach()
+                chunk_r.append(p)
+            await await_synced(chunk_r, 300, f"replica readers @{base}")
+            writers.extend(chunk_w)
+            readers.extend(chunk_r)
+        _log(f"replica: topology up ({num_docs} docs x 2 instances)")
+
+        wire = get_wire_telemetry()
+        wire.enable()
+        before = wire.totals()
+        pub_counters = getattr(ext_a.pub, "counters", {})
+        pub_before = dict(pub_counters)
+        stats_before = dict(ext_a.replication_stats)
+
+        async def storm_round() -> list:
+            t0: dict = {}
+            lat: list = []
+            handlers = []
+            events = []
+            for d in range(num_docs):
+                wtext = writers[d].document.get_text("body")
+                rdoc = readers[d].document
+                rtext = rdoc.get_text("body")
+                expected = len(wtext) + 8 * burst
+                event = asyncio.Event()
+
+                def handler(*args, d=d, rtext=rtext, expected=expected, event=event):
+                    if not event.is_set() and len(rtext) >= expected:
+                        lat.append(time.perf_counter() - t0[d])
+                        event.set()
+
+                rdoc.on("update", handler)
+                handlers.append((rdoc, handler))
+                events.append(event)
+            try:
+                # bursty concurrent writers: every doc's burst lands in
+                # one event-loop tick at instance A
+                for d in range(num_docs):
+                    t0[d] = time.perf_counter()
+                    wtext = writers[d].document.get_text("body")
+                    for _ in range(burst):
+                        wtext.insert(len(wtext), "z" * 8)
+                await asyncio.wait_for(
+                    asyncio.gather(*(event.wait() for event in events)), timeout=120
+                )
+            finally:
+                for rdoc, handler in handlers:
+                    rdoc.off("update", handler)
+            return lat
+
+        latencies: list = []
+        t_start = time.perf_counter()
+        for _ in range(rounds):
+            latencies.extend(await storm_round())
+        elapsed = max(time.perf_counter() - t_start, 1e-9)
+
+        after = wire.totals()
+        pub_after = dict(pub_counters)
+        stats_after = dict(ext_a.replication_stats)
+        publishes = int(after["pubsub_publishes"] - before["pubsub_publishes"])
+        flushes = int(pub_after.get("flushes", 0) - pub_before.get("flushes", 0))
+        commands = int(
+            pub_after.get("commands_flushed", 0) - pub_before.get("commands_flushed", 0)
+        )
+        updates_enqueued = int(
+            stats_after["updates_enqueued"] - stats_before["updates_enqueued"]
+        )
+        frames_published = int(
+            stats_after["update_frames_published"]
+            - stats_before["update_frames_published"]
+        )
+        lat_ms = np.array(latencies) * 1000
+
+        for p in writers + readers:
+            p.destroy()
+        await server_a.destroy()
+        await server_b.destroy()
+        await redis.stop()
+        return {
+            "docs": num_docs,
+            "instances": 2,
+            "rounds": rounds,
+            "burst": burst,
+            "samples": len(latencies),
+            "publishes": publishes,
+            "publishes_per_sec": round(publishes / elapsed, 1),
+            # publishes-per-RTT: commands shipped per pipelined flush
+            # (>1 means the lane amortized round trips; the per-command
+            # client is exactly 1.0)
+            "pipeline_flushes": flushes,
+            "avg_flush_batch": round(commands / max(flushes, 1), 2),
+            "max_flush_batch": int(pub_after.get("max_batch", 0)),
+            # frames-saved vs per-update publishing (one publish per
+            # local update, the pre-lane behavior)
+            "updates_enqueued": updates_enqueued,
+            "update_frames_published": frames_published,
+            "frames_saved_ratio": round(
+                updates_enqueued / max(frames_published, 1), 2
+            ),
+            "merge_to_remote_broadcast_p50_ms": round(
+                float(np.percentile(lat_ms, 50)), 3
+            ),
+            "merge_to_remote_broadcast_p99_ms": round(
+                float(np.percentile(lat_ms, 99)), 3
+            ),
+        }
+
+    return asyncio.run(run())
 
 
 def _measure_catchup_storm() -> dict:

@@ -6,6 +6,27 @@ prefixed `[1-byte idLen][identifier][payload]` for self-filtering, a
 distributed store lock electing a single storer (SET NX PX + compare-
 and-delete release), join protocol publishing SyncStep1 + QueryAwareness
 on document load, and delayed unsubscribe on disconnect.
+
+Beyond parity, the REPLICATION FAST PATH (docs/guides/
+horizontal-scaling.md) makes the cross-instance cost O(ticks x
+channels) instead of O(updates x instances):
+
+- **Outbound: per-tick publish coalescing.** Local updates ride the
+  broadcast tick (`server/fanout.py` hands the tick's local-origin
+  updates — and the already-built wire frame when the whole tick is
+  local — to this extension's publish lane); plane window broadcasts
+  (`on_plane_broadcast`) enqueue into the same lane. One merged
+  Y-update frame per (doc, tick), awareness piggybacked, everything
+  shipped through the pipelined client's single write+drain per tick.
+- **Inbound: batched apply.** Incoming frames land in a bounded
+  per-doc inbox drained once per tick: contiguous update frames merge
+  into ONE `apply_update` (one local fan-out tick) per doc per drain;
+  overflow drops are healed by an anti-entropy SyncStep1 exchange —
+  never silent loss.
+- **Anti-entropy.** Pub/sub is at-most-once, so direct update frames
+  can vanish; a rate-limited SyncStep1 exchange per doc (immediate
+  past the window, trailing edge within it) bounds any divergence
+  window for both CPU-doc and plane-served replication.
 """
 
 from __future__ import annotations
@@ -13,11 +34,23 @@ from __future__ import annotations
 import asyncio
 import random
 import uuid
+from collections import deque
 from typing import Any, Callable, Optional
 
-from ..net.resp import ClusterSubscriber, RedisClient, RedisClusterClient, RedisSubscriber
-from ..protocol.message import IncomingMessage, OutgoingMessage
+from ..crdt import apply_update
+from ..net.resp import (
+    ClusterSubscriber,
+    PipelinedRedisClient,
+    RedisClient,
+    RedisClusterClient,
+    RedisSubscriber,
+)
+from ..observability.wire import get_wire_telemetry
+from ..protocol.frames import build_update_frame, parse_frame_header
+from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
+from ..protocol.sync import MESSAGE_YJS_UPDATE, coalesce_updates
 from ..aio import spawn_tracked
+from ..crdt.encoding import Decoder
 from ..server import REDIS_ORIGIN, logger
 from ..server.message_receiver import MessageReceiver
 from ..server.types import Extension, Payload
@@ -61,6 +94,10 @@ class Redis(Extension):
         lock_retry_delay: int = 100,
         lock_auto_extend: bool = True,
         lock_max_extends: int = 20,
+        pipeline: bool = True,
+        coalesce: bool = True,
+        inbox_batch: bool = True,
+        inbox_limit: int = 512,
     ) -> None:
         """Production seams beyond host/port (reference
         `extension-redis/src/Redis.ts:19-50,96-140`): `nodes` switches to
@@ -68,6 +105,15 @@ class Redis(Extension):
         inject arbitrary client objects (any `RedisCommands`-shaped /
         subscriber-shaped implementation); the store lock retries with
         jittered delay and auto-extends at ttl/2 while a slow store runs.
+
+        Replication fast path knobs: `pipeline` uses the fire-and-forget
+        `PipelinedRedisClient` publish lane (single-node only; clusters
+        and injected clients keep their own transport), `coalesce`
+        merges outbound publishes per (doc, tick) via the broadcast
+        fan-out seam, `inbox_batch`/`inbox_limit` batch inbound frame
+        application through a bounded per-doc inbox (overflow heals via
+        anti-entropy, never silently). All default ON; turning them off
+        restores per-op publish/apply for differential testing.
         """
         self.host = host
         self.port = port
@@ -79,12 +125,17 @@ class Redis(Extension):
         self.lock_retry_delay = lock_retry_delay
         self.lock_auto_extend = lock_auto_extend
         self.lock_max_extends = lock_max_extends
+        self.coalesce = coalesce
+        self.inbox_batch = inbox_batch
+        self.inbox_limit = inbox_limit
 
         self.redis_transaction_origin = REDIS_ORIGIN
         if create_client is not None:
             self.pub = create_client()
         elif nodes:
             self.pub = RedisClusterClient(nodes)
+        elif pipeline:
+            self.pub = PipelinedRedisClient(host, port)
         else:
             self.pub = RedisClient(host, port)
         if create_subscriber is not None:
@@ -117,6 +168,30 @@ class Redis(Extension):
         self._pending_after_store: dict[str, asyncio.TimerHandle] = {}
         identifier_bytes = self.identifier.encode()
         self.message_prefix = bytes([len(identifier_bytes)]) + identifier_bytes
+        # -- replication lane state -----------------------------------
+        # outbound: doc -> {"updates": [bytes], "frame": reusable local
+        # tick frame (valid only while it covers exactly "updates"),
+        # "awareness": [frame bytes]} flushed once per event-loop tick
+        self._pending_pub: dict[str, dict] = {}
+        self._pub_scheduled = False
+        # inbound: doc -> bounded deque of (msg_type, payload_offset,
+        # raw frame); drained once per tick, serialized by _drain_lock
+        self._inboxes: dict[str, deque] = {}
+        self._inbox_scheduled = False
+        self._drain_lock = asyncio.Lock()
+        self._overflowed: set[str] = set()
+        # observability + bench accounting for the fast path
+        self.replication_stats = {
+            "updates_enqueued": 0,
+            "update_frames_published": 0,
+            "awareness_frames_published": 0,
+            "frames_saved": 0,
+            "frames_received": 0,
+            "inbound_applies": 0,
+            "inbound_merged_saved": 0,
+            "inbox_overflows": 0,
+        }
+        get_wire_telemetry().track_redis_inbox(self)
 
     # -- keys / framing ----------------------------------------------------
 
@@ -140,9 +215,43 @@ class Redis(Extension):
         self.instance = data.instance
 
     async def after_load_document(self, data: Payload) -> None:
-        await self.sub.subscribe(self.get_key(data.document_name))
-        await self.publish_first_sync_step(data.document_name, data.document)
-        await self.request_awareness_from_other_instances(data.document_name)
+        document_name = data.document_name
+        await self.sub.subscribe(self.get_key(document_name))
+        if self.coalesce:
+            self._register_replication_seam(data.document)
+        await self._publish_join_batch(document_name, data.document)
+
+    async def _publish_join_batch(self, document_name: str, document) -> None:
+        """The join/resync protocol: SyncStep1 + QueryAwareness leave as
+        ONE pipelined batch (enqueue-only on the pipelined client, a
+        single execute_many round trip otherwise) instead of two
+        serialized publish RTTs."""
+        step1 = (
+            OutgoingMessage(document_name)
+            .create_sync_message()
+            .write_first_sync_step_for(document)
+            .to_bytes()
+        )
+        query = OutgoingMessage(document_name).write_query_awareness().to_bytes()
+        await self._publish_batch(document_name, [step1, query])
+
+    def _register_replication_seam(self, document) -> None:
+        """Point the document's broadcast tick at the publish lane: the
+        tick's local-origin updates (and its awareness frame) replicate
+        with the tick's own coalescing + encode."""
+        fanout = getattr(document, "fanout", None)
+        if fanout is None:
+            return
+        name = document.name
+
+        def replicate_updates(frame, updates, _name=name):
+            self._queue_replication(_name, updates, frame)
+
+        def replicate_awareness(frame, _name=name):
+            self._queue_awareness_frame(_name, frame)
+
+        fanout.replicate_updates = replicate_updates
+        fanout.replicate_awareness = replicate_awareness
 
     async def publish_first_sync_step(self, document_name: str, document) -> None:
         sync_message = (
@@ -150,9 +259,132 @@ class Redis(Extension):
             .create_sync_message()
             .write_first_sync_step_for(document)
         )
-        await self.pub.publish(
-            self.get_key(document_name), self.encode_message(sync_message.to_bytes())
+        await self._publish(document_name, sync_message.to_bytes())
+
+    # -- the publish lane --------------------------------------------------
+
+    async def _publish(self, document_name: str, payload: bytes) -> None:
+        """Publish one framed message; enqueue-only on the pipelined
+        client (the ack is consumed by its reply reader), awaited
+        round-trip otherwise."""
+        channel = self.get_key(document_name)
+        data = self.encode_message(payload)
+        nowait = getattr(self.pub, "publish_nowait", None)
+        if nowait is not None:
+            nowait(channel, data)
+        else:
+            await self.pub.publish(channel, data)
+
+    def _publish_nowait(self, document_name: str, payload: bytes) -> None:
+        """Sync-context publish: enqueue on the pipelined client, else a
+        tracked fire-and-forget task."""
+        channel = self.get_key(document_name)
+        data = self.encode_message(payload)
+        nowait = getattr(self.pub, "publish_nowait", None)
+        if nowait is not None:
+            nowait(channel, data)
+        else:
+            spawn_tracked(self._tasks, self.pub.publish(channel, data))
+
+    async def _publish_batch(self, document_name: str, payloads: list) -> None:
+        """Ship several messages for one doc in ONE round trip."""
+        channel = self.get_key(document_name)
+        nowait = getattr(self.pub, "publish_nowait", None)
+        if nowait is not None:
+            for payload in payloads:
+                nowait(channel, self.encode_message(payload))
+            return
+        execute_many = getattr(self.pub, "execute_many", None)
+        if execute_many is not None:
+            await execute_many(
+                [
+                    ("PUBLISH", channel, self.encode_message(payload))
+                    for payload in payloads
+                ]
+            )
+            return
+        for payload in payloads:
+            await self.pub.publish(channel, self.encode_message(payload))
+
+    def _queue_replication(
+        self, document_name: str, updates: list, frame: Optional[bytes] = None
+    ) -> None:
+        """Enqueue local update payloads for the per-tick replication
+        flush. `frame` is the local tick's already-built wire frame,
+        reusable only while it covers exactly this entry's updates."""
+        entry = self._pending_pub.setdefault(
+            document_name, {"updates": [], "frame": None, "awareness": []}
         )
+        if entry["updates"]:
+            entry["frame"] = None  # frame no longer covers the entry
+        else:
+            entry["frame"] = frame
+        entry["updates"].extend(updates)
+        self.replication_stats["updates_enqueued"] += len(updates)
+        self._schedule_pub_flush()
+
+    def _queue_awareness_frame(self, document_name: str, frame: bytes) -> None:
+        entry = self._pending_pub.setdefault(
+            document_name, {"updates": [], "frame": None, "awareness": []}
+        )
+        entry["awareness"].append(frame)
+        self._schedule_pub_flush()
+
+    def _schedule_pub_flush(self) -> None:
+        if self._pub_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._flush_publishes()  # no loop (direct/test use)
+            return
+        self._pub_scheduled = True
+        loop.call_soon(self._flush_publishes)
+
+    def _flush_publishes(self) -> None:
+        """One replication flush: per doc, merge the tick's updates into
+        ONE frame (reusing the local tick frame when handed one; falling
+        back to per-update frames on merge failure so nothing is lost),
+        publish awareness piggybacked — all enqueued into the pipelined
+        outbox, which ships the whole tick in one write+drain."""
+        self._pub_scheduled = False
+        pending = self._pending_pub
+        if not pending:
+            return
+        self._pending_pub = {}
+        stats = self.replication_stats
+        wire = get_wire_telemetry()
+        for name, entry in pending.items():
+            updates = entry["updates"]
+            frame = entry["frame"]
+            if updates:
+                saved = 0
+                if frame is None:
+                    merged = coalesce_updates(updates)
+                    if merged is None:
+                        # merge failure: per-update frames, no loss
+                        for update in updates:
+                            self._publish_nowait(
+                                name, build_update_frame(name, update)
+                            )
+                        stats["update_frames_published"] += len(updates)
+                    else:
+                        self._publish_nowait(name, build_update_frame(name, merged))
+                        stats["update_frames_published"] += 1
+                        saved = len(updates) - 1
+                else:
+                    # encode-once across the boundary: the local tick's
+                    # frame bytes ship as-is
+                    self._publish_nowait(name, frame)
+                    stats["update_frames_published"] += 1
+                    saved = len(updates) - 1
+                if saved:
+                    stats["frames_saved"] += saved
+                    if wire.enabled:
+                        wire.record_redis_frames_saved(saved, direction="publish")
+            for awareness_frame in entry["awareness"]:
+                self._publish_nowait(name, awareness_frame)
+                stats["awareness_frames_published"] += 1
 
     async def _resync_after_reconnect(self) -> None:
         """Subscriber self-healed after an outage: pull missed state.
@@ -166,16 +398,9 @@ class Redis(Extension):
             return
         for name, document in list(self.instance.documents.items()):
             try:
-                await self.publish_first_sync_step(name, document)
-                await self.request_awareness_from_other_instances(name)
+                await self._publish_join_batch(name, document)
             except Exception:
                 logger.log_error(f"[redis] post-reconnect resync failed for {name!r}")
-
-    async def request_awareness_from_other_instances(self, document_name: str) -> None:
-        message = OutgoingMessage(document_name).write_query_awareness()
-        await self.pub.publish(
-            self.get_key(document_name), self.encode_message(message.to_bytes())
-        )
 
     async def on_store_document(self, data: Payload) -> None:
         """Acquire the distributed store lock; losing after all retries
@@ -274,49 +499,220 @@ class Redis(Extension):
             await waiter
 
     async def on_awareness_update(self, data: Payload) -> None:
+        document = data.document if hasattr(data, "document") else None
+        fanout = getattr(document, "fanout", None)
+        if (
+            self.coalesce
+            and fanout is not None
+            and fanout.replicate_awareness is not None
+        ):
+            # piggybacked on the broadcast tick: the fan-out engine's
+            # per-tick awareness frame replicates via the publish lane
+            # (one encode, one publish per doc-tick) — publishing here
+            # too would double every awareness frame
+            return
         changed_clients = data.added + data.updated + data.removed
         message = OutgoingMessage(data.document_name).create_awareness_update_message(
             data.awareness, changed_clients
         )
-        await self.pub.publish(
-            self.get_key(data.document_name), self.encode_message(message.to_bytes())
-        )
+        await self._publish(data.document_name, message.to_bytes())
 
     def _handle_incoming_message(self, channel: bytes, data: bytes) -> None:
         identifier, message_data = self.decode_message(data)
         if identifier == self.identifier:
             return
-        message = IncomingMessage(message_data)
-        document_name = message.read_var_string()
-        message.write_var_string(document_name)
         if self.instance is None:
             return
-        document = self.instance.documents.get(document_name)
-        if document is None:
-            return
-
-        def reply(response: bytes) -> None:
+        if not self.inbox_batch:
+            message = IncomingMessage(message_data)
+            document_name = message.read_var_string()
+            message.write_var_string(document_name)
+            document = self.instance.documents.get(document_name)
+            if document is None:
+                return
+            receiver = MessageReceiver(message, self.redis_transaction_origin)
             spawn_tracked(
                 self._tasks,
-                self.pub.publish(
-                    self.get_key(document.name), self.encode_message(response)
-                ),
+                receiver.apply(document, None, self._make_reply(document.name)),
             )
+            return
+        try:
+            document_name, message_type, offset = parse_frame_header(message_data)
+        except Exception:
+            return  # malformed frame: nothing safe to enqueue
+        if document_name not in self.instance.documents:
+            return
+        inbox = self._inboxes.setdefault(document_name, deque())
+        self.replication_stats["frames_received"] += 1
+        if len(inbox) >= self.inbox_limit:
+            # bounded inbox: the frame is DROPPED, but never silently —
+            # the drain publishes an anti-entropy SyncStep1 for the doc,
+            # and the resulting state exchange carries everything the
+            # dropped frames did (sync is state-based)
+            self._overflowed.add(document_name)
+            self.replication_stats["inbox_overflows"] += 1
+            wire = get_wire_telemetry()
+            if wire.enabled:
+                wire.record_redis_inbox_overflow()
+            self._schedule_inbox_drain()
+            return
+        inbox.append((message_type, offset, message_data))
+        self._schedule_inbox_drain()
 
-        receiver = MessageReceiver(message, self.redis_transaction_origin)
-        spawn_tracked(self._tasks, receiver.apply(document, None, reply))
+    def _make_reply(self, document_name: str) -> Callable[[bytes], None]:
+        def reply(response: bytes) -> None:
+            self._publish_nowait(document_name, response)
+
+        return reply
+
+    def inbox_depth(self) -> int:
+        """Queued inbound frames (the wire-telemetry depth gauge)."""
+        return sum(len(inbox) for inbox in self._inboxes.values())
+
+    def _schedule_inbox_drain(self) -> None:
+        if self._inbox_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # subscriber callbacks only fire inside a loop
+        self._inbox_scheduled = True
+        loop.call_soon(self._start_inbox_drain)
+
+    def _start_inbox_drain(self) -> None:
+        self._inbox_scheduled = False
+        if not self._inboxes and not self._overflowed:
+            return
+        spawn_tracked(self._tasks, self._drain_inboxes())
+
+    async def _drain_inboxes(self) -> None:
+        """One inbound tick: per doc, decode all queued frames, merge
+        contiguous update frames into ONE apply_update (one local
+        fan-out tick), apply everything else in arrival order through
+        the normal receiver. Serialized: two drains must not interleave
+        one doc's frames."""
+        async with self._drain_lock:
+            while self._inboxes or self._overflowed:
+                inboxes = self._inboxes
+                overflowed = self._overflowed
+                self._inboxes = {}
+                self._overflowed = set()
+                wire = get_wire_telemetry()
+                for document_name, frames in inboxes.items():
+                    document = (
+                        self.instance.documents.get(document_name)
+                        if self.instance is not None
+                        else None
+                    )
+                    if document is None:
+                        continue  # unloaded while queued
+                    if wire.enabled:
+                        wire.record_redis_inbox_drain(len(frames))
+                    try:
+                        await self._apply_doc_frames(document, frames)
+                    except Exception:
+                        logger.log_error(
+                            f"[redis] inbound drain failed for {document_name!r}"
+                        )
+                for document_name in overflowed:
+                    # anti-entropy healing for dropped frames
+                    document = (
+                        self.instance.documents.get(document_name)
+                        if self.instance is not None
+                        else None
+                    )
+                    if document is None:
+                        continue
+                    try:
+                        await self._publish_join_batch(document_name, document)
+                    except Exception:
+                        logger.log_error(
+                            f"[redis] overflow resync failed for {document_name!r}"
+                        )
+
+    @staticmethod
+    def _extract_update(message_type: int, offset: int, raw: bytes) -> Optional[bytes]:
+        """The update payload of a Sync/SyncReply UPDATE frame, else
+        None (anything with reply or hook semantics keeps the receiver
+        path)."""
+        if message_type not in (MessageType.Sync, MessageType.SyncReply):
+            return None
+        try:
+            decoder = Decoder(raw)
+            decoder.pos = offset
+            if decoder.read_var_uint() != MESSAGE_YJS_UPDATE:
+                return None
+            return decoder.read_var_uint8_array()
+        except Exception:
+            return None
+
+    async def _apply_doc_frames(self, document, frames) -> None:
+        stats = self.replication_stats
+        pending_updates: list = []
+
+        def flush_updates() -> None:
+            if not pending_updates:
+                return
+            updates = list(pending_updates)
+            pending_updates.clear()
+            merged = coalesce_updates(updates) if len(updates) > 1 else updates[0]
+            if merged is not None:
+                try:
+                    apply_update(document, merged, self.redis_transaction_origin)
+                    stats["inbound_applies"] += 1
+                    saved = len(updates) - 1
+                    if saved:
+                        stats["inbound_merged_saved"] += saved
+                        wire = get_wire_telemetry()
+                        if wire.enabled:
+                            wire.record_redis_frames_saved(saved, direction="apply")
+                    return
+                except Exception:
+                    pass  # fall through to per-update application
+            for update in updates:
+                try:
+                    apply_update(document, update, self.redis_transaction_origin)
+                    stats["inbound_applies"] += 1
+                except Exception:
+                    logger.log_error(
+                        f"[redis] inbound update apply failed for {document.name!r}"
+                    )
+
+        for message_type, offset, raw in frames:
+            update = self._extract_update(message_type, offset, raw)
+            if update is not None:
+                pending_updates.append(update)
+                continue
+            # order matters: apply buffered updates before a frame with
+            # handshake/reply semantics (Step1/Step2/awareness/...)
+            flush_updates()
+            message = IncomingMessage(raw)
+            document_name = message.read_var_string()
+            message.write_var_string(document_name)
+            receiver = MessageReceiver(message, self.redis_transaction_origin)
+            try:
+                await receiver.apply(document, None, self._make_reply(document.name))
+            except Exception:
+                logger.log_error(
+                    f"[redis] inbound frame apply failed for {document.name!r}"
+                )
+        flush_updates()
 
     async def on_plane_broadcast(self, data: Payload) -> None:
         """Cross-instance fan-out of a serve-mode plane window: publish
         the merged update frame itself — peers apply it directly. One
         coalesced message per doc-window instead of the per-op
         SyncStep1/Step2 round trips (which remain, rate-limited, as
-        anti-entropy below and as the join protocol)."""
-        from ..protocol.frames import build_update_frame
-
-        await self.pub.publish(
-            self.get_key(data.document_name),
-            self.encode_message(build_update_frame(data.document_name, data.update)),
+        anti-entropy below and as the join protocol). With coalescing
+        on, the window rides the per-tick publish lane — several
+        windows landing in one event-loop tick merge into one frame,
+        and the publish shares the pipelined flush with every other
+        channel's tick traffic."""
+        if self.coalesce:
+            self._queue_replication(data.document_name, [data.update])
+            return
+        await self._publish(
+            data.document_name, build_update_frame(data.document_name, data.update)
         )
 
     async def on_change(self, data: Payload) -> None:
@@ -328,11 +724,18 @@ class Redis(Extension):
             not hasattr(source, "is_capturing")
             or source.is_capturing(data.document_name)
         )
-        if capturing:
-            # plane-served: steady propagation rides the window frames
-            # (on_plane_broadcast); keep a LOW-RATE SyncStep1 exchange
-            # per doc as anti-entropy so a dropped pub/sub message heals
-            # instead of desyncing the peer forever
+        fanout = getattr(document, "fanout", None)
+        coalescing = (
+            self.coalesce
+            and fanout is not None
+            and fanout.replicate_updates is not None
+        )
+        if capturing or coalescing:
+            # steady propagation rides the coalesced update frames (the
+            # plane's window broadcasts / the CPU tick's replication
+            # seam); keep a LOW-RATE SyncStep1 exchange per doc as
+            # anti-entropy so a dropped pub/sub message heals instead
+            # of desyncing the peer forever
             name = data.document_name
             now = asyncio.get_event_loop().time()
             last = self._last_anti_entropy.get(name, 0.0)
@@ -389,9 +792,7 @@ class Redis(Extension):
 
     async def before_broadcast_stateless(self, data: Payload) -> None:
         message = OutgoingMessage(data.document_name).write_broadcast_stateless(data.payload)
-        await self.pub.publish(
-            self.get_key(data.document_name), self.encode_message(message.to_bytes())
-        )
+        await self._publish(data.document_name, message.to_bytes())
 
     async def on_destroy(self, data: Payload) -> None:
         for handle in list(self._pending_disconnects.values()):
@@ -404,5 +805,25 @@ class Redis(Extension):
         for held in list(self.locks.values()):
             if held.extend_handle is not None:
                 held.extend_handle.cancel()
+        # ship what the lane already holds: enqueue pending frames, then
+        # give the publish machinery one BOUNDED chance to drain before
+        # close() sheds whatever is left (pub/sub is at-most-once and
+        # peers heal via anti-entropy, so a timeout here loses nothing
+        # that the protocol can't recover)
+        try:
+            self._flush_publishes()
+            waitables = [task for task in self._tasks if not task.done()]
+            flush_task = getattr(self.pub, "_flush_task", None)
+            if flush_task is not None and not flush_task.done():
+                waitables.append(flush_task)
+            if waitables:
+                await asyncio.wait_for(
+                    asyncio.gather(*waitables, return_exceptions=True), timeout=1.0
+                )
+        except Exception:
+            pass
+        self._pending_pub.clear()
+        self._inboxes.clear()
+        self._overflowed.clear()
         self.pub.close()
         self.sub.close()

@@ -32,7 +32,12 @@ def _int(value: int) -> bytes:
 
 
 class MiniRedis:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        subscriber_queue_limit: int = 1024,
+    ) -> None:
         self.host = host
         self.port = port
         self.data: dict[bytes, tuple[bytes, Optional[float]]] = {}
@@ -40,6 +45,20 @@ class MiniRedis:
         self.subscribers: dict[bytes, set[asyncio.StreamWriter]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.StreamWriter] = set()
+        # per-subscriber bounded outbound queues: a subscriber that
+        # stops reading fills its queue and gets DISCONNECTED (like a
+        # real redis hitting client-output-buffer-limit pubsub) instead
+        # of growing an unbounded transport buffer; counters let
+        # replication tests assert loss-healing end to end
+        self.subscriber_queue_limit = subscriber_queue_limit
+        self._sub_queues: dict[asyncio.StreamWriter, asyncio.Queue] = {}
+        self._pump_tasks: dict[asyncio.StreamWriter, asyncio.Task] = {}
+        self.counters = {
+            "delivered": 0,
+            "dropped_injected": 0,
+            "dropped_slow": 0,
+            "slow_disconnects": 0,
+        }
         # cluster emulation: list of (start, end, MiniRedis) covering the
         # slot space; keyed commands off this node's ranges answer MOVED,
         # publishes fan out to every node's subscribers (the cluster bus)
@@ -74,12 +93,59 @@ class MiniRedis:
         message = _array([_bulk(b"message"), _bulk(channel), _bulk(payload)])
         delivered = 0
         for sub_writer in list(receivers):
+            queue = self._sub_queues.get(sub_writer)
+            if queue is None:
+                receivers.discard(sub_writer)  # connection already gone
+                continue
             try:
-                sub_writer.write(message)
+                queue.put_nowait(message)
                 delivered += 1
-            except Exception:
-                receivers.discard(sub_writer)
+            except asyncio.QueueFull:
+                # slow subscriber: drop the frame AND the client (its
+                # backlog dies with it) — matches real redis pub/sub
+                # under client-output-buffer-limit, and the extension's
+                # anti-entropy must absorb exactly this
+                self.counters["dropped_slow"] += 1
+                self._disconnect_slow(sub_writer)
+                wire = get_wire_telemetry()
+                if wire.enabled:
+                    wire.record_publish(0, dropped=True)
+        self.counters["delivered"] += delivered
         return delivered
+
+    def _disconnect_slow(self, writer: asyncio.StreamWriter) -> None:
+        self.counters["slow_disconnects"] += 1
+        for receivers in self.subscribers.values():
+            receivers.discard(writer)
+        task = self._pump_tasks.pop(writer, None)
+        if task is not None:
+            task.cancel()
+        self._sub_queues.pop(writer, None)
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+    def _ensure_pump(self, writer: asyncio.StreamWriter) -> None:
+        if writer in self._sub_queues:
+            return
+        queue: asyncio.Queue = asyncio.Queue(self.subscriber_queue_limit)
+        self._sub_queues[writer] = queue
+        self._pump_tasks[writer] = asyncio.ensure_future(self._pump(queue, writer))
+
+    async def _pump(self, queue: asyncio.Queue, writer: asyncio.StreamWriter) -> None:
+        """Drain one subscriber's queue: whole backlog per wake, one
+        drain() for the batch (the transport writer's batching idiom)."""
+        try:
+            while True:
+                writer.write(await queue.get())
+                while not queue.empty():
+                    writer.write(queue.get_nowait())
+                await writer.drain()
+        except asyncio.CancelledError:
+            return
+        except (OSError, ConnectionError):
+            return
 
     async def start(self) -> "MiniRedis":
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -92,6 +158,10 @@ class MiniRedis:
             # drop live client connections like a real redis restart
             # would (and Python 3.12's wait_closed otherwise blocks on
             # handlers that sit in read_reply forever)
+            for task in list(self._pump_tasks.values()):
+                task.cancel()
+            self._pump_tasks.clear()
+            self._sub_queues.clear()
             for writer in list(self._conns):
                 writer.close()
             await self._server.wait_closed()
@@ -137,7 +207,10 @@ class MiniRedis:
                             b"-ASK %d %s:%d\r\n"
                             % (key_hash_slot(routed_key), target.host.encode(), target.port)
                         )
-                        await writer.drain()
+                        try:
+                            await writer.drain()
+                        except (ConnectionError, OSError):
+                            break
                         continue
                     owner = self._owns(routed_key)
                     if owner is not None and not was_asking:
@@ -145,7 +218,10 @@ class MiniRedis:
                             b"-MOVED %d %s:%d\r\n"
                             % (key_hash_slot(routed_key), owner.host.encode(), owner.port)
                         )
-                        await writer.drain()
+                        try:
+                            await writer.drain()
+                        except (ConnectionError, OSError):
+                            break
                         continue
                 if command == b"ASKING":
                     asking = True
@@ -231,11 +307,15 @@ class MiniRedis:
                         # (subscriber never sees it; publisher is none
                         # the wiser — pub/sub is at-most-once)
                         self.drop_publishes -= 1
+                        self.counters["dropped_injected"] += 1
                         wire = get_wire_telemetry()
                         if wire.enabled:
                             wire.record_publish(0, dropped=True)
                         writer.write(b":0\r\n")
-                        await writer.drain()
+                        try:
+                            await writer.drain()
+                        except (ConnectionError, OSError):
+                            break
                         continue
                     delivered = self._deliver(channel, payload)
                     if self.cluster_ranges is not None:
@@ -253,6 +333,7 @@ class MiniRedis:
                         wire.record_publish(delivered)
                     writer.write(b":%d\r\n" % delivered)
                 elif command == b"SUBSCRIBE":
+                    self._ensure_pump(writer)
                     for channel in args:
                         self.subscribers.setdefault(channel, set()).add(writer)
                         subscribed.add(channel)
@@ -282,9 +363,16 @@ class MiniRedis:
                     writer.write(_bulk(b"# mini-redis\r\nredis_version:7.0.0-mini"))
                 else:
                     writer.write(b"-ERR unknown command\r\n")
-                await writer.drain()
+                try:
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    break  # client went away mid-reply (teardown/restart)
         finally:
             for channel in subscribed:
                 self.subscribers.get(channel, set()).discard(writer)
+            task = self._pump_tasks.pop(writer, None)
+            if task is not None:
+                task.cancel()
+            self._sub_queues.pop(writer, None)
             self._conns.discard(writer)
             writer.close()

@@ -9,6 +9,8 @@ uses ioredis pub + sub clients the same way).
 from __future__ import annotations
 
 import asyncio
+import time
+from collections import deque
 from typing import Any, Callable, Optional, Union
 
 CRLF = b"\r\n"
@@ -137,17 +139,35 @@ class RedisCommands:
         await self.execute("FLUSHALL")
 
     async def acquire_lock(self, key: str, token: str, ttl_ms: int) -> bool:
+        want = token.encode() if isinstance(token, str) else token
+        execute_many = getattr(self, "execute_many", None)
+        if execute_many is not None:
+            # ONE pipelined round trip: the SET NX and the holder probe
+            # share a single write+drain instead of two serialized RTTs.
+            # The GET doubles as the lost-reply self-acquisition check:
+            # if the FIRST transport attempt executed server-side with
+            # its reply lost, execute_many's no-reply-consumed retry
+            # re-runs both commands — SET NX then fails (our token holds
+            # the key) but the GET returns our token, proving this call
+            # acquired the lock. Tokens are unique per attempt.
+            replies = await execute_many(
+                [("SET", key, token, "PX", ttl_ms, "NX"), ("GET", key)]
+            )
+            set_reply, holder = replies
+            if isinstance(set_reply, RespError):
+                raise set_reply
+            return set_reply == "OK" or (
+                not isinstance(holder, RespError) and holder == want
+            )
         if await self.set(key, token, nx=True, px=ttl_ms) == "OK":
             return True
-        # Lost-reply self-acquisition: execute() retries a transport
-        # failure once, and the FIRST attempt may have executed
-        # server-side with its reply lost — the retry then sees the key
-        # held and reports the lock unavailable while OUR token holds it
-        # for a full TTL. Tokens are unique per acquisition attempt, so
-        # a GET matching this token proves this call acquired the lock.
-        # (One extra round trip only on the contended/failed path.)
+        # Lost-reply self-acquisition (clients without execute_many):
+        # execute() retries a transport failure once, and the FIRST
+        # attempt may have executed server-side with its reply lost —
+        # the retry then sees the key held and reports the lock
+        # unavailable while OUR token holds it for a full TTL. A GET
+        # matching this token proves this call acquired the lock.
         current = await self.get(key)
-        want = token.encode() if isinstance(token, str) else token
         return current == want
 
     async def release_lock(self, key: str, token: str) -> bool:
@@ -259,6 +279,306 @@ class RedisClient(RedisCommands):
             self.writer.close()
             self.writer = None
             self.reader = None
+
+
+class _PipelinedCommand:
+    __slots__ = ("encoded", "future", "attempts", "enqueued_at", "is_publish")
+
+    def __init__(
+        self,
+        encoded: bytes,
+        future: Optional[asyncio.Future],
+        is_publish: bool = False,
+    ) -> None:
+        self.encoded = encoded
+        self.future = future
+        self.attempts = 0
+        self.enqueued_at = time.perf_counter()
+        self.is_publish = is_publish
+
+
+class PipelinedRedisClient(RedisClient):
+    """Fire-and-forget RESP pipeline lane over one connection.
+
+    The plain client's `execute` pays one serialized round trip per
+    command under the connection lock — write, drain, await the reply.
+    The replication hot path (extensions/redis.py) publishes once per
+    (doc, tick) across potentially hundreds of busy docs, so per-command
+    RTTs make the cross-instance cost O(updates x instances). This lane
+    makes it O(ticks x channels):
+
+    - `publish_nowait` is ENQUEUE-ONLY: it appends the encoded command
+      to an outgoing buffer and returns. A flush task scheduled once per
+      event-loop tick concatenates everything buffered and ships it in
+      a single `write` + `drain` — N same-tick publishes cost one
+      syscall pair and one RTT, not N.
+    - A background reply reader consumes acks asynchronously in command
+      order (RESP replies are strictly ordered), counts `-ERR` replies
+      (`counters["reply_errors"]`, surfaced via wire telemetry) without
+      desyncing the stream, and resolves the futures of commands that
+      went through `execute`/`execute_many` — which ride the same lane,
+      so concurrent lock traffic coalesces into the tick flush too.
+    - On a transport failure the stream RESYNCS: the connection drops,
+      unacked in-flight commands are requeued at the front of the
+      buffer (ONE resend attempt each — publishes are at-most-once for
+      the extension, and the CRDT payloads are idempotent so a
+      duplicate from the ack-lost window is harmless) and the next
+      flush re-sends complete encoded commands on the fresh socket.
+      Buffered commands are therefore either flushed or resent — never
+      half-written: partial bytes died with the old socket.
+    - If the server stays unreachable (two connect attempts per flush
+      cycle), pending work is SHED: futures fail with ConnectionError,
+      publishes are counted dropped. The extension's anti-entropy
+      SyncStep1 exchange heals dropped replication frames.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        max_pending: int = 65536,
+        reconnect_delay: float = 0.05,
+    ) -> None:
+        super().__init__(host, port)
+        self._outbox: "deque[_PipelinedCommand]" = deque()
+        self._inflight: "deque[_PipelinedCommand]" = deque()
+        self._flush_task: Optional[asyncio.Task] = None
+        self._reply_task: Optional[asyncio.Task] = None
+        self.max_pending = max_pending
+        self.reconnect_delay = reconnect_delay
+        self.counters = {
+            "publishes": 0,
+            "flushes": 0,
+            "commands_flushed": 0,
+            "max_batch": 0,
+            "reply_errors": 0,
+            "resyncs": 0,
+            "dropped": 0,
+        }
+        from ..observability.wire import get_wire_telemetry
+
+        get_wire_telemetry().track_redis_pipeline(self)
+
+    # -- enqueue lane ------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Commands buffered or awaiting their ack (the depth gauge)."""
+        return len(self._outbox) + len(self._inflight)
+
+    def publish_nowait(self, channel: str, data: Union[bytes, str]) -> None:
+        """Enqueue one PUBLISH; returns immediately. The ack is consumed
+        by the background reply reader. Overflow past `max_pending` is
+        counted dropped (at-most-once — anti-entropy heals)."""
+        if self._closed:
+            raise ConnectionError("redis client closed")
+        if self.pending >= self.max_pending:
+            self.counters["dropped"] += 1
+            return
+        self.counters["publishes"] += 1
+        self._enqueue(
+            encode_command("PUBLISH", channel, data), None, is_publish=True
+        )
+
+    async def execute(self, *args: Union[bytes, str, int, float], key=None) -> Any:
+        if self._closed:
+            raise ConnectionError("redis client closed")
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._enqueue(encode_command(*args), future)
+        return await future
+
+    async def execute_many(self, commands: list[tuple]) -> list[Any]:
+        """Pipeline semantics match RedisClient.execute_many: error
+        replies come back as RespError VALUES; transport failures (after
+        the resend attempt) raise. All commands ride one flush batch."""
+        if self._closed:
+            raise ConnectionError("redis client closed")
+        loop = asyncio.get_running_loop()
+        futures = [loop.create_future() for _ in commands]
+        for command, future in zip(commands, futures):
+            self._enqueue(encode_command(*command), future)
+        replies = await asyncio.gather(*futures, return_exceptions=True)
+        for reply in replies:
+            if isinstance(reply, Exception) and not isinstance(reply, RespError):
+                raise reply
+        return list(replies)
+
+    def _enqueue(
+        self,
+        encoded: bytes,
+        future: Optional[asyncio.Future],
+        is_publish: bool = False,
+    ) -> None:
+        self._outbox.append(_PipelinedCommand(encoded, future, is_publish))
+        self._schedule_flush()
+
+    def _schedule_flush(self) -> None:
+        if self._flush_task is not None and not self._flush_task.done():
+            return
+        try:
+            # get_RUNNING_loop, strictly: get_event_loop() would hand
+            # back a non-running loop outside async context and pin
+            # _flush_task to a task that never executes — wedging every
+            # later flush behind its not-done() check
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop: flushed when the next async call runs one
+        # the task's first step runs via call_soon, i.e. AFTER the
+        # current callback finishes — every same-tick enqueue lands in
+        # this flush's batch
+        self._flush_task = loop.create_task(self._flush_loop())
+
+    # -- the flush ---------------------------------------------------------
+
+    async def _flush_loop(self) -> None:
+        try:
+            while self._outbox and not self._closed:
+                if not self.connected:
+                    if not await self._reconnect():
+                        self._shed_pending()
+                        return
+                self._ensure_reply_reader()
+                batch = list(self._outbox)
+                self._outbox.clear()
+                self._inflight.extend(batch)
+                oldest_wait = time.perf_counter() - batch[0].enqueued_at
+                try:
+                    # ONE write + drain for the whole batch: the
+                    # concatenation is the entire point of the lane
+                    self.writer.write(b"".join(c.encoded for c in batch))
+                    await self.writer.drain()
+                except (OSError, ConnectionError):
+                    self._resync()
+                    continue
+                # account only SUCCESSFUL flushes: a failed write is
+                # re-flushed after the resync and must not double-count
+                # the same commands in the batch-size profile
+                self.counters["flushes"] += 1
+                self.counters["commands_flushed"] += len(batch)
+                if len(batch) > self.counters["max_batch"]:
+                    self.counters["max_batch"] = len(batch)
+                from ..observability.wire import get_wire_telemetry
+
+                wire = get_wire_telemetry()
+                if wire.enabled:
+                    wire.record_redis_flush(len(batch), oldest_wait)
+        finally:
+            self._flush_task = None
+            if self._outbox and not self._closed:
+                # commands enqueued during the final drain await
+                self._schedule_flush()
+
+    async def _reconnect(self) -> bool:
+        for attempt in (0, 1):
+            try:
+                await self.connect()
+                return True
+            except (OSError, ConnectionError):
+                if self._closed:
+                    return False
+                if attempt == 0:
+                    await asyncio.sleep(self.reconnect_delay)
+        return False
+
+    def _shed_pending(self) -> None:
+        """Server unreachable after retries: fail futures, count dropped
+        publishes. Pending work must not wedge callers forever."""
+        error = ConnectionError("redis unreachable; pipelined commands shed")
+        for queue in (self._inflight, self._outbox):
+            while queue:
+                self._fail(queue.popleft(), error)
+
+    def _fail(self, command: _PipelinedCommand, error: Exception) -> None:
+        if command.future is not None:
+            if not command.future.done():
+                command.future.set_exception(error)
+        elif command.is_publish:
+            self.counters["dropped"] += 1
+
+    def _resync(self) -> None:
+        """Transport failure with commands possibly executed but unacked:
+        drop the socket, requeue unacked commands (one resend each) at
+        the FRONT of the outbox so order is preserved on the fresh
+        connection. Half-written bytes died with the old socket — the
+        resend writes complete encoded commands."""
+        self._drop_connection()
+        # retire the reply reader bound to the dead stream: left alive,
+        # it could still drain the old socket's buffered replies and
+        # pop REQUEUED commands out of _inflight against the wrong
+        # attempt — and _ensure_reply_reader would see it not-done and
+        # never start a reader for the fresh connection
+        task = self._reply_task
+        if task is not None and not task.done():
+            try:
+                current = asyncio.current_task()
+            except RuntimeError:
+                current = None
+            if task is not current:
+                task.cancel()
+        self._reply_task = None
+        self.counters["resyncs"] += 1
+        requeue = []
+        while self._inflight:
+            command = self._inflight.popleft()
+            command.attempts += 1
+            if command.attempts >= 2:
+                self._fail(command, ConnectionError("redis connection lost"))
+            else:
+                requeue.append(command)
+        self._outbox.extendleft(reversed(requeue))
+
+    # -- the reply reader --------------------------------------------------
+
+    def _ensure_reply_reader(self) -> None:
+        if self._reply_task is None or self._reply_task.done():
+            self._reply_task = asyncio.ensure_future(self._reply_loop(self.reader))
+
+    async def _reply_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while not self._closed:
+                try:
+                    reply = await read_reply(reader)
+                except RespError as error:
+                    # a server ERROR reply — the stream is still in
+                    # sync (the line was consumed); account and move on
+                    self.counters["reply_errors"] += 1
+                    from ..observability.wire import get_wire_telemetry
+
+                    wire = get_wire_telemetry()
+                    if wire.enabled:
+                        wire.record_redis_reply_error()
+                    command = self._inflight.popleft() if self._inflight else None
+                    if command is not None and command.future is not None:
+                        if not command.future.done():
+                            command.future.set_exception(error)
+                    continue
+                command = self._inflight.popleft() if self._inflight else None
+                if command is not None and command.future is not None:
+                    if not command.future.done():
+                        command.future.set_result(reply)
+        except asyncio.CancelledError:
+            return
+        except (OSError, ConnectionError, asyncio.IncompleteReadError):
+            # the connection died under the reader. Only resync if the
+            # stream we were reading is still the live one — a flush
+            # write failure (or close) already handled replacement
+            if reader is self.reader and not self._closed:
+                self._resync()
+                if self._outbox:
+                    self._schedule_flush()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._reply_task is not None:
+            self._reply_task.cancel()
+            self._reply_task = None
+        error = ConnectionError("redis client closed")
+        for queue in (self._inflight, self._outbox):
+            while queue:
+                command = queue.popleft()
+                if command.future is not None and not command.future.done():
+                    command.future.set_exception(error)
+        super().close()
 
 
 class RedisClusterClient(RedisCommands):

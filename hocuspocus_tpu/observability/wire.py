@@ -148,12 +148,61 @@ class WireTelemetry:
         )
         self.pubsub_dropped = Counter(
             "hocuspocus_wire_pubsub_dropped_total",
-            "mini_redis publishes dropped by fault injection",
+            "mini_redis publish deliveries dropped, by reason (injected "
+            "fault / slow-subscriber disconnect)",
+        )
+        # -- cross-instance replication lane (net/resp.py pipelined
+        # client + extensions/redis.py publish coalescing / inbound
+        # inbox) ------------------------------------------------------
+        self.redis_pipeline_depth = Gauge(
+            "hocuspocus_redis_pipeline_depth",
+            "Commands buffered or awaiting their ack across live "
+            "pipelined Redis clients (summed)",
+            fn=self._total_pipeline_depth,
+        )
+        self.redis_flush_batch = Histogram(
+            "hocuspocus_redis_flush_batch_commands",
+            "Commands shipped per pipelined flush (one write+drain)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),  # counts
+        )
+        self.redis_publish_flush_seconds = Histogram(
+            "hocuspocus_redis_publish_flush_seconds",
+            "Oldest-command wait from enqueue to its flush write",
+        )
+        self.redis_reply_errors = Counter(
+            "hocuspocus_redis_reply_errors_total",
+            "Error replies consumed by the pipelined reply reader",
+        )
+        self.redis_inbox_depth = Gauge(
+            "hocuspocus_redis_inbox_depth",
+            "Inbound replication frames queued across per-doc inboxes "
+            "(summed over live Redis extensions)",
+            fn=self._total_inbox_depth,
+        )
+        self.redis_inbox_drained = Histogram(
+            "hocuspocus_redis_inbox_drained_frames",
+            "Inbound frames consumed per doc per inbox drain",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),  # counts
+        )
+        self.redis_inbox_overflows = Counter(
+            "hocuspocus_redis_inbox_overflow_total",
+            "Inbound frames dropped by a full per-doc inbox (each "
+            "triggers an anti-entropy SyncStep1 exchange)",
+        )
+        self.redis_frames_saved = Counter(
+            "hocuspocus_redis_frames_saved_total",
+            "Cross-instance publishes avoided by per-tick replication "
+            "coalescing, by direction (publish/apply)",
         )
         # live transports (weak: an abandoned transport must not leak
         # through the gauge); per-transport watermark armed state rides
         # in the map value
         self._transports: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        # live pipelined redis clients (expose `.pending`) and Redis
+        # extensions (expose `.inbox_depth()`), weakly held for the
+        # depth gauges — closed/collected instances fall out on their own
+        self._redis_pipelines: "weakref.WeakSet" = weakref.WeakSet()
+        self._redis_inbox_sources: "weakref.WeakSet" = weakref.WeakSet()
         # egress header-parse cache (see record_egress_frame): identity
         # of the last frame parsed + its type (strong ref on purpose —
         # object identity is only trustworthy while the object lives)
@@ -290,6 +339,53 @@ class WireTelemetry:
         if delivered:
             self.pubsub_deliveries.inc(delivered)
 
+    # -- cross-instance replication lane -------------------------------------
+
+    def track_redis_pipeline(self, client) -> None:
+        """Register a pipelined client whose `.pending` feeds the depth
+        gauge (weakly held)."""
+        self._redis_pipelines.add(client)
+
+    def track_redis_inbox(self, source) -> None:
+        """Register an inbox owner whose `.inbox_depth()` feeds the
+        inbound depth gauge (weakly held)."""
+        self._redis_inbox_sources.add(source)
+
+    def record_redis_flush(self, batch_size: int, oldest_wait_seconds: float) -> None:
+        self.redis_flush_batch.observe(float(batch_size))
+        self.redis_publish_flush_seconds.observe(oldest_wait_seconds)
+
+    def record_redis_reply_error(self) -> None:
+        self.redis_reply_errors.inc()
+
+    def record_redis_inbox_drain(self, frames: int) -> None:
+        self.redis_inbox_drained.observe(float(frames))
+
+    def record_redis_inbox_overflow(self, count: int = 1) -> None:
+        self.redis_inbox_overflows.inc(count)
+
+    def record_redis_frames_saved(self, count: int, direction: str = "publish") -> None:
+        if count > 0:
+            self.redis_frames_saved.inc(count, direction=direction)
+
+    def _total_pipeline_depth(self) -> int:
+        total = 0
+        for client in list(self._redis_pipelines):
+            try:
+                total += client.pending
+            except Exception:
+                continue
+        return total
+
+    def _total_inbox_depth(self) -> int:
+        total = 0
+        for source in list(self._redis_inbox_sources):
+            try:
+                total += source.inbox_depth()
+            except Exception:
+                continue
+        return total
+
     # -- registry binding ----------------------------------------------------
 
     def metrics(self) -> Iterable:
@@ -317,6 +413,14 @@ class WireTelemetry:
             self.pubsub_publishes,
             self.pubsub_deliveries,
             self.pubsub_dropped,
+            self.redis_pipeline_depth,
+            self.redis_flush_batch,
+            self.redis_publish_flush_seconds,
+            self.redis_reply_errors,
+            self.redis_inbox_depth,
+            self.redis_inbox_drained,
+            self.redis_inbox_overflows,
+            self.redis_frames_saved,
         )
 
     # -- reading (bench / tests) ---------------------------------------------
@@ -338,6 +442,12 @@ class WireTelemetry:
             "sync_cache_hits": self.sync_cache_events.value(result="hit"),
             "sync_cache_misses": self.sync_cache_events.value(result="miss"),
             "queue_overflows": sum(self.send_queue_overflows._values.values()),
+            "pubsub_publishes": sum(self.pubsub_publishes._values.values()),
+            "pubsub_deliveries": sum(self.pubsub_deliveries._values.values()),
+            "pubsub_dropped": sum(self.pubsub_dropped._values.values()),
+            "redis_reply_errors": sum(self.redis_reply_errors._values.values()),
+            "redis_inbox_overflows": sum(self.redis_inbox_overflows._values.values()),
+            "redis_frames_saved": sum(self.redis_frames_saved._values.values()),
         }
 
 

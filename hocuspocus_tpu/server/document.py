@@ -19,6 +19,7 @@ from ..protocol.awareness import (
 from ..protocol.frames import build_update_frame
 from ..protocol.message import OutgoingMessage
 from .fanout import DocumentFanout
+from .types import REDIS_ORIGIN
 
 
 class Document(Doc):
@@ -151,16 +152,20 @@ class Document(Doc):
         # broadcast fan-out (reference Document.ts:228-240 fans out per
         # update; here bursts within one event-loop iteration coalesce
         # into ONE merged frame — same latency via call_soon, 1/N the
-        # frame builds + websocket sends + receiver applies)
-        self.fanout.queue_update(update)
+        # frame builds + websocket sends + receiver applies). Updates
+        # applied FROM the redis bus are flagged non-replicable so the
+        # tick's replication seam can't echo them back across instances.
+        self.fanout.queue_update(update, replicate=origin != REDIS_ORIGIN)
 
     def queue_broadcast(self, update: bytes, on_complete=None) -> None:
         """Enqueue a ready update payload onto the current broadcast
         tick (the plane's window broadcasts ride this). `on_complete`
         is invoked with the last-socket-enqueue timestamp once the
         tick's fan-out finished — where the lifecycle trace's fan-out
-        stage closes."""
-        self.fanout.queue_update(update, on_complete)
+        stage closes. Plane windows carry local AND remote-origin ops,
+        so they are never replicated from here — the plane publishes a
+        remote-op-stripped `cross_update` via `on_plane_broadcast`."""
+        self.fanout.queue_update(update, on_complete, replicate=False)
 
     def broadcast_update_frame(self, update: bytes) -> None:
         """Immediate (tickless) fan-out of one update — the degrade

@@ -31,6 +31,16 @@ does exactly that). This module makes it O(ticks x audiences):
   frames per drain cycle instead of O(updates), and can never stall
   the tick: the tick never awaits any transport.
 
+- **Replication seam.** The tick is also where updates cross the
+  INSTANCE boundary: when the Redis extension registers
+  `replicate_updates`/`replicate_awareness`, the flush hands its
+  local-origin updates (and, when the whole tick was local, the
+  already-built wire frame plus the tick's awareness frame) to the
+  per-tick publish lane (`extensions/redis.py`) — one coalesce and one
+  encode serve both the local audience and every peer instance.
+  Remote-origin updates are flagged `replicate=False` at enqueue and
+  never re-cross the boundary.
+
 - **Trace closure.** Plane broadcasts pass an `on_complete` callback
   (`Document.queue_broadcast`); the tick invokes it with the
   last-socket-enqueue timestamp, which is where the PR-4 lifecycle
@@ -203,16 +213,30 @@ class DocumentFanout:
     def __init__(self, document) -> None:
         self.document = document
         self._pending_updates: list[bytes] = []
+        self._pending_replicate: list[bool] = []
         self._pending_awareness: set[int] = set()
         self._on_complete: list[Callable[[float], Any]] = []
         self._scheduled = False
+        # cross-instance replication seam (extensions/redis.py): when
+        # set, the tick hands its LOCAL-origin updates — and, when the
+        # whole tick is local, the already-built wire frame — to the
+        # replication lane, so the instance boundary reuses the tick's
+        # coalescing and encode instead of re-paying both per update.
+        # Remote-origin updates (replicate=False) never re-cross the
+        # boundary: republishing them would echo between instances.
+        self.replicate_updates: Optional[Callable[[Optional[bytes], list], Any]] = None
+        self.replicate_awareness: Optional[Callable[[bytes], Any]] = None
 
     # -- enqueue -----------------------------------------------------------
 
     def queue_update(
-        self, update: bytes, on_complete: Optional[Callable[[float], Any]] = None
+        self,
+        update: bytes,
+        on_complete: Optional[Callable[[float], Any]] = None,
+        replicate: bool = True,
     ) -> None:
         self._pending_updates.append(update)
+        self._pending_replicate.append(replicate)
         if on_complete is not None:
             self._on_complete.append(on_complete)
         self._schedule()
@@ -237,10 +261,12 @@ class DocumentFanout:
     def flush(self) -> None:
         self._scheduled = False
         pending = self._pending_updates
+        replicate_flags = self._pending_replicate
         awareness_clients = self._pending_awareness
         callbacks = self._on_complete
         if pending:
             self._pending_updates = []
+            self._pending_replicate = []
         if awareness_clients:
             self._pending_awareness = set()
         if callbacks:
@@ -254,6 +280,7 @@ class DocumentFanout:
         wire = get_wire_telemetry()
         elided = 0
         if pending:
+            frame = None
             update = coalesce_updates(pending)
             if update is None:
                 # merge failure must not lose updates: per-update frames
@@ -262,18 +289,42 @@ class DocumentFanout:
                         audience, build_update_frame(document.name, u)
                     )
             else:
-                elided += self.deliver(
-                    audience, build_update_frame(document.name, update)
-                )
+                frame = build_update_frame(document.name, update)
+                elided += self.deliver(audience, frame)
                 if wire.enabled and audience:
                     wire.record_fanout_frame(
                         len(pending), (len(pending) - 1) * len(audience)
                     )
-        if awareness_clients and audience:
+            if self.replicate_updates is not None:
+                replicable = [
+                    u for u, r in zip(pending, replicate_flags) if r
+                ]
+                if replicable:
+                    # the built frame is reusable across the instance
+                    # boundary only when it covers EXACTLY the
+                    # replicable set (a tick mixing remote-origin
+                    # updates needs a separate coalesce in the lane)
+                    reuse = frame if len(replicable) == len(pending) else None
+                    try:
+                        self.replicate_updates(reuse, replicable)
+                    except Exception:
+                        pass  # replication must never break local fan-out
+        if awareness_clients and (
+            audience or self.replicate_awareness is not None
+        ):
             message = OutgoingMessage(document.name).create_awareness_update_message(
                 document.awareness, list(awareness_clients)
             )
-            elided += self.deliver(audience, message.to_bytes())
+            data = message.to_bytes()
+            if audience:
+                elided += self.deliver(audience, data)
+            if self.replicate_awareness is not None:
+                # awareness piggybacks on the tick: the SAME frame bytes
+                # cross the instance boundary (encode once, both sides)
+                try:
+                    self.replicate_awareness(data)
+                except Exception:
+                    pass
         if wire.enabled and elided:
             wire.record_catchup_elided(elided)
         if callbacks:
@@ -305,5 +356,8 @@ class DocumentFanout:
     def close(self) -> None:
         """Drop pending work (document destroyed)."""
         self._pending_updates = []
+        self._pending_replicate = []
         self._pending_awareness = set()
         self._on_complete = []
+        self.replicate_updates = None
+        self.replicate_awareness = None

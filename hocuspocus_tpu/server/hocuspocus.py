@@ -31,9 +31,8 @@ from .types import (
     Extension,
     HOOK_NAMES,
     Payload,
+    REDIS_ORIGIN,
 )
-
-REDIS_ORIGIN = "__hocuspocus__redis__origin__"
 
 
 class RequestInfo:

@@ -10,6 +10,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional
 
+# Transaction origin marking changes applied from the Redis replication
+# bus (defined here, not in hocuspocus.py, so Document's hot path can
+# read it without a circular import; re-exported from server/__init__).
+REDIS_ORIGIN = "__hocuspocus__redis__origin__"
+
 # All lifecycle hooks, in the reference's vocabulary (snake_cased).
 HOOK_NAMES = (
     "on_configure",

@@ -28,9 +28,13 @@ def _assert(cond):
 
 
 async def test_dropped_pubsub_frame_heals_on_next_sync_exchange():
-    """Plain (non-plane) doc: each local change publishes a SyncStep1;
-    one dropped frame loses that round, but sync is STATE-based — the
-    next change's Step1/Step2 exchange carries everything missing."""
+    """Plain (non-plane) doc on the replication fast path: a local edit
+    publishes its coalesced tick update frame plus (rate-limited) one
+    anti-entropy SyncStep1. Drop BOTH so instance B misses the edit
+    entirely; the next edit's frame alone cannot close the gap (its
+    structs depend on the lost ones and sit in B's pending buffer), so
+    healing must come from the state-based Step1/Step2 exchange the
+    anti-entropy machinery keeps running."""
     redis = await MiniRedis().start()
     server_a = await new_hocuspocus(
         extensions=[Redis(port=redis.port, identifier="drop-a", disconnect_delay=100)]
@@ -42,17 +46,25 @@ async def test_dropped_pubsub_frame_heals_on_next_sync_exchange():
     provider_b = new_provider(server_b, name="droppy")
     try:
         await wait_synced(provider_a, provider_b)
-        # eat the Step1 that edit #1 will publish (channel-scoped so an
-        # unrelated frame can't consume the injected fault)
+        # let the join/handshake exchange drain COMPLETELY: a straggling
+        # Step2/awareness publish would eat the injected drops and let
+        # the edit's frames slip through
+        last = -1
+        while redis.counters["delivered"] != last:
+            last = redis.counters["delivered"]
+            await asyncio.sleep(0.5)
+        # eat the update frame AND the anti-entropy Step1 that edit #1
+        # publishes (channel-scoped so an unrelated frame can't consume
+        # the injected fault)
         redis.drop_channel = b"hocuspocus:droppy"
-        redis.drop_publishes = 1
+        redis.drop_publishes = 2
         provider_a.document.get_text("t").insert(0, "first")
         # event-driven wait: the fault has fired once the counter drains
         await retryable_assertion(lambda: _assert(redis.drop_publishes == 0))
         assert provider_b.document.get_text("t").to_string() == "", (
-            "edit crossed despite the dropped frame — fault never injected"
+            "edit crossed despite the dropped frames — fault never injected"
         )
-        # edit #2's exchange must heal BOTH edits
+        # edit #2 plus the trailing anti-entropy exchange must heal BOTH
         provider_a.document.get_text("t").insert(5, " second")
         await retryable_assertion(
             lambda: _assert(

@@ -3,8 +3,9 @@
 
 Compares the newest two `BENCH_*.json` artifacts (or two explicit
 files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms`,
-`extra.wire_load.ingress.p99_ms` and
-`extra.fanout_storm.merge_to_last_write_p99_ms` — and exits nonzero
+`extra.wire_load.ingress.p99_ms`,
+`extra.fanout_storm.merge_to_last_write_p99_ms` and
+`extra.replica_storm.merge_to_remote_broadcast_p99_ms` — and exits nonzero
 when any stage regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
 (latency on shared CPU runners is noisy; the gate is for on-chip
 rounds and deliberate local runs):
@@ -91,6 +92,11 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
         p99 = fanout.get("merge_to_last_write_p99_ms")
         if isinstance(p99, (int, float)) and not isinstance(p99, bool):
             stages["fanout_storm.merge_to_last_write"] = float(p99)
+    replica = extra.get("replica_storm")
+    if isinstance(replica, dict):
+        p99 = replica.get("merge_to_remote_broadcast_p99_ms")
+        if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+            stages["replica_storm.merge_to_remote_broadcast"] = float(p99)
     return stages
 
 
