@@ -629,6 +629,17 @@ def run_bench() -> None:
         except Exception as error:
             fanout = {"error": repr(error)[:300]}
 
+    # durability plane (storage/wal.py): WAL group-commit overhead on
+    # the broadcast path (on vs off), append->durable p50/p99, fsync
+    # batch amortization and the 10k-update recovery replay time
+    wal_load = None
+    if os.environ.get("BENCH_WAL", "1") != "0":
+        _log("inner: wal-load pass ...")
+        try:
+            wal_load = _measure_wal_load()
+        except Exception as error:
+            wal_load = {"error": repr(error)[:300]}
+
     # cross-instance replication storm (net/resp.py pipelined lane +
     # extensions/redis.py per-tick coalescing): publishes/s, frames
     # saved vs per-update publishing, merge -> remote-broadcast p50/p99
@@ -684,6 +695,8 @@ def run_bench() -> None:
         result["extra"]["catchup_storm"] = storm
     if wire_load is not None:
         result["extra"]["wire_load"] = wire_load
+    if wal_load is not None:
+        result["extra"]["wal_load"] = wal_load
     if fanout is not None:
         result["extra"]["fanout_storm"] = fanout
     if replica is not None:
@@ -1115,6 +1128,168 @@ def _measure_fanout_storm() -> dict:
         # the gated headline: the hot-doc shape is the pathological one
         "merge_to_last_write_p99_ms": hot["merge_to_last_write_p99_ms"],
     }
+
+
+def _measure_wal_load() -> dict:
+    """Durability-plane characterization (docs/guides/durability.md):
+
+    - broadcast overhead: the sparse busy-doc shape (many docs, few
+      busy per tick, real Documents/Connections/transports) measured
+      merge -> LAST-socket-write with the WAL capture seam + broadcast
+      gate attached (`--wal-fsync=tick` semantics) vs detached. The
+      acceptance bar is <15% p99 overhead.
+    - append latency: append -> group-commit-durable p50/p99 and the
+      fsync amortization actually achieved (records per fsync).
+    - recovery: wall time to scan + replay a 10k-update log into a
+      fresh document (the restart-after-kill-9 cost).
+    """
+    import asyncio
+    import shutil
+    import tempfile
+
+    from hocuspocus_tpu.server.connection import Connection
+    from hocuspocus_tpu.server.document import Document
+    from hocuspocus_tpu.server.transports import CallbackWebSocketTransport
+    from hocuspocus_tpu.storage import WalManager
+
+    num_docs = int(os.environ.get("BENCH_WAL_DOCS", 64))
+    conns_per_doc = int(os.environ.get("BENCH_WAL_CONNS", 4))
+    rounds = int(os.environ.get("BENCH_WAL_ROUNDS", 24))
+    burst = int(os.environ.get("BENCH_WAL_BURST", 4))
+    replay_updates = int(os.environ.get("BENCH_WAL_REPLAY", 10_000))
+
+    async def storm(wal: "WalManager | None") -> dict:
+        documents = [Document(f"wal-{i}") for i in range(num_docs)]
+        if wal is not None:
+            # warm the log exactly as a live server does at load time
+            # (first append per doc pays the mkdir+open once): the
+            # timed rounds measure the steady-state group commit
+            for document in documents:
+                wal.append(document.name, b"\x00\x00")
+            await wal.flush()
+            for document in documents:
+                name = document.name
+                document.wal_sink = (
+                    lambda update, origin, n=name: wal.append(n, update)
+                )
+        writes = {"count": 0, "t_last": 0.0, "target": 1 << 62}
+        pending = asyncio.Event()
+
+        async def send_async(data: bytes) -> None:
+            writes["count"] += 1
+            writes["t_last"] = time.perf_counter()
+            if writes["count"] >= writes["target"]:
+                pending.set()
+
+        async def close_async(code: int, reason: str) -> None:
+            pass
+
+        transports = []
+        for document in documents:
+            for c in range(conns_per_doc):
+                transport = CallbackWebSocketTransport(send_async, close_async)
+                Connection(transport, None, document, f"s{c}", {})
+                transports.append(transport)
+        total_conns = num_docs * conns_per_doc
+        latencies = []
+        # one untimed round first: doc/fanout/transport machinery and
+        # (in the wal pass) the gate/commit path warm symmetrically, so
+        # the on-vs-off ratio compares steady states — first-run
+        # warm-up must not masquerade as WAL overhead
+        for round_no in range(rounds + 1):
+            writes["target"] = writes["count"] + total_conns
+            pending.clear()
+            t0 = time.perf_counter()
+            for document in documents:
+                text = document.get_text("t")
+                for _ in range(burst):
+                    text.insert(len(text), "x" * 24)
+            await asyncio.wait_for(pending.wait(), timeout=60)
+            if round_no > 0:
+                latencies.append(writes["t_last"] - t0)
+        if wal is not None:
+            await wal.flush()
+        for transport in transports:
+            transport.abort()
+        lat_ms = np.array(latencies) * 1000
+        return {
+            "merge_to_last_write_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "merge_to_last_write_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        }
+
+    wal_dir = tempfile.mkdtemp(prefix="hocuspocus-wal-bench-")
+    try:
+        wal = WalManager(os.path.join(wal_dir, "storm"), fsync="tick")
+        with_wal = asyncio.run(storm(wal))
+        baseline = asyncio.run(storm(None))
+        appended = wal.stats["appended_records"]
+        fsyncs = max(wal.stats["fsyncs"], 1)
+
+        # append -> durable latency distribution (its own loop: each
+        # await resolves at that tick's group commit)
+        async def append_latency() -> "list[float]":
+            lat = []
+            wal2 = WalManager(os.path.join(wal_dir, "lat"), fsync="tick")
+            payload = b"y" * 64
+            for i in range(256):
+                t0 = time.perf_counter()
+                await wal2.append("append-doc", payload)
+                lat.append(time.perf_counter() - t0)
+            return lat
+
+        append_ms = np.array(asyncio.run(append_latency())) * 1000
+
+        # recovery replay: scan + apply a 10k-update log
+        from hocuspocus_tpu.crdt import Doc, apply_update
+
+        async def build_and_replay() -> "tuple[float, int]":
+            wal3 = WalManager(os.path.join(wal_dir, "replay"), fsync="off")
+            seed = Doc()
+            updates: "list[bytes]" = []
+            seed.on("update", lambda update, *rest: updates.append(update))
+            text = seed.get_text("t")
+            for i in range(replay_updates):
+                text.insert(len(text), "z")
+            for update in updates:
+                wal3.append("replay-doc", update)
+            await wal3.flush()
+            wal3.close()
+            cold = WalManager(os.path.join(wal_dir, "replay"), fsync="off")
+            t0 = time.perf_counter()
+            records, report = await cold.replay("replay-doc")
+            doc = Doc()
+            for _rec_type, payload in records:
+                apply_update(doc, payload)
+            elapsed = time.perf_counter() - t0
+            assert len(str(doc.get_text("t"))) == replay_updates
+            return elapsed, report["records"]
+
+        replay_s, replayed = asyncio.run(build_and_replay())
+        on_p99 = with_wal["merge_to_last_write_p99_ms"]
+        off_p99 = baseline["merge_to_last_write_p99_ms"]
+        return {
+            "docs": num_docs,
+            "connections": num_docs * conns_per_doc,
+            "rounds": rounds,
+            "burst": burst,
+            "wal_on": with_wal,
+            "wal_off": baseline,
+            # the gated headline: fractional p99 overhead of tick-fsync
+            # group commit on the merge->broadcast path (budget: <0.15)
+            "broadcast_p99_overhead": round(
+                (on_p99 - off_p99) / max(off_p99, 1e-9), 4
+            ),
+            "append_p50_ms": round(float(np.percentile(append_ms, 50)), 3),
+            "append_p99_ms": round(float(np.percentile(append_ms, 99)), 3),
+            "records_per_fsync": round(appended / fsyncs, 2),
+            "fsyncs": int(wal.stats["fsyncs"]),
+            "appended_records": int(appended),
+            "replay_updates": int(replayed),
+            "replay_seconds": round(replay_s, 3),
+            "replay_updates_per_sec": round(replayed / max(replay_s, 1e-9), 1),
+        }
+    finally:
+        shutil.rmtree(wal_dir, ignore_errors=True)
 
 
 def _measure_replica_storm() -> dict:

@@ -3,6 +3,19 @@
 from __future__ import annotations
 
 import asyncio
+import random
+
+
+def backoff_delay_s(attempt: int, base_ms: float, max_ms: float) -> float:
+    """Bounded exponential backoff with full jitter, in SECONDS.
+
+    `attempt` counts completed failures (0 = first retry). The ceiling
+    doubles per attempt up to `max_ms`; the delay is drawn uniformly
+    from [ceiling/2, ceiling] so a herd of retriers spreads out. Shared
+    by the store-retry chain (server/hocuspocus.py) and the webhook
+    delivery retries (extensions/webhook.py)."""
+    ceiling = min(base_ms * (2 ** attempt), max_ms)
+    return random.uniform(ceiling / 2, ceiling) / 1000.0
 
 
 async def await_synced(providers, timeout: float = 30.0, what: str = "providers") -> None:

@@ -26,6 +26,44 @@ def build_parser() -> argparse.ArgumentParser:
         help="store documents in SQLite (optional path, default in-memory)",
     )
     parser.add_argument("--s3", action="store_true", help="store documents in S3")
+    # durability plane (docs/guides/durability.md): per-doc write-ahead
+    # log + crash recovery, store retry/quarantine, graceful drain
+    parser.add_argument(
+        "--wal-dir",
+        help="enable the write-ahead log: append every update to a "
+        "segmented CRC-framed per-document log under this directory "
+        "BEFORE broadcast, and replay the log suffix over the stored "
+        "snapshot at load — a kill -9 between debounced stores loses "
+        "nothing (docs/guides/durability.md)",
+    )
+    parser.add_argument(
+        "--wal-fsync",
+        choices=("tick", "always", "off"),
+        default="tick",
+        help="WAL durability mode: 'tick' group-commits with one fsync "
+        "per doc per event-loop tick (default), 'always' fsyncs every "
+        "record, 'off' writes without fsync (OS-decided durability)",
+    )
+    parser.add_argument(
+        "--store-retries",
+        type=int,
+        default=2,
+        help="retries (after the first attempt) for a failing "
+        "on_store_document chain, with exponential backoff + jitter; "
+        "after exhaustion the doc is quarantined — kept loaded, WAL "
+        "retained, periodically re-stored, /healthz degraded — instead "
+        "of silently dropping data (default 2)",
+    )
+    parser.add_argument(
+        "--drain-timeout-secs",
+        type=float,
+        default=20.0,
+        help="SIGTERM drain deadline: stop accepting connections, flush "
+        "the WAL, store every dirty doc concurrently within this many "
+        "seconds, then close clients with 1012 Service Restart; docs "
+        "still storing at the deadline are quarantined, never lost "
+        "(default 20)",
+    )
     parser.add_argument("--s3-bucket", help="S3 bucket")
     parser.add_argument("--s3-region", default="us-east-1", help="S3 region")
     parser.add_argument("--s3-prefix", default="", help="S3 key prefix")
@@ -225,6 +263,10 @@ async def run(args: argparse.Namespace) -> None:
                 slo_error_rate=args.slo_error_rate,
             )
         )
+    if args.wal_dir:
+        from .storage import Durability
+
+        extensions.append(Durability(wal_dir=args.wal_dir, fsync=args.wal_fsync))
     if args.sqlite is not None:
         extensions.append(SQLite(database=args.sqlite))
     if args.s3:
@@ -269,17 +311,35 @@ async def run(args: argparse.Namespace) -> None:
             )
         )
 
-    server = Server(Configuration(extensions=extensions, quiet=False))
+    server = Server(
+        Configuration(
+            extensions=extensions,
+            quiet=False,
+            store_retries=max(args.store_retries, 0),
+            drain_timeout_secs=args.drain_timeout_secs,
+        )
+    )
     await server.listen(port=args.port, host=args.host)
 
     stop = asyncio.Event()
+    drain_requested = False
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
+
+    def request_stop(graceful: bool) -> None:
+        nonlocal drain_requested
+        drain_requested = drain_requested or graceful
+        stop.set()
+
+    for sig, graceful in ((signal.SIGINT, False), (signal.SIGTERM, True)):
         try:
-            loop.add_signal_handler(sig, stop.set)
+            loop.add_signal_handler(sig, request_stop, graceful)
         except NotImplementedError:
             pass
     await stop.wait()
+    if drain_requested:
+        # SIGTERM = orchestrated shutdown: drain first (flush WAL, store
+        # dirty docs under the deadline, 1012 the clients), then tear down
+        await server.drain(args.drain_timeout_secs)
     await server.destroy()
 
 

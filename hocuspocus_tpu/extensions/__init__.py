@@ -1,3 +1,4 @@
+from ..storage import Durability
 from .database import Database
 from .history import History
 from .incremental import IncrementalSQLite
@@ -10,6 +11,7 @@ from .webhook import Events, Webhook
 
 __all__ = [
     "Database",
+    "Durability",
     "History",
     "IncrementalSQLite",
     "Logger",

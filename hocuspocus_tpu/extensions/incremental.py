@@ -95,6 +95,9 @@ class IncrementalSQLite(Database):
         name = data.document_name
         delta = encode_state_as_update(data.document, self._last_sv.get(name))
         if delta == _EMPTY_DELTA:
+            # nothing new since the last store — the log rows already
+            # cover everything, so the WAL may still truncate
+            data["wal_covered"] = True
             return
         current_sv = encode_state_vector(data.document)
 
@@ -135,6 +138,9 @@ class IncrementalSQLite(Database):
 
         await asyncio.to_thread(write)
         self._last_sv[name] = current_sv
+        # delta (or snapshot) row committed: the WAL suffix up to the
+        # Durability extension's captured position is covered
+        data["wal_covered"] = True
 
     async def after_unload_document(self, data: Payload) -> None:
         self._last_sv.pop(data.document_name, None)

@@ -4,6 +4,14 @@ POSTs document lifecycle events to a URL with an HMAC-SHA256 signature
 header `X-Hocuspocus-Signature-256`; imports JSON into empty fields on
 load; onConnect response JSON becomes connection context (failure =>
 Forbidden).
+
+Requests carry a timeout and retry transient failures (network errors
+and 5xx responses) with bounded exponential backoff + jitter — the
+reference ships webhook retries; firing once with no timeout turns any
+slow endpoint into a hung hook chain. Retries are counted in
+`hocuspocus_webhook_retries_total` (exposed when a `Metrics` extension
+is configured). 4xx responses are NOT retried: the endpoint understood
+the request and rejected it.
 """
 
 from __future__ import annotations
@@ -17,6 +25,17 @@ from enum import Enum
 from typing import Any, Optional
 
 import aiohttp
+
+from ..observability.metrics import Counter
+
+# process-global (the wire-telemetry pattern): several Webhook
+# instances share ONE counter object, so a second instance's registry
+# adoption is a no-op instead of a swallowed name collision that would
+# hide its retries from /metrics
+_RETRIES_TOTAL = Counter(
+    "hocuspocus_webhook_retries_total",
+    "Webhook request retries after a transient failure, by event",
+)
 
 from ..protocol.close_events import CloseError, FORBIDDEN
 from ..server import logger
@@ -40,6 +59,10 @@ class Webhook(Extension):
         events: Optional[list[Events]] = None,
         debounce: Optional[float] = 2000,
         debounce_max_wait: float = 10000,
+        request_timeout: float = 10000,
+        retries: int = 2,
+        retry_base_ms: float = 250,
+        retry_max_ms: float = 5000,
     ) -> None:
         if not url:
             raise ValueError("url is required!")
@@ -50,6 +73,26 @@ class Webhook(Extension):
         self.debounce_ms = debounce
         self.debounce_max_wait = debounce_max_wait
         self.debounced: dict[str, dict] = {}
+        # delivery robustness: per-request timeout (ms) + bounded
+        # exponential-backoff retries with full jitter on transient
+        # failures (connection errors, timeouts, 5xx)
+        self.request_timeout_ms = request_timeout
+        self.retries = max(int(retries), 0)
+        self.retry_base_ms = retry_base_ms
+        self.retry_max_ms = retry_max_ms
+        self.retries_total = _RETRIES_TOTAL
+
+    async def on_configure(self, data: Payload) -> None:
+        # surface the retry counter on /metrics when a Metrics extension
+        # is configured (its registry adopts pre-built collectors)
+        for extension in getattr(data.instance.configuration, "extensions", []):
+            registry = getattr(extension, "registry", None)
+            if registry is not None and hasattr(registry, "register"):
+                try:
+                    registry.register(self.retries_total)
+                except (ValueError, AttributeError):
+                    pass
+                break
 
     def create_signature(self, body: bytes) -> str:
         digest = hmac.new(self.secret.encode(), body, hashlib.sha256).hexdigest()
@@ -71,19 +114,48 @@ class Webhook(Extension):
         handle = asyncio.get_event_loop().call_later(self.debounce_ms / 1000, run)
         self.debounced[id] = {"start": start, "handle": handle}
 
+    def _retry_delay(self, attempt: int) -> float:
+        from ..aio import backoff_delay_s
+
+        return backoff_delay_s(attempt, self.retry_base_ms, self.retry_max_ms)
+
     async def send_request(self, event: Events, payload: Any) -> tuple[int, Any]:
         body = json.dumps({"event": event.value, "payload": payload}).encode()
         headers = {
             "X-Hocuspocus-Signature-256": self.create_signature(body),
             "Content-Type": "application/json",
         }
-        async with aiohttp.ClientSession() as session:
-            async with session.post(self.url, data=body, headers=headers) as response:
-                try:
-                    data = await response.json(content_type=None)
-                except Exception:
-                    data = await response.text()
-                return response.status, data
+        timeout = aiohttp.ClientTimeout(total=self.request_timeout_ms / 1000.0)
+        attempts = self.retries + 1
+        last_error: Optional[Exception] = None
+        for attempt in range(attempts):
+            if attempt:
+                self.retries_total.inc(event=event.value)
+                await asyncio.sleep(self._retry_delay(attempt - 1))
+            try:
+                async with aiohttp.ClientSession(timeout=timeout) as session:
+                    async with session.post(
+                        self.url, data=body, headers=headers
+                    ) as response:
+                        try:
+                            data = await response.json(content_type=None)
+                        except Exception:
+                            data = await response.text()
+                        if response.status >= 500 and attempt + 1 < attempts:
+                            # server-side failure: retryable; a 4xx is a
+                            # decision, returned to the caller as-is
+                            last_error = RuntimeError(
+                                f"webhook returned {response.status}"
+                            )
+                            continue
+                        return response.status, data
+            except asyncio.CancelledError:
+                raise
+            except Exception as error:  # connect/timeout/transport
+                last_error = error
+        raise last_error if last_error is not None else RuntimeError(
+            "webhook request failed"
+        )
 
     async def on_change(self, data: Payload) -> None:
         if Events.onChange not in self.events:

@@ -172,6 +172,19 @@ class Metrics(Extension):
         # silently falling off the device path. The key set is complete
         # by construction: MergePlane pre-declares every counter in
         # __init__ and retire_doc uses strict key access.
+        # durability plane (storage/extension.py): WAL append/commit/
+        # recovery counters + the store-quarantine population — the
+        # crash-safety story must be alertable, not just logged
+        self.registry.gauge(
+            "hocuspocus_store_quarantined_docs",
+            "Documents whose store chain exhausted its retries (kept "
+            "loaded + WAL retained; /healthz reports degraded)",
+            fn=lambda: len(getattr(instance, "quarantine", ()) or ()),
+        )
+        for extension in getattr(instance.configuration, "extensions", []):
+            if callable(getattr(extension, "wal_stats", None)):
+                self._bind_durability_metrics(extension)
+                break
         for extension in getattr(instance.configuration, "extensions", []):
             supervisor = getattr(extension, "supervisor", None)
             if supervisor is not None and hasattr(supervisor, "snapshot"):
@@ -439,6 +452,20 @@ class Metrics(Extension):
             return True
         return False
 
+    def _bind_durability_metrics(self, durability) -> None:
+        """One gauge per WAL stat (hocuspocus_wal_*): appended records/
+        bytes, fsyncs, group-commit batch sizes, append errors, and the
+        recovery report (replayed records/bytes, torn tails)."""
+        # read the live stats dict directly: wal_stats() copies it, and
+        # ~15 gauges x one copy each per scrape is pure garbage churn
+        stats = durability.wal.stats
+        for key in stats:
+            self.registry.gauge(
+                f"hocuspocus_wal_{key}",
+                f"Write-ahead log stat: {key} (docs/guides/durability.md)",
+                fn=(lambda s=stats, k=key: s[k]),
+            )
+
     def _bind_trace_book(self, plane) -> None:
         """Point the plane's update-lifecycle trace book at the labelled
         e2e histogram, and route slow-flush promotions into the per-doc
@@ -643,6 +670,16 @@ class Metrics(Extension):
             error = _ServeMetrics()
             error.response = data.response
             raise error
+        if path == "/healthz" and self._instance is not None:
+            # the supervised-plane extension serves this too (same
+            # payload); Metrics covers deployments without a plane —
+            # e.g. a CPU server whose durability quarantine must still
+            # degrade the balancer health check. Repo-wide convention
+            # (pinned by test_healthz_endpoint_reports_plane_state):
+            # "degraded" still answers HTTP 200 — the server SERVES,
+            # degraded is a steer signal for body-parsing probes, not a
+            # kill signal that would drop every live session
+            self._serve_json(data, self._instance.get_health())
         if self.debug_endpoints:
             if path == "/debug/slo":
                 self.slo.maybe_sample()

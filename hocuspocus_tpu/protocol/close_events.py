@@ -11,6 +11,10 @@ class CloseEvent(NamedTuple):
 
 
 MESSAGE_TOO_BIG = CloseEvent(1009, "Message Too Big")
+# graceful drain (docs/guides/durability.md): 1012 is the standard
+# "Service Restart" code — clients SHOULD reconnect (another instance,
+# or this one after restart), unlike the 4xxx application rejections
+SERVICE_RESTART = CloseEvent(1012, "Service Restart")
 RESET_CONNECTION = CloseEvent(4205, "Reset Connection")
 UNAUTHORIZED = CloseEvent(4401, "Unauthorized")
 FORBIDDEN = CloseEvent(4403, "Forbidden")

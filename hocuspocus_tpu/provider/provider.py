@@ -42,6 +42,8 @@ class HocuspocusProvider(Observable):
         awareness: Any = _NO_AWARENESS,
         token: Union[str, Callable, None] = None,
         force_sync_interval: Optional[float] = None,
+        min_reconnect_delay_ms: Optional[float] = None,
+        max_reconnect_delay_ms: Optional[float] = None,
         **callbacks: Any,
     ) -> None:
         super().__init__()
@@ -63,7 +65,14 @@ class HocuspocusProvider(Observable):
         if websocket_provider is None:
             if url is None:
                 raise ValueError("provide either url or websocket_provider")
-            websocket_provider = HocuspocusProviderWebsocket(url)
+            # reconnect pacing is part of the provider configuration:
+            # capped exponential backoff + jitter between these bounds
+            # (provider/websocket.py `_backoff_delay`)
+            websocket_provider = HocuspocusProviderWebsocket(
+                url,
+                min_reconnect_delay_ms=min_reconnect_delay_ms,
+                max_reconnect_delay_ms=max_reconnect_delay_ms,
+            )
         self.websocket_provider = websocket_provider
 
         for event_name, fn in callbacks.items():

@@ -34,6 +34,8 @@ class HocuspocusProviderWebsocket(ProviderSocketBase):
         max_attempts: int = 0,
         min_delay: float = 1000,
         max_delay: float = 30000,
+        min_reconnect_delay_ms: Optional[float] = None,
+        max_reconnect_delay_ms: Optional[float] = None,
         jitter: bool = True,
         **callbacks: Any,
     ) -> None:
@@ -45,8 +47,15 @@ class HocuspocusProviderWebsocket(ProviderSocketBase):
         self.initial_delay = initial_delay
         self.factor = factor
         self.max_attempts = max_attempts
-        self.min_delay = min_delay
-        self.max_delay = max_delay
+        # min/max_reconnect_delay_ms are the configuration-surface
+        # names (provider options); min_delay/max_delay kept as the
+        # historical aliases
+        self.min_delay = (
+            min_reconnect_delay_ms if min_reconnect_delay_ms is not None else min_delay
+        )
+        self.max_delay = (
+            max_reconnect_delay_ms if max_reconnect_delay_ms is not None else max_delay
+        )
         self.jitter = jitter
 
         self.provider_map: dict[str, Any] = {}
@@ -147,8 +156,23 @@ class HocuspocusProviderWebsocket(ProviderSocketBase):
                     pass
                 return
 
+    @property
+    def min_reconnect_delay_ms(self) -> float:
+        return self.min_delay
+
+    @property
+    def max_reconnect_delay_ms(self) -> float:
+        return self.max_delay
+
     async def _run(self) -> None:
-        attempt = 0
+        # two ladders: `failures` counts CONSECUTIVE connect failures
+        # (the max_attempts give-up check — resets on any successful
+        # connect, the original semantic); `flap` counts connections
+        # that dropped instantly without a message (accept-then-drop
+        # servers), feeding the backoff only — an established-then-
+        # flapped connection must never burn the give-up budget
+        failures = 0
+        flap = 0
         if self.initial_delay:
             await asyncio.sleep(self.initial_delay / 1000)
         while self.should_connect and not self._destroyed:
@@ -160,14 +184,15 @@ class HocuspocusProviderWebsocket(ProviderSocketBase):
                     self.url, autoping=True, max_msg_size=0, heartbeat=None
                 )
             except Exception:
-                attempt += 1
-                if self.max_attempts and attempt >= self.max_attempts:
+                failures += 1
+                if self.max_attempts and failures >= self.max_attempts:
                     self._set_status(WebSocketStatus.Disconnected)
                     return
-                await asyncio.sleep(self._backoff_delay(attempt))
+                await asyncio.sleep(self._backoff_delay(max(failures, flap)))
                 continue
-            attempt = 0
+            failures = 0
             self.ws = ws
+            connected_at = time.monotonic()
             self.last_message_received = 0.0
             self._out_queue = asyncio.Queue()  # no frames from a dead socket
             self._pump_task = asyncio.ensure_future(self._pump(ws))
@@ -206,14 +231,32 @@ class HocuspocusProviderWebsocket(ProviderSocketBase):
             self._set_status(WebSocketStatus.Disconnected)
             self.emit("close", {"event": close_event})
             self.emit("disconnect", {"event": close_event})
+            # a connection that RECEIVED something (or survived a while)
+            # resets the flap ladder; a flapping server that accepts
+            # then immediately drops keeps climbing — without this,
+            # every successful-but-instant connect snapped the delay
+            # back to the floor and reconnects hammered at a fixed
+            # cadence
+            if self.last_message_received or time.monotonic() - connected_at >= 1.0:
+                flap = 0
+            else:
+                flap += 1
             if self.should_connect and not self._destroyed:
-                await asyncio.sleep(self._backoff_delay(max(attempt, 1)))
+                await asyncio.sleep(self._backoff_delay(max(flap, 1)))
 
     def _backoff_delay(self, attempt: int) -> float:
-        delay = min(self.delay * (self.factor ** max(attempt - 1, 0)), self.max_delay)
+        """Capped exponential backoff with full jitter: the ceiling
+        doubles per consecutive failed attempt (bounded by
+        max_reconnect_delay_ms) and the actual delay is drawn uniformly
+        from [min_reconnect_delay_ms, ceiling] — a herd of reconnecting
+        clients spreads instead of thundering."""
+        ceiling = min(
+            self.delay * (self.factor ** max(attempt - 1, 0)), self.max_delay
+        )
+        ceiling = max(ceiling, self.min_delay)
         if self.jitter:
-            delay = random.uniform(self.min_delay, max(delay, self.min_delay))
-        return delay / 1000
+            return random.uniform(self.min_delay, ceiling) / 1000
+        return ceiling / 1000
 
     def _on_message(self, data: bytes) -> None:
         self.last_message_received = time.monotonic()

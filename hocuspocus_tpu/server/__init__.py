@@ -4,6 +4,7 @@ from .debounce import Debouncer
 from .direct_connection import DirectConnection
 from .document import Document
 from .hocuspocus import Hocuspocus, RequestInfo, REDIS_ORIGIN
+from .types import WAL_ORIGIN
 from .message_receiver import MessageReceiver
 from .server import Server
 from .transports import CallbackWebSocketTransport
@@ -18,6 +19,7 @@ __all__ = [
     "Hocuspocus",
     "RequestInfo",
     "REDIS_ORIGIN",
+    "WAL_ORIGIN",
     "MessageReceiver",
     "Server",
     "CallbackWebSocketTransport",

@@ -48,6 +48,19 @@ class Document(Doc):
         # coalescing, one audience snapshot per tick, catch-up tiering
         # for slow consumers — updates AND awareness share the tick
         self.fanout = DocumentFanout(self)
+        # durability capture seam (storage/extension.py): when attached,
+        # every update is appended to the write-ahead log BEFORE any
+        # broadcast, and the fan-out tick gates on the group-commit
+        # future the sink returns — no client sees an update before its
+        # commit COMPLETES. A commit that completes with a disk error
+        # still releases the gate (availability over durability: the
+        # error is counted, /healthz degrades, and the store pipeline
+        # remains the doc's durability floor). wal_checkpoint folds
+        # full-state snapshots (eviction, tpu/residency.py) into the
+        # log.
+        self.wal_sink = None
+        self.wal_checkpoint = None
+        self._wal_gate = None
         self.awareness.on("update", self._handle_awareness_update)
         self.on("update", self._handle_update)
 
@@ -136,6 +149,20 @@ class Document(Doc):
 
     def _handle_update(self, update: bytes, origin: Any, doc, transaction) -> None:
         self.callbacks["on_update"](self, origin, update)
+        sink = self.wal_sink
+        gate = None
+        if sink is not None:
+            try:
+                gate = sink(update, origin)
+            except Exception:
+                from . import logger as _logger_mod
+
+                _logger_mod.log_error(
+                    f"WAL append failed for {self.name!r}; broadcasting anyway"
+                )
+            # plane windows broadcast later (queue_broadcast) — they
+            # gate on the newest append's commit future
+            self._wal_gate = gate
         source = self.broadcast_source
         if source is not None:
             try:
@@ -155,7 +182,27 @@ class Document(Doc):
         # frame builds + websocket sends + receiver applies). Updates
         # applied FROM the redis bus are flagged non-replicable so the
         # tick's replication seam can't echo them back across instances.
-        self.fanout.queue_update(update, replicate=origin != REDIS_ORIGIN)
+        self.fanout.queue_update(update, replicate=origin != REDIS_ORIGIN, gate=gate)
+
+    async def wait_wal_durable(self, max_rounds: int = 16) -> None:
+        """Wait until every update currently applied to this doc has a
+        completed WAL commit — the sync-serving seam's durability gate:
+        a joiner's SyncStep2 must not show state the log could still
+        lose (the broadcast tick has the same gate). Re-checks after
+        each wait because new updates open a new gate; bounded so
+        relentless write pressure degrades to best-effort instead of
+        parking the join forever."""
+        for _ in range(max_rounds):
+            gate = self._wal_gate
+            if gate is None:
+                return
+            if gate.done():
+                self._wal_gate = None
+                return
+            try:
+                await gate
+            except Exception:
+                return  # commit errors are counted elsewhere; serve
 
     def queue_broadcast(self, update: bytes, on_complete=None) -> None:
         """Enqueue a ready update payload onto the current broadcast
@@ -165,7 +212,10 @@ class Document(Doc):
         stage closes. Plane windows carry local AND remote-origin ops,
         so they are never replicated from here — the plane publishes a
         remote-op-stripped `cross_update` via `on_plane_broadcast`."""
-        self.fanout.queue_update(update, on_complete, replicate=False)
+        gate = self._wal_gate
+        if gate is not None and gate.done():
+            self._wal_gate = gate = None
+        self.fanout.queue_update(update, on_complete, replicate=False, gate=gate)
 
     def broadcast_update_frame(self, update: bytes) -> None:
         """Immediate (tickless) fan-out of one update — the degrade

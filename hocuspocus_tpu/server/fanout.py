@@ -226,6 +226,18 @@ class DocumentFanout:
         # boundary: republishing them would echo between instances.
         self.replicate_updates: Optional[Callable[[Optional[bytes], list], Any]] = None
         self.replicate_awareness: Optional[Callable[[bytes], Any]] = None
+        # durability gates (storage/extension.py): group-commit futures
+        # the tick must wait out before DELIVERING — an update is never
+        # shown to a client while the WAL write that covers it is still
+        # in flight (a commit that FAILS still releases the gate: the
+        # error is counted and health degrades; halting fan-out on a
+        # sick disk would trade availability for nothing, since the
+        # store pipeline still provides the durability floor).
+        # Coalescing and frame building stay synchronous (and overlap
+        # the commit on the executor); only the socket enqueue defers
+        # to the gate.
+        self._gates: list = []
+        self._gate_tasks: set = set()
 
     # -- enqueue -----------------------------------------------------------
 
@@ -234,11 +246,14 @@ class DocumentFanout:
         update: bytes,
         on_complete: Optional[Callable[[float], Any]] = None,
         replicate: bool = True,
+        gate: Any = None,
     ) -> None:
         self._pending_updates.append(update)
         self._pending_replicate.append(replicate)
         if on_complete is not None:
             self._on_complete.append(on_complete)
+        if gate is not None and not gate.done():
+            self._gates.append(gate)
         self._schedule()
 
     def queue_awareness(self, changed_clients: Iterable[int]) -> None:
@@ -264,6 +279,7 @@ class DocumentFanout:
         replicate_flags = self._pending_replicate
         awareness_clients = self._pending_awareness
         callbacks = self._on_complete
+        gates = self._gates
         if pending:
             self._pending_updates = []
             self._pending_replicate = []
@@ -271,71 +287,121 @@ class DocumentFanout:
             self._pending_awareness = set()
         if callbacks:
             self._on_complete = []
+        if gates:
+            self._gates = []
         if not pending and not awareness_clients:
             return
         document = self.document
-        # audience snapshot: ONE registry copy serves the update pass
-        # AND the awareness pass of this tick
-        audience = document.get_connections()
         wire = get_wire_telemetry()
-        elided = 0
+        # coalesce + build the wire frame NOW — this work overlaps the
+        # WAL group commit running on the executor; only DELIVERY (the
+        # first moment a client could see the update) waits for the
+        # durability gates
+        frame = None
+        per_update_frames = None
         if pending:
-            frame = None
             update = coalesce_updates(pending)
             if update is None:
                 # merge failure must not lose updates: per-update frames
-                for u in pending:
-                    elided += self.deliver(
-                        audience, build_update_frame(document.name, u)
-                    )
+                per_update_frames = [
+                    build_update_frame(document.name, u) for u in pending
+                ]
             else:
                 frame = build_update_frame(document.name, update)
-                elided += self.deliver(audience, frame)
-                if wire.enabled and audience:
-                    wire.record_fanout_frame(
-                        len(pending), (len(pending) - 1) * len(audience)
-                    )
-            if self.replicate_updates is not None:
-                replicable = [
-                    u for u, r in zip(pending, replicate_flags) if r
-                ]
-                if replicable:
-                    # the built frame is reusable across the instance
-                    # boundary only when it covers EXACTLY the
-                    # replicable set (a tick mixing remote-origin
-                    # updates needs a separate coalesce in the lane)
-                    reuse = frame if len(replicable) == len(pending) else None
+
+        def deliver_tick() -> None:
+            if document.is_destroyed:
+                return
+            # audience snapshot: ONE registry copy serves the update
+            # pass AND the awareness pass of this tick
+            audience = document.get_connections()
+            elided = 0
+            if pending:
+                if per_update_frames is not None:
+                    for data in per_update_frames:
+                        elided += self.deliver(audience, data)
+                else:
+                    elided += self.deliver(audience, frame)
+                    if wire.enabled and audience:
+                        wire.record_fanout_frame(
+                            len(pending), (len(pending) - 1) * len(audience)
+                        )
+                if self.replicate_updates is not None:
+                    replicable = [
+                        u for u, r in zip(pending, replicate_flags) if r
+                    ]
+                    if replicable:
+                        # the built frame is reusable across the
+                        # instance boundary only when it covers EXACTLY
+                        # the replicable set (a tick mixing remote-
+                        # origin updates needs a separate coalesce in
+                        # the lane)
+                        reuse = (
+                            frame if len(replicable) == len(pending) else None
+                        )
+                        try:
+                            self.replicate_updates(reuse, replicable)
+                        except Exception:
+                            pass  # replication must never break local fan-out
+            if awareness_clients and (
+                audience or self.replicate_awareness is not None
+            ):
+                # built at delivery time: awareness is per-client LWW
+                # state, so the freshest encode wins
+                message = OutgoingMessage(
+                    document.name
+                ).create_awareness_update_message(
+                    document.awareness, list(awareness_clients)
+                )
+                data = message.to_bytes()
+                if audience:
+                    elided += self.deliver(audience, data)
+                if self.replicate_awareness is not None:
+                    # awareness piggybacks on the tick: the SAME frame
+                    # bytes cross the instance boundary (encode once,
+                    # both sides)
                     try:
-                        self.replicate_updates(reuse, replicable)
+                        self.replicate_awareness(data)
                     except Exception:
-                        pass  # replication must never break local fan-out
-        if awareness_clients and (
-            audience or self.replicate_awareness is not None
-        ):
-            message = OutgoingMessage(document.name).create_awareness_update_message(
-                document.awareness, list(awareness_clients)
-            )
-            data = message.to_bytes()
-            if audience:
-                elided += self.deliver(audience, data)
-            if self.replicate_awareness is not None:
-                # awareness piggybacks on the tick: the SAME frame bytes
-                # cross the instance boundary (encode once, both sides)
-                try:
-                    self.replicate_awareness(data)
-                except Exception:
-                    pass
-        if wire.enabled and elided:
-            wire.record_catchup_elided(elided)
-        if callbacks:
-            # last-socket-enqueue: where the lifecycle trace's fan-out
-            # stage closes
-            t_last = time.perf_counter()
-            for callback in callbacks:
-                try:
-                    callback(t_last)
-                except Exception:
-                    pass
+                        pass
+            if wire.enabled and elided:
+                wire.record_catchup_elided(elided)
+            if callbacks:
+                # last-socket-enqueue: where the lifecycle trace's
+                # fan-out stage closes
+                t_last = time.perf_counter()
+                for callback in callbacks:
+                    try:
+                        callback(t_last)
+                    except Exception:
+                        pass
+
+        waiting = [gate for gate in gates if not gate.done()]
+        if not waiting:
+            deliver_tick()
+            return
+        self._spawn_gated_delivery(waiting, deliver_tick)
+
+    def _spawn_gated_delivery(self, gates: list, deliver_tick: Callable) -> None:
+        """Run `deliver_tick` once every durability gate has resolved.
+        Ticks stay ordered: WAL commit futures resolve in append order,
+        and same-future waiters wake in task-creation order."""
+
+        async def waiter() -> None:
+            try:
+                for gate in gates:
+                    if not gate.done():
+                        try:
+                            await gate
+                        except Exception:
+                            pass  # commit errors are counted, never block
+            finally:
+                self._gate_tasks.discard(asyncio.current_task())
+            deliver_tick()
+
+        # strong ref: a GC'd waiter would swallow the tick's frames
+        task = asyncio.ensure_future(waiter())
+        self._gate_tasks.add(task)
 
     def deliver(self, audience, frame: bytes, tierable: bool = True) -> int:
         """Enqueue one shared frame to every connection; returns the
@@ -359,5 +425,9 @@ class DocumentFanout:
         self._pending_replicate = []
         self._pending_awareness = set()
         self._on_complete = []
+        self._gates = []
+        for task in list(self._gate_tasks):
+            task.cancel()
+        self._gate_tasks.clear()
         self.replicate_updates = None
         self.replicate_awareness = None

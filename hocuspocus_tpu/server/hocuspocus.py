@@ -9,6 +9,7 @@ request interception and distributed store-locks work.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import Any, Callable, Optional
 
@@ -64,6 +65,12 @@ class Hocuspocus:
         self.documents: dict[str, Document] = {}
         self.loading_documents: dict[str, asyncio.Future] = {}
         self.debouncer = Debouncer()
+        # store quarantine (docs/guides/durability.md): docs whose store
+        # chain exhausted its retries. Kept loaded (unload would drop
+        # the only in-memory copy), WAL retained, re-stored by the
+        # sweep task, reported degraded via get_health().
+        self.quarantine: dict[str, dict] = {}
+        self._quarantine_task: Optional[asyncio.Task] = None
         self.server = None  # set by Server when hosted
         self._configured_payload: Optional[Payload] = None
         self._on_configure_done = False
@@ -161,6 +168,12 @@ class Hocuspocus:
             "connections": self.get_connections_count(),
             "extensions": {},
         }
+        if self.quarantine:
+            # docs whose store chain exhausted its retries: data is safe
+            # (loaded + WAL) but the persistence backend is failing —
+            # balancers should steer new load away
+            health["status"] = "degraded"
+            health["quarantined_documents"] = sorted(self.quarantine)
         for extension in getattr(self, "_extensions", []):
             status_fn = getattr(extension, "health_status", None)
             if not callable(status_fn):
@@ -252,32 +265,67 @@ class Hocuspocus:
         except Exception:
             pass
 
+    def _store_retry_delay(self, attempt: int) -> float:
+        from ..aio import backoff_delay_s
+
+        cfg = self.configuration
+        return backoff_delay_s(
+            attempt, cfg.store_retry_base_ms, cfg.store_retry_max_ms
+        )
+
     def store_document_hooks(
         self, document: Document, hook_payload: Payload, immediately: bool = False
     ):
         debounce_id = f"onStoreDocument-{document.name}"
 
         async def run() -> None:
+            attempts = max(int(self.configuration.store_retries), 0) + 1
             try:
                 async with document.save_mutex:
-                    await self.hooks("on_store_document", hook_payload)
-                    await self.hooks("after_store_document", hook_payload)
-            except Exception as error:
-                logger.log_error(f"caught error during store_document_hooks: {error!r}")
-                # best-effort cleanup hook so extensions holding resources
-                # across the store chain (e.g. the Redis store lock) can
-                # release them — after_store_document never runs on failure
-                try:
-                    await self.hooks("on_store_document_failed", hook_payload)
-                except Exception:
-                    pass
-                if str(error):
-                    raise
+                    for attempt in range(attempts):
+                        try:
+                            await self.hooks("on_store_document", hook_payload)
+                            await self.hooks("after_store_document", hook_payload)
+                            self._clear_quarantine(document.name)
+                            break
+                        except Exception as error:
+                            logger.log_error(
+                                "caught error during store_document_hooks "
+                                f"(attempt {attempt + 1}/{attempts}): {error!r}"
+                            )
+                            # best-effort cleanup hook so extensions
+                            # holding resources across the store chain
+                            # (e.g. the Redis store lock) can release
+                            # them before the retry re-acquires —
+                            # after_store_document never runs on failure
+                            try:
+                                await self.hooks(
+                                    "on_store_document_failed", hook_payload
+                                )
+                            except Exception:
+                                pass
+                            if attempt + 1 >= attempts:
+                                # retries exhausted: quarantine instead
+                                # of silently dropping the document's
+                                # only in-memory copy at unload
+                                self._quarantine_document(
+                                    document, hook_payload, error
+                                )
+                                if str(error):
+                                    raise
+                                break
+                            await asyncio.sleep(self._store_retry_delay(attempt))
+                            if document.is_destroyed:
+                                return
             finally:
                 has_pending_work = (
                     self.debouncer.is_debounced(debounce_id) or document.save_mutex.locked()
                 )
-                if document.get_connections_count() == 0 and not has_pending_work:
+                if (
+                    document.get_connections_count() == 0
+                    and not has_pending_work
+                    and document.name not in self.quarantine
+                ):
                     await self.unload_document(document)
 
         return self.debouncer.debounce(
@@ -286,6 +334,199 @@ class Hocuspocus:
             0 if immediately else self.configuration.debounce,
             self.configuration.max_debounce,
         )
+
+    # -- store quarantine ---------------------------------------------------
+
+    def _quarantine_document(
+        self, document: Document, hook_payload: Payload, error: Exception
+    ) -> None:
+        info = self.quarantine.get(document.name)
+        self.quarantine[document.name] = {
+            "since": info["since"] if info else time.time(),
+            "failures": (info["failures"] if info else 0) + 1,
+            "last_error": repr(error)[:200],
+            "payload": hook_payload,
+        }
+        get_flight_recorder().record(
+            document.name, "store_quarantined", error=repr(error)[:120]
+        )
+        logger.log_error(
+            f"store retries exhausted for {document.name!r}: QUARANTINED "
+            "(kept loaded; periodic re-store sweep active)"
+        )
+        self._ensure_quarantine_sweep()
+
+    def _clear_quarantine(self, name: str) -> None:
+        if self.quarantine.pop(name, None) is not None:
+            get_flight_recorder().record(name, "store_recovered")
+
+    def _ensure_quarantine_sweep(self) -> None:
+        if self._quarantine_task is None or self._quarantine_task.done():
+            self._quarantine_task = asyncio.ensure_future(self._quarantine_sweep())
+
+    async def _quarantine_sweep(self) -> None:
+        """Periodically retry the store chain for quarantined docs. The
+        task exits when the quarantine empties (respawned on the next
+        quarantine) so idle servers hold no timer."""
+        interval = max(self.configuration.store_quarantine_sweep_ms, 100) / 1000.0
+        try:
+            while self.quarantine:
+                await asyncio.sleep(interval)
+                for name in list(self.quarantine):
+                    document = self.documents.get(name)
+                    info = self.quarantine.get(name)
+                    if document is None or info is None:
+                        self.quarantine.pop(name, None)
+                        continue
+                    if document.save_mutex.locked():
+                        # a previous attempt is still in flight (e.g. a
+                        # hung backend holding the mutex): piling fresh
+                        # tasks behind it helps nothing
+                        continue
+                    task = self.store_document_hooks(
+                        document, info["payload"], immediately=True
+                    )
+                    if task is not None:
+                        try:
+                            # bounded: ONE hung store must not starve
+                            # every other quarantined doc's re-store
+                            # (the task itself keeps running; the mutex
+                            # check above stops pile-up)
+                            await asyncio.wait_for(
+                                asyncio.shield(task),
+                                timeout=max(
+                                    self.configuration.drain_timeout_secs, 1.0
+                                ),
+                            )
+                        except Exception:
+                            pass  # still failing/hung: stays quarantined
+        except asyncio.CancelledError:
+            pass
+
+    async def release_quarantine(self, unload: bool = True) -> None:
+        """Shutdown path: stop the sweep and (optionally) unload the
+        quarantined docs — callers must have flushed/drained first."""
+        if self._quarantine_task is not None:
+            self._quarantine_task.cancel()
+            self._quarantine_task = None
+        names, self.quarantine = list(self.quarantine), {}
+        if not unload:
+            return
+        for name in names:
+            document = self.documents.get(name)
+            if document is not None and document.get_connections_count() == 0:
+                await self.unload_document(document)
+
+    # -- graceful drain ------------------------------------------------------
+
+    async def drain(self, timeout_secs: Optional[float] = None) -> dict:
+        """SIGTERM path: make everything durable under a deadline.
+
+        1. flush the WAL (everything acknowledged is now on disk — from
+           here on, nothing can be lost even if the deadline expires);
+        2. fire every pending debounced store NOW and store every other
+           loaded doc, all concurrently;
+        3. docs still storing at the deadline are quarantined (their
+           WAL suffix has the data) — the outcome report says which.
+        """
+        if timeout_secs is None:
+            timeout_secs = self.configuration.drain_timeout_secs
+        started = time.perf_counter()
+        outcome: dict = {
+            "docs": len(self.documents),
+            "stored": 0,
+            "clean": 0,
+            "timed_out": [],
+            "quarantined": [],
+            "wal_flushed": False,
+        }
+        # 1. durable log first
+        wal = None
+        for extension in getattr(self, "_extensions", []):
+            flush = getattr(extension, "flush_wal", None)
+            if callable(flush):
+                wal = getattr(extension, "wal", None)
+                try:
+                    await asyncio.wait_for(flush(), timeout=max(timeout_secs, 0.1))
+                    outcome["wal_flushed"] = True
+                except Exception as error:
+                    logger.log_error(f"drain: WAL flush failed: {error!r}")
+        # 2. store the DIRTY docs concurrently (execute pending
+        # debounces via the same path so per-doc stores can't overlap).
+        # A fleet of thousands of loaded-but-clean docs must not turn
+        # SIGTERM into thousands of full-state writes racing one
+        # deadline — a clean doc has nothing the store does not.
+        tasks: "dict[asyncio.Task, tuple[str, Payload]]" = {}
+        for name, document in list(self.documents.items()):
+            debounce_id = f"onStoreDocument-{name}"
+            dirty = (
+                self.debouncer.is_debounced(debounce_id)
+                or self.debouncer.in_flight(debounce_id)
+                or document.save_mutex.locked()
+                or name in self.quarantine
+                or (wal is not None and wal.pending_records(name) > 0)
+            )
+            if not dirty:
+                outcome["clean"] += 1
+                continue
+            payload = Payload(
+                instance=self,
+                document=document,
+                document_name=name,
+                context={},
+                socket_id="drain",
+                request_headers={},
+                request_parameters={},
+            )
+            quarantined = self.quarantine.get(name)
+            if quarantined is not None:
+                payload = quarantined["payload"]
+            task = self.store_document_hooks(document, payload, immediately=True)
+            if task is not None:
+                tasks[task] = (name, payload)
+        if tasks:
+            remaining = max(timeout_secs - (time.perf_counter() - started), 0.05)
+            done, pending = await asyncio.wait(tasks, timeout=remaining)
+            for task in done:
+                name, _payload = tasks[task]
+                if task.cancelled() or task.exception() is not None:
+                    outcome["quarantined"].append(name)
+                else:
+                    outcome["stored"] += 1
+            for task in pending:
+                # still storing at the deadline: the store task keeps
+                # running until process exit, but we stop waiting. The
+                # doc's WAL suffix is durable, so no data is at risk —
+                # record it as quarantined so the outcome is honest.
+                # The FULL store payload rides into the quarantine: the
+                # sweep re-runs the whole extension chain with it, and
+                # extensions read socket_id/request_* off it.
+                name, payload = tasks[task]
+                outcome["timed_out"].append(name)
+                document = self.documents.get(name)
+                if document is not None and name not in self.quarantine:
+                    self._quarantine_document(
+                        document, payload, TimeoutError("drain deadline")
+                    )
+        outcome["quarantined"].extend(
+            name for name in self.quarantine if name not in outcome["quarantined"]
+        )
+        outcome["duration_s"] = round(time.perf_counter() - started, 3)
+        get_flight_recorder().record("__server__", "drain", **{
+            key: value for key, value in outcome.items() if key != "docs"
+        })
+        logger.logger.info(
+            "drain: stored %s/%s docs in %ss%s",
+            outcome["stored"],
+            outcome["docs"],
+            outcome["duration_s"],
+            (
+                f"; quarantined {sorted(set(outcome['quarantined']))}"
+                if outcome["quarantined"]
+                else ""
+            ),
+        )
+        return outcome
 
     # -- document lifecycle ------------------------------------------------
 
@@ -415,6 +656,11 @@ class Hocuspocus:
     async def unload_document(self, document: Document) -> None:
         document_name = document.name
         if document_name not in self.documents:
+            return
+        if document_name in self.quarantine:
+            # the in-memory copy is the only one the store backend does
+            # not have; the quarantine sweep (or drain/destroy) decides
+            # its fate, never a connection-count race
             return
         try:
             await self.hooks(

@@ -194,6 +194,14 @@ class MessageReceiver:
             )
 
         if sync_type == MESSAGE_YJS_SYNC_STEP1:
+            # durability gate (docs/guides/durability.md): the state a
+            # joiner is about to receive must be WAL-durable first, or
+            # a crash could leave the client holding updates the
+            # restarted server never saw — same invariant as the
+            # broadcast tick's delivery gate
+            wait_durable = getattr(document, "wait_wal_durable", None)
+            if wait_durable is not None:
+                await wait_durable()
             source = getattr(document, "sync_source", None)
             if source is not None:
                 # TPU-plane serving path: the SyncStep2 payload is built

@@ -16,7 +16,7 @@ import aiohttp
 from aiohttp import WSMsgType, web
 
 from . import logger
-from ..protocol.close_events import MESSAGE_TOO_BIG
+from ..protocol.close_events import MESSAGE_TOO_BIG, SERVICE_RESTART
 from .hocuspocus import Hocuspocus, RequestInfo
 from .transports import CallbackWebSocketTransport
 from .types import Configuration, Payload
@@ -47,6 +47,7 @@ class Server:
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
         self._transports: set = set()
+        self._draining = False
 
     @property
     def configuration(self) -> Configuration:
@@ -117,9 +118,30 @@ class Server:
             ", ".join(extensions) or "none",
         )
 
+    async def drain(self, timeout_secs: Optional[float] = None) -> dict:
+        """Graceful SIGTERM path (docs/guides/durability.md): stop
+        accepting connections, flush the WAL, store every dirty doc
+        concurrently under the deadline, then close clients with 1012
+        (Service Restart — reconnect-advisable). Returns the outcome
+        report; call `destroy()` afterwards to tear the server down."""
+        self._draining = True
+        outcome = await self.hocuspocus.drain(timeout_secs)
+        for document in list(self.hocuspocus.documents.values()):
+            for connection in document.get_connections():
+                connection.close(SERVICE_RESTART)
+        for transport in list(self._transports):
+            transport.close(SERVICE_RESTART.code, SERVICE_RESTART.reason)
+        await asyncio.sleep(0)
+        return outcome
+
     async def destroy(self) -> None:
         # stop accepting new connections, reset existing ones
+        self._draining = True
         self.close_connections()
+        # quarantined docs never unload on their own: stop the sweep
+        # and release them now (drain(), if the operator called it,
+        # already gave their stores a final bounded chance)
+        await self.hocuspocus.release_quarantine()
         # wait for all documents to store + unload
         for _ in range(500):
             if self.hocuspocus.get_documents_count() == 0:
@@ -156,6 +178,16 @@ class Server:
         return web.Response(text="Welcome to hocuspocus-tpu!")
 
     async def _handle_websocket(self, request: web.Request):
+        if self._draining:
+            # upgrade refused with 503 + Retry-After: balancers fail the
+            # health check over to another instance; direct clients back
+            # off and reconnect (the provider treats any connect failure
+            # as retryable)
+            return web.Response(
+                status=503,
+                text="Draining",
+                headers={"Retry-After": "1"},
+            )
         request_info = RequestInfo(
             headers=dict(request.headers),
             url=str(request.rel_url),

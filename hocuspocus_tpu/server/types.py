@@ -15,6 +15,12 @@ from typing import Any, Awaitable, Callable, Optional
 # read it without a circular import; re-exported from server/__init__).
 REDIS_ORIGIN = "__hocuspocus__redis__origin__"
 
+# Transaction origin for updates replayed out of the write-ahead log at
+# recovery time (storage/extension.py): the capture seam must not
+# re-append them, and consumers can tell recovery traffic from live
+# edits.
+WAL_ORIGIN = "__hocuspocus__wal__origin__"
+
 # All lifecycle hooks, in the reference's vocabulary (snake_cased).
 HOOK_NAMES = (
     "on_configure",
@@ -103,6 +109,23 @@ class Configuration:
     max_debounce: int = 10000
     quiet: bool = False
     unload_immediately: bool = True
+    # store retry/quarantine (docs/guides/durability.md): a failing
+    # on_store_document chain is retried with bounded exponential
+    # backoff + jitter; after exhaustion the document is QUARANTINED —
+    # kept loaded, WAL retained, re-stored by a periodic sweep and
+    # surfaced as degraded in /healthz — instead of silently unloading
+    # with its data dropped. store_retries counts retries AFTER the
+    # first attempt (0 restores fail-once semantics, but still
+    # quarantines). Delays are milliseconds like debounce above.
+    store_retries: int = 2
+    store_retry_base_ms: float = 100
+    store_retry_max_ms: float = 5000
+    store_quarantine_sweep_ms: float = 15000
+    # graceful drain deadline, seconds: SIGTERM stops intake, flushes
+    # the WAL, then stores every dirty doc concurrently under this
+    # bound; docs still storing at the deadline are quarantined (their
+    # WAL has the data), never silently dropped.
+    drain_timeout_secs: float = 20.0
     ydoc_options: dict = field(default_factory=lambda: {"gc": True})
     stateless_payload_limit: int = 1024 * 1024 * 100
     extensions: list[Extension] = field(default_factory=list)

@@ -150,3 +150,99 @@ async def test_throttle_rejected_connection_gets_permission_denied():
     finally:
         provider_a.destroy()
         await server.destroy()
+
+
+class FlakyWebhookTarget(FakeWebhookTarget):
+    """Fails (5xx or hang) the first N requests, then behaves."""
+
+    def __init__(self, failures=0, hang_secs=0.0, response_body=None):
+        super().__init__(response_body=response_body)
+        self.failures = failures
+        self.hang_secs = hang_secs
+        self.hits = 0
+
+    async def handle(self, request):
+        self.hits += 1
+        if self.hits <= self.failures:
+            if self.hang_secs:
+                await asyncio.sleep(self.hang_secs)
+            return web.Response(status=503, text="flaky")
+        return await super().handle(request)
+
+
+async def test_webhook_retries_5xx_with_backoff_and_counter():
+    target = await FlakyWebhookTarget(failures=2).start()
+    webhook = Webhook(
+        url=target.url,
+        secret="sec",
+        debounce=None,
+        retries=3,
+        retry_base_ms=10,
+        retry_max_ms=40,
+    )
+    before = webhook.retries_total.value(event="change")  # process-global
+    try:
+        status, _data = await webhook.send_request(
+            Events.onChange, {"documentName": "retry-doc"}
+        )
+        assert status == 200
+        assert target.hits == 3  # two 503s + the success
+        assert webhook.retries_total.value(event="change") - before == 2
+    finally:
+        await target.stop()
+
+
+async def test_webhook_retries_exhaust_then_raise():
+    target = await FlakyWebhookTarget(failures=10).start()
+    webhook = Webhook(
+        url=target.url, debounce=None, retries=1, retry_base_ms=5, retry_max_ms=10
+    )
+    before = webhook.retries_total.value(event="change")
+    try:
+        # the final 5xx is RETURNED (old API contract: callers decide),
+        # after the retry budget is spent
+        status, _data = await webhook.send_request(Events.onChange, {})
+        assert status == 503
+        assert target.hits == 2  # first attempt + one retry
+        assert webhook.retries_total.value(event="change") - before == 1
+    finally:
+        await target.stop()
+
+
+async def test_webhook_4xx_is_not_retried():
+    class Rejecting(FakeWebhookTarget):
+        async def handle(self, request):
+            self.requests.append({})
+            return web.Response(status=403, text="no")
+
+    target = await Rejecting().start()
+    webhook = Webhook(url=target.url, debounce=None, retries=3, retry_base_ms=5)
+    before = webhook.retries_total.value(event="connect")
+    try:
+        status, _data = await webhook.send_request(Events.onConnect, {})
+        assert status == 403
+        assert len(target.requests) == 1, "a 4xx decision must not be retried"
+        assert webhook.retries_total.value(event="connect") - before == 0
+    finally:
+        await target.stop()
+
+
+async def test_webhook_request_timeout_retries_then_succeeds():
+    # first request hangs past the timeout; the retry lands instantly
+    target = await FlakyWebhookTarget(failures=1, hang_secs=5.0).start()
+    webhook = Webhook(
+        url=target.url,
+        debounce=None,
+        request_timeout=300,  # ms
+        retries=2,
+        retry_base_ms=10,
+        retry_max_ms=20,
+    )
+    before = webhook.retries_total.value(event="change")
+    try:
+        status, _data = await webhook.send_request(Events.onChange, {})
+        assert status == 200
+        assert target.hits == 2
+        assert webhook.retries_total.value(event="change") - before == 1
+    finally:
+        await target.stop()

@@ -173,3 +173,47 @@ async def test_authentication_scope_read_write():
     finally:
         provider.destroy()
         await server.destroy()
+
+
+async def test_reconnect_backoff_capped_exponential_with_jitter():
+    """Reconnect pacing is part of the provider configuration:
+    min/max_reconnect_delay_ms bound a capped exponential ladder, and
+    jitter draws uniformly inside it (a reconnect herd spreads instead
+    of thundering)."""
+    socket = HocuspocusProviderWebsocket(
+        url="ws://127.0.0.1:9",  # never connected: auto_connect off
+        auto_connect=False,
+        delay=100,
+        factor=2,
+        min_reconnect_delay_ms=50,
+        max_reconnect_delay_ms=400,
+        jitter=False,
+    )
+    try:
+        assert socket.min_reconnect_delay_ms == 50
+        assert socket.max_reconnect_delay_ms == 400
+        # deterministic (jitter off): 100, 200, 400, then capped at 400
+        delays_ms = [socket._backoff_delay(a) * 1000 for a in (1, 2, 3, 4, 9)]
+        assert delays_ms == [100, 200, 400, 400, 400]
+        socket.jitter = True
+        for attempt in (1, 2, 3, 8):
+            ceiling = min(100 * (2 ** (attempt - 1)), 400)
+            for _ in range(50):
+                delay_ms = socket._backoff_delay(attempt) * 1000
+                assert 50 <= delay_ms <= max(ceiling, 50) + 1e-6
+    finally:
+        socket.destroy()
+
+
+async def test_provider_exposes_reconnect_delay_configuration():
+    provider = HocuspocusProvider(
+        name="backoff-doc",
+        url="ws://127.0.0.1:9",
+        min_reconnect_delay_ms=25,
+        max_reconnect_delay_ms=900,
+    )
+    try:
+        assert provider.websocket_provider.min_reconnect_delay_ms == 25
+        assert provider.websocket_provider.max_reconnect_delay_ms == 900
+    finally:
+        provider.destroy()

@@ -172,3 +172,41 @@ async def test_cli_sharded_serve_flags():
         finally:
             for p in providers:
                 p.destroy()
+
+
+async def test_cli_wal_and_drain_flags(tmp_path):
+    """--wal-dir boots the durability plane; SIGTERM drains: dirty docs
+    are stored before exit, so a cold reboot serves the edits even with
+    a debounce window that never fired."""
+    wal_dir = str(tmp_path / "wal")
+    db = str(tmp_path / "cli-wal.db")
+    async with _launch_cli(
+        "--wal-dir", wal_dir, "--sqlite", db, "--drain-timeout-secs", "5"
+    ) as port:
+        provider = None
+        try:
+            provider = HocuspocusProvider(
+                name="wal-cli-doc", url=f"ws://127.0.0.1:{port}"
+            )
+            await wait_for(lambda: provider.synced, timeout=20)
+            provider.document.get_text("t").insert(0, "drained durably")
+            await wait_for(lambda: not provider.has_unsynced_changes, timeout=10)
+            await asyncio.sleep(0.2)  # let the WAL group commit land
+        finally:
+            if provider is not None:
+                provider.destroy()
+    # the context manager SIGTERMed the process: drain stored the doc
+    async with _launch_cli("--wal-dir", wal_dir, "--sqlite", db) as port:
+        reader = None
+        try:
+            reader = HocuspocusProvider(
+                name="wal-cli-doc", url=f"ws://127.0.0.1:{port}"
+            )
+            await wait_for(lambda: reader.synced, timeout=20)
+            await wait_for(
+                lambda: str(reader.document.get_text("t")) == "drained durably",
+                timeout=10,
+            )
+        finally:
+            if reader is not None:
+                reader.destroy()

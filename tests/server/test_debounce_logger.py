@@ -49,6 +49,97 @@ async def test_execute_now_runs_pending_and_clears():
     assert debouncer.execute_now("missing") is None
 
 
+async def test_in_flight_covers_timer_fire_to_task_completion():
+    """The unload-decision window the comment in debounce.py documents:
+    between the timer popping `_timers` and the task's coroutine first
+    running, the work is invisible to `is_debounced` AND to any mutex
+    the coroutine will take. `in_flight` must be True for that whole
+    stretch, or a caller tears down state the store still needs."""
+    debouncer = Debouncer()
+    started = asyncio.Event()
+    release = asyncio.Event()
+
+    async def store() -> None:
+        started.set()
+        await release.wait()
+
+    task = debouncer.debounce("k", store, 0, 10000)  # fires immediately
+    # the exact hazard window: timer fired (not debounced any more), the
+    # coroutine has NOT run yet (no mutex held, nothing started)
+    assert not debouncer.is_debounced("k")
+    assert not started.is_set()
+    assert debouncer.in_flight("k"), (
+        "fired-but-not-started store invisible to in_flight: the unload "
+        "path would drop the doc out from under the pending store"
+    )
+    await started.wait()
+    assert debouncer.in_flight("k")  # still running
+    release.set()
+    await task
+    assert not debouncer.in_flight("k")
+
+
+async def test_timer_fired_store_cannot_race_unload(tmp_path):
+    """End-to-end pin for the Debouncer.in_flight unload window: a
+    store fired by the debounce timer is still pending when the last
+    connection closes. handle_close must NOT unload the document — the
+    store task's own finally does, after the store completed. A doc
+    dropped from the registry before its state hit storage would load
+    EMPTY on a fast rejoin."""
+    from hocuspocus_tpu.extensions import Database
+    from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+    gate = asyncio.Event()
+    store_started = asyncio.Event()
+    stored: list = []
+
+    async def slow_store(data) -> None:
+        store_started.set()
+        await gate.wait()
+        stored.append(bytes(data["state"]))
+
+    server = await new_hocuspocus(
+        extensions=[Database(store=slow_store)], debounce=30
+    )
+    provider = new_provider(server, name="race-doc")
+    try:
+        await wait_synced(provider)
+        provider.document.get_text("t").insert(0, "must survive the race")
+        # wait for the debounce timer to FIRE (store coroutine started,
+        # now parked on the gate with the save_mutex held)
+        await asyncio.wait_for(store_started.wait(), timeout=10)
+        assert server.hocuspocus.debouncer.in_flight("onStoreDocument-race-doc")
+        # last connection leaves while the store is still pending
+        provider.destroy()
+        await asyncio.sleep(0.1)
+        assert "race-doc" in server.hocuspocus.documents, (
+            "unload raced the in-flight store and dropped the doc"
+        )
+        gate.set()
+        await retryable_assertion(lambda: _assert_true(stored))
+        # with the store complete and no connections, the task's finally
+        # unloads the doc
+        await retryable_assertion(
+            lambda: _assert_true("race-doc" not in server.hocuspocus.documents)
+        )
+        # a rejoin loads the STORED state, not an empty doc
+        rejoin = new_provider(server, name="race-doc")
+        try:
+            await wait_synced(rejoin)
+            # the Database fetch is a no-op here, but the registry must
+            # have gone through a full store-then-unload cycle
+            assert stored and len(stored[0]) > 2
+        finally:
+            rejoin.destroy()
+    finally:
+        provider.destroy()
+        await server.destroy()
+
+
+def _assert_true(cond):
+    assert cond
+
+
 async def test_logger_flags_and_format():
     lines = []
     logger = Logger(log=lines.append, on_change=False)

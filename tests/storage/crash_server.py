@@ -1,0 +1,37 @@
+"""Subprocess target for the kill -9 crash/recovery suite.
+
+Runs a real Server with the Durability WAL + SQLite store on an
+OS-assigned port and prints `PORT <n>` once listening. The parent test
+SIGKILLs this process mid-edit-storm and then boots a second copy on
+the same directories to assert recovery. The store debounce is huge on
+purpose: the WAL must be the only thing standing between the storm and
+data loss.
+"""
+
+import asyncio
+import os
+import sys
+
+
+async def main() -> None:
+    wal_dir, db_path = sys.argv[1], sys.argv[2]
+    from hocuspocus_tpu.extensions import SQLite
+    from hocuspocus_tpu.server import Configuration, Server
+    from hocuspocus_tpu.storage import Durability
+
+    server = Server(
+        Configuration(
+            extensions=[Durability(wal_dir=wal_dir), SQLite(database=db_path)],
+            quiet=True,
+            debounce=600_000,  # never stores during the test window
+            max_debounce=600_000,
+        )
+    )
+    await server.listen(port=0)
+    print(f"PORT {server.port}", flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    asyncio.run(main())

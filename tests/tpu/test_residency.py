@@ -797,3 +797,66 @@ async def test_preserved_retired_doc_reclaimed_by_sweep():
     finally:
         a.destroy()
         await server.destroy()
+
+
+async def test_eviction_checkpoints_wal(tmp_path):
+    """WAL + eviction interaction (docs/guides/durability.md): an
+    eviction snapshot is folded into the write-ahead log as a
+    checkpoint record that SUBSUMES the per-update history — the log
+    shrinks to one record, and recovery from it rebuilds the exact
+    evicted state."""
+    from hocuspocus_tpu.storage import REC_SNAPSHOT, WalManager
+
+    rng = np.random.default_rng(11)
+    plane = MergePlane(num_docs=4, capacity=4096)
+    serving = PlaneServing(plane)
+    mgr = ResidencyManager(plane=plane, serving=serving, hydrate_batch=4)
+    wal = WalManager(str(tmp_path / "wal"), fsync="tick")
+
+    ref = Doc()
+    updates = []
+    ref.on("update", lambda update, *rest: updates.append(update))
+    # durability capture seam, as Document wires it: every update is
+    # appended; eviction checkpoints through the doc attribute
+    ref.wal_checkpoint = lambda snapshot: wal.checkpoint("wal-evict", snapshot)
+    plane.register("wal-evict")
+    plane.enqueue_update("wal-evict", encode_state_as_update(ref), presync=True)
+
+    _random_edits(rng, ref, 20)
+    while updates:
+        update = updates.pop(0)
+        wal.append("wal-evict", update)
+        plane.enqueue_update("wal-evict", update)
+    plane.flush()
+    serving.refresh()
+    await wal.flush()
+    records, _report = await wal.replay("wal-evict")
+    assert len(records) >= 5, "edit history must be in the log pre-eviction"
+
+    assert await mgr.evict("wal-evict", ref)
+    assert mgr.is_evicted("wal-evict")
+    # make the checkpoint's group commit durable before reading back
+    await wal.flush()
+    records, report = await wal.replay("wal-evict")
+    assert len(records) == 1, "checkpoint must subsume the per-update history"
+    assert records[0][0] == REC_SNAPSHOT
+    assert report["torn_tail_records"] == 0
+
+    # recovery differential: the checkpoint record alone rebuilds the
+    # evicted doc byte-identically
+    rebuilt = Doc()
+    apply_update(rebuilt, records[0][1])
+    assert _fingerprint(rebuilt) == _fingerprint(ref)
+    assert encode_state_vector(rebuilt) == encode_state_vector(ref)
+
+    # post-eviction edits keep appending AFTER the checkpoint record
+    _random_edits(rng, ref, 5)
+    while updates:
+        wal.append("wal-evict", updates.pop(0))
+    await wal.flush()
+    records, _report = await wal.replay("wal-evict")
+    assert records[0][0] == REC_SNAPSHOT and len(records) > 1
+    replayed = Doc()
+    for _rec_type, payload in records:
+        apply_update(replayed, payload)
+    assert _fingerprint(replayed) == _fingerprint(ref)

@@ -4,8 +4,10 @@
 Compares the newest two `BENCH_*.json` artifacts (or two explicit
 files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms`,
 `extra.wire_load.ingress.p99_ms`,
-`extra.fanout_storm.merge_to_last_write_p99_ms` and
-`extra.replica_storm.merge_to_remote_broadcast_p99_ms` — and exits nonzero
+`extra.fanout_storm.merge_to_last_write_p99_ms`,
+`extra.replica_storm.merge_to_remote_broadcast_p99_ms`, and the
+durability plane's `extra.wal_load.append_p99_ms` +
+`extra.wal_load.wal_on.merge_to_last_write_p99_ms` — and exits nonzero
 when any stage regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
 (latency on shared CPU runners is noisy; the gate is for on-chip
 rounds and deliberate local runs):
@@ -97,6 +99,16 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
         p99 = replica.get("merge_to_remote_broadcast_p99_ms")
         if isinstance(p99, (int, float)) and not isinstance(p99, bool):
             stages["replica_storm.merge_to_remote_broadcast"] = float(p99)
+    wal = extra.get("wal_load")
+    if isinstance(wal, dict):
+        append_p99 = wal.get("append_p99_ms")
+        if isinstance(append_p99, (int, float)) and not isinstance(append_p99, bool):
+            stages["wal_load.append"] = float(append_p99)
+        wal_on = wal.get("wal_on")
+        if isinstance(wal_on, dict):
+            p99 = wal_on.get("merge_to_last_write_p99_ms")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["wal_load.merge_to_last_write_wal_on"] = float(p99)
     return stages
 
 
