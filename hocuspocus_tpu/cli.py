@@ -151,6 +151,43 @@ def build_parser() -> argparse.ArgumentParser:
         "capacity/overflow-retired docs (serve mode; 0 disables, "
         "default 0.75)",
     )
+    # adaptive merge scheduling (docs/guides/tpu-scheduling.md): the
+    # device-lane arbiter orders every dispatch by priority class
+    # (interactive > catch-up > compaction > canary/warmup) and the
+    # arrival-aware governor picks flush cadence + batch count from
+    # measured load instead of the fixed timer.
+    parser.add_argument(
+        "--tpu-scheduler",
+        choices=("on", "off"),
+        default="on",
+        help="adaptive merge scheduling: 'on' (default) runs every "
+        "device dispatch through the priority-class lane arbiter and "
+        "drives flush cadence from the op-arrival EWMA; 'off' restores "
+        "the fixed flush timer with unarbitrated dispatches",
+    )
+    parser.add_argument(
+        "--tpu-drain-watermark",
+        type=int,
+        default=256,
+        help="queued-op depth at which the governor collapses the flush "
+        "tick to an immediate full drain (default 256)",
+    )
+    parser.add_argument(
+        "--tpu-flush-stretch",
+        type=float,
+        default=4.0,
+        help="max factor the governor may stretch the flush tick under "
+        "sparse arrivals — cheap, since broadcasts build from host "
+        "serve logs and never wait on the device flush (default 4)",
+    )
+    parser.add_argument(
+        "--tpu-lane-promote-ms",
+        type=float,
+        default=250.0,
+        help="device-lane starvation guard: a queued background "
+        "admission older than this is promoted to the interactive "
+        "class so aged work always progresses (default 250)",
+    )
     # plane supervisor (docs/guides/tpu-supervisor.md): the TPU runtime
     # is an accelerator the server may acquire, never a boot dependency
     # — a wedged/absent runtime degrades to CPU-merge mode, the server
@@ -308,6 +345,11 @@ async def run(args: argparse.Namespace) -> None:
                 evict_idle_secs=args.tpu_evict_idle_secs,
                 hydrate_batch=args.tpu_hydrate_batch,
                 compact_threshold=args.tpu_compact_threshold,
+                governor=args.tpu_scheduler == "on",
+                lane=None if args.tpu_scheduler == "on" else False,
+                drain_watermark=args.tpu_drain_watermark,
+                flush_stretch=args.tpu_flush_stretch,
+                lane_promote_ms=args.tpu_lane_promote_ms,
             )
         )
 

@@ -102,6 +102,15 @@ class CompileTracker:
     def seen(self, site: str, shape) -> bool:
         return (site, shape_label(shape)) in self._seen
 
+    def mark_covered(self, site: str, shape) -> None:
+        """The process-wide jit cache already holds this (site, shape)
+        program — another plane's warm pass compiled it (tpu/scheduler.py
+        shared warm registry). Seed the seen set so this plane's live
+        dispatches classify as the cache hits they are, without charging
+        a fresh compile this tracker never paid (and without the storm
+        detector firing on a warmed-elsewhere shape)."""
+        self._seen.add((site, shape_label(shape)))
+
     def observe(
         self, site: str, shape, seconds: float, warmup: bool = False
     ) -> str:

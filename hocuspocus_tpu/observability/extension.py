@@ -269,6 +269,16 @@ class Metrics(Extension):
         with `.plane`, or the sharded router with `.shards`). Returns
         True when a plane surface was found and bound."""
         reg = self.registry
+        # device-lane arbiter telemetry (tpu/scheduler.py): wait
+        # histograms per class, queue depths, occupancy, preemption/
+        # starvation/deferral counters — adopted like the wire collector
+        lane = getattr(owner, "lane", None)
+        if lane is not None and callable(getattr(lane, "metrics", None)):
+            for metric in lane.metrics():
+                try:
+                    reg.register(metric)
+                except ValueError:
+                    pass  # already adopted (shared lane, repeat bind)
         plane = getattr(owner, "plane", None)
         counters = getattr(plane, "counters", None)
         if isinstance(counters, dict):
@@ -684,6 +694,8 @@ class Metrics(Extension):
             if path == "/debug/slo":
                 self.slo.maybe_sample()
                 self._serve_json(data, self.slo.status())
+            if path == "/debug/scheduler":
+                self._serve_json(data, self._scheduler_overview())
             if path == "/debug/trace":
                 self._serve_json(data, get_tracer().export_chrome_trace())
             if path == "/debug/docs":
@@ -757,6 +769,22 @@ class Metrics(Extension):
         if shards:
             return [shard.plane for shard in shards]
         return []
+
+    def _scheduler_overview(self) -> dict:
+        """`/debug/scheduler`: the device-lane arbiter's state (classes,
+        queue depths, occupancy, preemption/starvation accounting) plus
+        every shard's batching-governor snapshot
+        (docs/guides/tpu-scheduling.md)."""
+        owner = self._plane_owner
+        if owner is None:
+            return {"scheduler": None, "note": "no merge plane bound"}
+        runtime = getattr(owner, "runtime", None)
+        if runtime is not None:
+            owner = runtime  # supervised: the runtime holds lane/governor
+        snapshot_fn = getattr(owner, "scheduler_snapshot", None)
+        if callable(snapshot_fn):
+            return snapshot_fn()
+        return {"scheduler": None, "note": "plane owner has no scheduler"}
 
     def _docs_overview(self, top_k: int = 20) -> dict:
         """`/debug/docs`: top-K busiest docs (driven by the planes' busy

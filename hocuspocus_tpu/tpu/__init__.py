@@ -27,6 +27,12 @@ _LAZY = {
     "ResidencyManager": ("residency", "ResidencyManager"),
     "SupervisedTpuMergeExtension": ("supervisor", "SupervisedTpuMergeExtension"),
     "CircuitBreaker": ("supervisor", "CircuitBreaker"),
+    # adaptive merge scheduling (tpu/scheduler.py): these import no
+    # kernel/JAX modules, so resolving them stays boot-safe
+    "DeviceLane": ("scheduler", "DeviceLane"),
+    "BatchGovernor": ("scheduler", "BatchGovernor"),
+    "get_device_lane": ("scheduler", "get_device_lane"),
+    "reset_device_lane": ("scheduler", "reset_device_lane"),
 }
 
 __all__ = sorted(_LAZY)

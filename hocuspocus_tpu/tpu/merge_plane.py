@@ -18,6 +18,7 @@ go straight to the doc's serve log.
 from __future__ import annotations
 
 import asyncio
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -386,6 +387,17 @@ class MergePlane:
         }
         # short-TTL memo for memory_stats (one scrape = one pytree walk)
         self._memory_stats_cache: "tuple[float, Optional[dict]]" = (0.0, None)
+        # device-lane arbiter seam (tpu/scheduler.py): set by the owning
+        # extension. The plane never admits itself — its CLIENTS (flush
+        # engine, hydration, compaction, canary, warmup) hold the lane;
+        # the dispatch sites below only ACCOUNT each device dispatch as
+        # in-lane or bypass, so the scheduler-accounting test can pin
+        # "no dispatch bypasses the arbiter" on the scheduled paths.
+        self.lane = None
+
+    def _note_dispatch(self, site: str, batches: int = 1) -> None:
+        if self.lane is not None:
+            self.lane.note_dispatch(site, batches)
 
     # -- arena dispatch ----------------------------------------------------
 
@@ -958,7 +970,7 @@ class MergePlane:
         with self._step_lock:
             return self._flush_locked(max_batches)
 
-    def warmup_compiles(self, shape=None) -> None:
+    def warmup_compiles(self, shape=None, shared: bool = False) -> bool:
         """Pre-compile the integrate step over the (K, B) flush grid.
 
         The first flush at each batch shape otherwise pays the
@@ -970,12 +982,39 @@ class MergePlane:
         warmup_shapes() to compile one shape (callers can interleave
         lock acquisition per shape), a bare int k for the dense
         (k, num_docs) shape, or nothing for the whole grid.
+
+        shared=True consults the process-wide warm registry
+        (tpu/scheduler.py): the jitted steps are module-level, so a
+        shape another identically-shaped plane already warmed is a
+        guaranteed jit-cache hit — skip the redundant no-op dispatch
+        and seed this plane's CompileTracker instead (shards 2..N of a
+        sharded deployment boot without N identical warm sweeps).
+        Mesh-backed planes build per-plane jitted closures and never
+        share. Returns True when any program was actually dispatched.
         """
         full_grid = shape is None
         shapes = [shape] if shape is not None else self.warmup_shapes()
+        shapes = [
+            entry if isinstance(entry, tuple) else (entry, self.num_docs)
+            for entry in shapes
+        ]
+        share = shared and self.mesh is None
+        if share:
+            from .scheduler import note_warmed, shared_warm_filter
+
+            shapes, covered = shared_warm_filter(
+                self.arena, self.num_docs, self.capacity, shapes
+            )
+            for k, b in covered:
+                if b >= self.num_docs:
+                    self.compile_watch.mark_covered(
+                        "integrate_dense", (k, self.num_docs)
+                    )
+                else:
+                    self.compile_watch.mark_covered("integrate_sparse", (k, b))
+        dispatched = False
         with self._step_lock:
-            for entry in shapes:
-                k, b = entry if isinstance(entry, tuple) else (entry, self.num_docs)
+            for k, b in shapes:
                 if b >= self.num_docs:
                     ops = self._empty_batch(k)
                     with self.compile_watch.track(
@@ -992,10 +1031,15 @@ class MergePlane:
                             self.state, ops, slots
                         )
                         int(count)  # completion barrier (data-dependent)
+                self._note_dispatch("warmup")
+                dispatched = True
+                if share:
+                    note_warmed(self.arena, self.num_docs, self.capacity, (k, b))
         if full_grid:
             # the whole grid is compiled: any later fresh compile means
             # the flush shapes drifted off the warmed buckets
             self.compile_watch.mark_warmed()
+        return dispatched
 
     def canary_probe(self) -> float:
         """One tiny no-op integrate + data-dependent readback: the plane
@@ -1020,6 +1064,7 @@ class MergePlane:
                 with self.compile_watch.track("integrate_dense", (1, self.num_docs)):
                     self.state, count = self._step_fn()(self.state, ops)
                     int(count)  # completion barrier (data-dependent readback)
+            self._note_dispatch("canary")
         return time.perf_counter() - started
 
     def _k_buckets(self) -> list[int]:
@@ -1202,6 +1247,7 @@ class MergePlane:
             upload_bytes += staging.nbytes(k, b, slot_view is not None)
             k_last, b_last, busy_last = k, b, b_actual
         if batches:
+            self._note_dispatch("flush", batches)
             t3 = time.perf_counter()
             self._sync_health()
             t_sync = time.perf_counter()
@@ -1752,7 +1798,31 @@ class TpuMergeExtension(Extension):
         evict_idle_secs: float = 0.0,
         hydrate_batch: int = 64,
         compact_threshold: float = 0.0,
+        governor: bool = True,
+        lane=None,
+        phase_offset_ms: Optional[float] = None,
+        drain_watermark: int = 256,
+        flush_stretch: float = 4.0,
+        lane_promote_ms: float = 250.0,
     ) -> None:
+        """Scheduling knobs (docs/guides/tpu-scheduling.md):
+
+        governor — arrival-aware batching: the flush cadence and the
+        kernel calls per cycle follow the op-arrival EWMA, queue depth
+        and lane congestion instead of the fixed flush_interval_ms
+        (which stays the governor's BASE cadence). False restores the
+        fixed timer exactly.
+        lane — the device-lane arbiter this extension's device work
+        admits through: a DeviceLane instance, None for the process-
+        global one (all shards of one chip must share an arbiter), or
+        False to disable arbitration entirely (benches' off-leg).
+        phase_offset_ms — deterministic timer phase (the sharded router
+        assigns i/N spreads so N shards stop tick-aligning dispatches).
+        drain_watermark — queue depth that collapses the tick to an
+        immediate full drain. flush_stretch — max tick stretch under
+        sparse arrivals. lane_promote_ms — lane starvation guard: a
+        waiter older than this is promoted to the interactive class.
+        """
         if plane is not None and mesh is not None:
             raise ValueError(
                 "pass mesh= to the MergePlane you construct, not alongside plane= "
@@ -1761,6 +1831,35 @@ class TpuMergeExtension(Extension):
         self.plane = plane or MergePlane(
             num_docs=num_docs, capacity=capacity, mesh=mesh, arena=arena
         )
+        from .scheduler import BatchGovernor, get_device_lane
+
+        if lane is False:
+            self.lane = None
+        elif lane is None:
+            self.lane = get_device_lane()
+        else:
+            self.lane = lane
+        if self.lane is not None:
+            self.lane.promote_after_s = max(lane_promote_ms, 0.0) / 1000.0
+        self.plane.lane = self.lane
+        self.governor = (
+            BatchGovernor(
+                base_interval_ms=flush_interval_ms,
+                max_stretch=flush_stretch,
+                drain_watermark=drain_watermark,
+            )
+            if governor
+            else None
+        )
+        self.phase_offset_ms = phase_offset_ms
+        # governor policy inputs ride a short-TTL depth cache:
+        # pending_ops() is O(busy slots) and the capture seam calls the
+        # governor per update — during a 2k-doc hydration storm an
+        # exact walk per capture would cost the interactive path more
+        # than the scheduling saves. Policy tolerates 5ms staleness;
+        # the post-flush reschedule check stays exact.
+        self._depth_cache = 0
+        self._depth_cache_at = 0.0
         # native text lane: the C++ host path (lower+log+queue+window)
         # for plain-text docs — the round-3 host-plane bottleneck fix.
         # Serve-mode only (its broadcast windows ride the lane) and
@@ -1774,6 +1873,12 @@ class TpuMergeExtension(Extension):
         # remote-attached) never sits on the edit->observe path
         self.broadcast_interval_ms = broadcast_interval_ms
         self._flush_handle: Optional[asyncio.TimerHandle] = None
+        # single-flight guard for the flush task: captures keep the
+        # timer armed, and without this a long background lane hold
+        # (one hydration round can run hundreds of ms) would stack one
+        # queued flush task per tick — hundreds of waiters the arbiter
+        # then scans per grant. One cycle in flight; it reschedules.
+        self._flush_inflight = False
         self._broadcast_handle: Optional[asyncio.TimerHandle] = None
         self._last_broadcast_at = 0.0
         self.serve = serve
@@ -1821,6 +1926,17 @@ class TpuMergeExtension(Extension):
 
     def servings(self) -> list:
         return [] if self.serving is None else [self.serving]
+
+    def scheduler_snapshot(self) -> dict:
+        """Lane + governor state for /debug/scheduler (uniform with the
+        sharded router's aggregate)."""
+        return {
+            "lane": None if self.lane is None else self.lane.snapshot(),
+            "governors": [
+                None if self.governor is None else self.governor.snapshot()
+            ],
+            "phase_offsets_ms": [self.phase_offset_ms],
+        }
 
     def is_served(self, document_name: str) -> bool:
         return document_name in self._docs
@@ -1870,28 +1986,59 @@ class TpuMergeExtension(Extension):
 
     async def on_listen(self, data: Payload) -> None:
         """Kick off compile warmup so the first live flush at each batch
-        shape doesn't pay XLA/Mosaic compile time in the serving path."""
+        shape doesn't pay XLA/Mosaic compile time in the serving path.
+
+        The warm grid rides the device lane at the LOWEST priority, one
+        admission per shape (tpu/scheduler.py): early client flushes
+        preempt between compiles instead of waiting out the whole grid,
+        and the shared warm registry makes shard 2..N of a sharded
+        deployment skip shapes shard 1 already compiled (the jitted
+        steps are module-level, so the XLA cache already holds them)."""
 
         async def warm() -> None:
+            from .scheduler import CLASS_CANARY, LaneDeferred
+
             loop = asyncio.get_event_loop()
             # one lock acquisition per shape: early client syncs and
             # unloads interleave between compiles instead of stalling
             # for the whole warmup
             for shape in self.plane.warmup_shapes():
+                ticket = None
+                if self.lane is not None:
+                    try:
+                        ticket = await self.lane.admit(
+                            CLASS_CANARY, site="warmup", weight=1
+                        )
+                    except LaneDeferred:
+                        return  # parked: the re-attach warm pass retries
                 try:
                     async with self.plane.flush_lock:
                         await loop.run_in_executor(
-                            None, lambda s=shape: self.plane.warmup_compiles(s)
+                            None,
+                            lambda s=shape: self.plane.warmup_compiles(
+                                s, shared=True
+                            ),
                         )
                 except Exception:
                     from ..server import logger as _logger_mod
 
                     _logger_mod.log_error("plane compile warmup failed (continuing)")
                     return
+                finally:
+                    if ticket is not None:
+                        ticket.release(preempted=ticket.should_yield())
             # from here every flush shape is compiled: a later fresh
             # compile is the recompile-storm signal
             self.plane.compile_watch.mark_warmed()
             if self.serving is not None:
+                ticket = None
+                if self.lane is not None:
+                    try:
+                        ticket = await self.lane.admit(
+                            CLASS_CANARY, site="warmup", weight=1
+                        )
+                    except LaneDeferred:
+                        return
                 try:
                     async with self.plane.flush_lock:
                         await loop.run_in_executor(None, self.serving.warmup_gathers)
@@ -1899,6 +2046,9 @@ class TpuMergeExtension(Extension):
                     from ..server import logger as _logger_mod
 
                     _logger_mod.log_error("gather warmup failed (continuing)")
+                finally:
+                    if ticket is not None:
+                        ticket.release()
 
         self._spawn_tracked(warm())
         self._schedule_residency()
@@ -1973,7 +2123,9 @@ class TpuMergeExtension(Extension):
             if plane_doc is not None and plane_doc.retired:
                 self._maybe_recycle(data.document, plane_doc.retire_reason)
                 return
-        self.plane.enqueue_update(data.document_name, data.update)
+        accepted = self.plane.enqueue_update(data.document_name, data.update)
+        if accepted and self.governor is not None:
+            self.governor.note_arrival(accepted)
         self._schedule_flush()
 
     async def after_unload_document(self, data: Payload) -> None:
@@ -2037,9 +2189,10 @@ class TpuMergeExtension(Extension):
         # extensions like Redis destroy first, so their pub/sub is
         # already closed — peers heal via the join protocol and
         # anti-entropy), then fully drain the device queues: no timer
-        # fires after teardown to pick up either
+        # fires after teardown to pick up either. final=True: the drain
+        # is pause-exempt — a parked lane must not strand teardown
         self._broadcast_served(cross_instance=False)
-        await self._flush_now(max_batches=None)
+        await self._flush_now(max_batches=None, final=True)
 
     # -- serving: update capture (called by Document._handle_update) ---------
 
@@ -2117,6 +2270,10 @@ class TpuMergeExtension(Extension):
             self._fallback_to_cpu(document)
             self._maybe_recycle(document, reason)
             return False
+        if accepted and self.governor is not None:
+            # feed the arrival-rate EWMA BEFORE scheduling: the cadence
+            # decision below reads it
+            self.governor.note_arrival(accepted)
         self._schedule_flush()
         self._schedule_broadcast()
         return True
@@ -2218,6 +2375,23 @@ class TpuMergeExtension(Extension):
         row (no headroom) or still doesn't fit the plane, the doc stays
         on the CPU path rather than thrash through recycles.
         """
+        from .scheduler import CLASS_CATCHUP, LaneDeferred
+
+        ticket = None
+        if self.lane is not None:
+            try:
+                # catch-up class: recovery work for a live busy doc —
+                # outranks compaction sweeps, yields to live flushes
+                ticket = await self.lane.admit(CLASS_CATCHUP, site="recycle")
+            except LaneDeferred:
+                return  # parked: the next capture on this doc retries
+        try:
+            await self._recycle_capacity_doc_admitted(document)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    async def _recycle_capacity_doc_admitted(self, document) -> None:
         from ..crdt import encode_state_as_update
 
         name = document.name
@@ -2458,7 +2632,9 @@ class TpuMergeExtension(Extension):
         except Exception:
             _logger_mod.log_error(f"CPU fallback failed for {name!r}")
 
-    async def _flush_now(self, max_batches: Optional[int] = 1) -> None:
+    async def _flush_now(
+        self, max_batches: Optional[int] = 1, final: bool = False
+    ) -> None:
         """Flush+serve with the DEVICE step off the event loop.
 
         plane.flush() host-syncs on the integrate step; running it
@@ -2474,23 +2650,79 @@ class TpuMergeExtension(Extension):
         full RTT on a remote-attached chip — only gates validation and
         sync serves, never the edit->observe path. The default of ONE
         kernel batch per cycle keeps cycles short; the remainder
-        reschedules. on_destroy passes None for a full drain — no timer
-        fires after teardown.
+        reschedules. on_destroy passes final=True with max_batches=None
+        for a pause-exempt full drain — no timer fires after teardown.
+
+        The cycle admits through the device lane as INTERACTIVE before
+        touching the flush lock (tpu/scheduler.py): background clients
+        — hydration batches, compaction sweeps, warm compiles — queue
+        behind it and yield between their own microbatches, so a 2-doc
+        flush never sits behind a full-population sweep. A parked lane
+        (supervisor breaker open) defers the cycle instead of stacking
+        blocked tasks onto a wedged device.
         """
-        async with self.plane.flush_lock:
+        from .scheduler import CLASS_INTERACTIVE, CLASS_NAMES, LaneDeferred
+
+        if self._flush_inflight and not final:
+            return  # the in-flight cycle reschedules; don't stack waiters
+        self._flush_inflight = True
+        try:
+            ticket = None
+            if self.lane is not None:
+                try:
+                    ticket = await self.lane.admit(
+                        CLASS_INTERACTIVE,
+                        site="flush",
+                        ignore_pause=final,
+                        deadline_s=5.0 if final else None,
+                    )
+                except LaneDeferred as deferred:
+                    get_flight_recorder().record(
+                        "__plane__",
+                        "flush_deferred",
+                        lane_class=CLASS_NAMES[deferred.lane_class],
+                        wait_ms=round(deferred.waited_s * 1000.0, 3),
+                        reason=deferred.reason,
+                    )
+                    if final:
+                        ticket = None  # teardown drain proceeds unarbitrated
+                    elif self.plane.pending_ops() > 0:
+                        # parked: retry on a slow cadence (the supervisor
+                        # resumes the lane at re-attach; a tight retry loop
+                        # would just churn timers against a wedged device)
+                        self._schedule_flush(delay_override=0.25)
+                        return
+                    else:
+                        return
             try:
-                await asyncio.get_event_loop().run_in_executor(
-                    None, lambda: self.plane.flush(max_batches)
-                )
-                if self.serve:
-                    self.serving.refresh()
-            except Exception:
-                self._degrade_all_served()
-                return
-            if self.serve:
-                self._validate_served()
-        if self.plane.pending_ops() > 0:
-            self._schedule_flush()
+                if self.governor is not None and max_batches == 1:
+                    congested = self.lane is not None and self.lane.contended()
+                    max_batches = self.governor.max_batches(
+                        self._policy_depth(), congested
+                    )
+                async with self.plane.flush_lock:
+                    try:
+                        await asyncio.get_event_loop().run_in_executor(
+                            None, lambda: self.plane.flush(max_batches)
+                        )
+                        if self.serve:
+                            self.serving.refresh()
+                    except Exception:
+                        self._degrade_all_served()
+                        return
+                    if self.serve:
+                        self._validate_served()
+                if self.governor is not None:
+                    self.governor.note_cycle(self.plane.flush_stats)
+            finally:
+                if ticket is not None:
+                    ticket.release()
+            if self.plane.pending_ops() > 0:
+                self._schedule_flush()
+            elif self.governor is not None:
+                self.governor.note_park()
+        finally:
+            self._flush_inflight = False
 
     def _validate_served(self) -> None:
         """Post-flush desync sweep, vectorized over every slot.
@@ -2523,7 +2755,7 @@ class TpuMergeExtension(Extension):
                 if document is not None:
                     self._fallback_to_cpu(document)
 
-    def _schedule_flush(self) -> None:
+    def _schedule_flush(self, delay_override: Optional[float] = None) -> None:
         if self._flush_handle is not None:
             return
 
@@ -2531,9 +2763,48 @@ class TpuMergeExtension(Extension):
             self._flush_handle = None
             self._spawn_tracked(self._flush_now())
 
-        self._flush_handle = asyncio.get_event_loop().call_later(
-            self.flush_interval_ms / 1000, run
+        if delay_override is not None:
+            delay = delay_override
+        elif self.governor is not None:
+            # arrival-aware cadence: immediate full drain past the
+            # queue-depth watermark, base cadence under steady load or
+            # lane congestion, stretched ticks when arrivals are sparse
+            congested = self.lane is not None and self.lane.contended()
+            delay = self.governor.flush_delay_s(
+                self._policy_depth(), congested
+            )
+        else:
+            delay = self.flush_interval_ms / 1000
+        if delay:
+            # sustained-cadence ticks quantize onto the shard's phase
+            # grid; the watermark's zero-delay drain stays IMMEDIATE
+            # (same exemption as the broadcast scheduler's idle path)
+            delay = self._align_to_phase(delay, self.flush_interval_ms / 1000)
+        self._flush_handle = asyncio.get_event_loop().call_later(delay, run)
+
+    def _policy_depth(self) -> int:
+        """Queued-op depth for GOVERNOR decisions only (5ms-stale)."""
+        now = time.monotonic()
+        if now - self._depth_cache_at > 0.005:
+            self._depth_cache = self.plane.pending_ops()
+            self._depth_cache_at = now
+        return self._depth_cache
+
+    def _align_to_phase(self, delay: float, interval_s: float) -> float:
+        """Deterministic per-shard timer stagger: quantize the fire time
+        onto this shard's phase grid (offset i/N of the interval, set by
+        the sharded router) so N shards stop tick-aligning their device
+        dispatches. Never fires earlier than asked — alignment only adds
+        up to one interval. No-op for unsharded extensions."""
+        if self.phase_offset_ms is None or interval_s <= 0:
+            return delay
+        now = asyncio.get_event_loop().time()
+        phase = (self.phase_offset_ms / 1000.0) % interval_s
+        fire = now + delay
+        aligned = (
+            math.ceil((fire - phase) / interval_s) * interval_s + phase
         )
+        return max(aligned - now, delay)
 
     def _schedule_residency(self) -> None:
         """Periodic residency maintenance (eviction + proactive
@@ -2572,9 +2843,12 @@ class TpuMergeExtension(Extension):
         # coalescing window only under sustained traffic: a lone edit
         # after an idle gap broadcasts on the next loop tick (the
         # window would be pure added latency), while back-to-back edits
-        # within the window share one frame per doc
+        # within the window share one frame per doc. Sustained-traffic
+        # windows quantize onto the shard's phase grid (sharded router)
+        # so N shards' broadcast passes stop landing on the same tick.
         window = self.broadcast_interval_ms / 1000
         idle = loop.time() - self._last_broadcast_at
-        self._broadcast_handle = loop.call_later(
-            0 if idle >= window else window, run
-        )
+        delay = 0.0 if idle >= window else window
+        if delay:
+            delay = self._align_to_phase(delay, window)
+        self._broadcast_handle = loop.call_later(delay, run)

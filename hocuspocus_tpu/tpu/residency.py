@@ -150,6 +150,11 @@ class ResidencyManager:
         self._sweep_ring: list[str] = []
         self._tasks: set = set()
         self.plane.residency = self  # retire-time log preservation seam
+        # device-lane arbiter (tpu/scheduler.py): hydration batches ride
+        # the catch-up class, compaction sweeps the background class —
+        # both yield to interactive flushes between microbatches. A
+        # standalone manager (tests, benches) runs unarbitrated.
+        self.lane = getattr(extension, "lane", None)
 
     # -- policy inputs -------------------------------------------------------
 
@@ -317,7 +322,17 @@ class ResidencyManager:
                     return
                 document = self.extension._docs.get(name)
                 if document is not None:
-                    await self.evict(name, document)
+                    # background-class admission: the eviction snapshot
+                    # may drain pending ops through the serving path —
+                    # a device dispatch like any other
+                    ticket = await self._admit_background("evict")
+                    if ticket is False:
+                        return  # lane parked: retry next maintenance tick
+                    try:
+                        await self.evict(name, document)
+                    finally:
+                        if ticket is not None:
+                            ticket.release(preempted=ticket.should_yield())
         if self.compact_threshold > 0:
             await self._compact_sweep()
         # runs regardless of the threshold: retire-time log preservation
@@ -433,40 +448,62 @@ class ResidencyManager:
             self._spawn(self._drain_hydrations())
 
     async def _drain_hydrations(self) -> None:
+        from .scheduler import CLASS_CATCHUP, LaneDeferred
+
         plane = self.plane
         try:
             while self._queue:
                 if self.paused:
                     await asyncio.sleep(0.05)
                     continue
+                ticket = None
+                if self.lane is not None:
+                    try:
+                        # catch-up class: admitted per ROUND, so the
+                        # lane re-arbitrates between rounds and an
+                        # interactive flush never waits out the whole
+                        # storm. Parked lane (breaker open): hold the
+                        # queue and retry — admission control, lossless.
+                        ticket = await self.lane.admit(
+                            CLASS_CATCHUP, site="hydrate"
+                        )
+                    except LaneDeferred:
+                        await asyncio.sleep(0.05)
+                        continue
                 batch = []
                 while self._queue and len(batch) < self.hydrate_batch:
                     batch.append(self._queue.popleft())
                 self.inflight = len(batch)
                 self._publish_stats(last_hydration_batch=len(batch))
                 admitted = 0
-                async with plane.flush_lock:
-                    for i, (name, document, t_req) in enumerate(batch):
-                        self._queued.discard(name)
-                        try:
-                            if self._hydrate_one_locked(name, document):
-                                admitted += 1
-                        except Exception:
-                            plane.counters["hydrations_declined"] += 1
-                        self._hydration_latencies.append(
-                            time.perf_counter() - t_req
-                        )
-                        if i % 8 == 7:
-                            await asyncio.sleep(0)  # keep websockets pumping
-                    if admitted:
-                        # ONE device drain integrates the whole batch's
-                        # snapshots (bucketed shapes: no fresh compiles)
-                        loop = asyncio.get_event_loop()
-                        await loop.run_in_executor(
-                            None, lambda: plane.flush(None)
-                        )
-                        if self.serving is not None:
-                            self.serving.refresh()
+                try:
+                    async with plane.flush_lock:
+                        for i, (name, document, t_req) in enumerate(batch):
+                            self._queued.discard(name)
+                            try:
+                                if self._hydrate_one_locked(name, document):
+                                    admitted += 1
+                            except Exception:
+                                plane.counters["hydrations_declined"] += 1
+                            self._hydration_latencies.append(
+                                time.perf_counter() - t_req
+                            )
+                            if i % 8 == 7:
+                                await asyncio.sleep(0)  # keep websockets pumping
+                        if admitted:
+                            # ONE device drain integrates the whole batch's
+                            # snapshots (bucketed shapes: no fresh compiles)
+                            loop = asyncio.get_event_loop()
+                            await loop.run_in_executor(
+                                None, lambda: plane.flush(None)
+                            )
+                            if self.serving is not None:
+                                self.serving.refresh()
+                finally:
+                    if ticket is not None:
+                        # preempted = released BECAUSE higher-priority
+                        # work was waiting (flight-recorded by the lane)
+                        ticket.release(preempted=ticket.should_yield())
                 if admitted and self.extension is not None:
                     # the presync registration enqueues marked the docs
                     # dirty, and broadcast ticks are capture-driven: with
@@ -596,10 +633,30 @@ class ResidencyManager:
         for name in names:
             if self.paused:
                 return
-            async with plane.flush_lock:
-                await self.compact_doc_locked(
-                    name, min_reclaim=max(plane.capacity // 8, 1)
-                )
+            ticket = await self._admit_background("compact_sweep")
+            if ticket is False:
+                return  # lane parked: retry next maintenance tick
+            try:
+                async with plane.flush_lock:
+                    await self.compact_doc_locked(
+                        name, min_reclaim=max(plane.capacity // 8, 1)
+                    )
+            finally:
+                if ticket is not None:
+                    ticket.release(preempted=ticket.should_yield())
+
+    async def _admit_background(self, site: str):
+        """One background-class lane admission (compaction/GC sweeps):
+        None when unarbitrated, False when the lane is parked — the
+        sweep stops and the next maintenance tick retries."""
+        if self.lane is None:
+            return None
+        from .scheduler import CLASS_BACKGROUND, LaneDeferred
+
+        try:
+            return await self.lane.admit(CLASS_BACKGROUND, site=site)
+        except LaneDeferred:
+            return False
 
     async def _visit_preserved(self) -> None:
         """Proactive pass over log-preserving retires (note_preserved):
@@ -622,17 +679,24 @@ class ResidencyManager:
             document = (
                 instance.documents.get(name) if instance is not None else None
             )
-            async with plane.flush_lock:
-                doc = plane.docs.get(name)
-                if doc is None or not doc.retired:
-                    self._preserved.discard(name)
-                    continue
-                if document is None:
-                    # not loaded (mid-unload): just free the host memory
-                    plane.drop_doc_logs(name)
-                    self._preserved.discard(name)
-                    continue
-                await self.compact_and_replay_locked(name, document)
+            ticket = await self._admit_background("compact_preserved")
+            if ticket is False:
+                return  # lane parked: retry next maintenance tick
+            try:
+                async with plane.flush_lock:
+                    doc = plane.docs.get(name)
+                    if doc is None or not doc.retired:
+                        self._preserved.discard(name)
+                        continue
+                    if document is None:
+                        # not loaded (mid-unload): just free the host memory
+                        plane.drop_doc_logs(name)
+                        self._preserved.discard(name)
+                        continue
+                    await self.compact_and_replay_locked(name, document)
+            finally:
+                if ticket is not None:
+                    ticket.release(preempted=ticket.should_yield())
 
     async def compact_and_replay_locked(self, name: str, document) -> bool:
         """The recycle rail, shared by the retire-seam recycle
@@ -790,6 +854,7 @@ class ResidencyManager:
         plane.state, sizes = plane._compact_step_fn()(
             plane.state, jnp.asarray(routed, jnp.int32)
         )
+        plane._note_dispatch("compact")
         return np.asarray(sizes)[: len(slots)]
 
     def _writable_health_caches(self) -> None:

@@ -1,0 +1,696 @@
+"""Adaptive merge scheduling: the device-lane arbiter + batching governor.
+
+The sharded plane (tpu/sharded_extension.py) runs N independent flush
+pipelines with fixed timers that contend blindly for ONE device: an
+interactive 2-doc flush can sit behind a 100k-row compaction sweep, a
+hydration batch, or another shard's full microbatch. Serving-systems
+practice (continuous batching under an SLO) and the CRDT-perf
+literature (Eg-walker's minimal-work-per-merge, arXiv:2409.14252) both
+say the same thing: batch size and dispatch order must follow measured
+arrival rate and latency budget, not wall-clock timers. This module is
+that scheduling layer, in three parts:
+
+1. **`DeviceLane`** — a process-global admission arbiter every device
+   client passes through before dispatching: shard flushes
+   (interactive), hydration batches (catch-up), compaction/GC sweeps
+   (background), canary probes and warm-grid compiles (lowest). One
+   holder at a time (one chip); waiters are granted strictly by
+   priority class, FIFO within a class. Background holders are expected
+   to check `ticket.should_yield()` between microbatches and release —
+   preemption at batch granularity, since a launched kernel is not
+   interruptible. A starvation guard promotes waiters that have aged
+   past `promote_after_s` so background work always progresses. The
+   supervisor parks the lane on breaker-open (`pause()` — queued
+   waiters defer, new admissions defer, only pause-exempt canary
+   probes pass) and resumes it at re-attach.
+
+2. **`BatchGovernor`** — per-shard arrival-aware batching: an EWMA of
+   op-arrival rate plus the measured per-cycle device time pick the
+   flush cadence and per-cycle batch count dynamically. Past the
+   queue-depth watermark the tick collapses to an immediate full
+   drain; when arrivals are sparse the tick stretches (up to
+   `max_stretch`x — cheap, because broadcasts build from the HOST
+   serve logs and never wait on the device flush); when the lane is
+   congested batch growth is capped at one kernel call per admission
+   so higher-priority work preempts between batches. Idle shards park
+   their timers entirely (the flush timer is enqueue-driven and stops
+   rescheduling at empty queues; the governor counts the parks).
+
+3. **Cross-shard compile sharing** — the jitted step functions are
+   module-level (pallas_kernels*.py), so XLA's compile cache is
+   already process-wide for unsharded planes: N shards warming the
+   same (k, b) grid pay N identical no-op dispatch sweeps for one
+   real compile set. `shared_warm_filter` is the module-level registry
+   of already-warmed (backend, arena, num_docs, capacity, (k, b))
+   keys: the first shard's warm pass compiles, every other shard skips
+   the covered shapes (seeding its CompileTracker so live flushes at
+   those shapes classify as cache hits, which they are) — and the warm
+   grid runs through the lane at the lowest priority, so it can never
+   head-of-line-block an interactive flush at boot.
+
+Invariants and tuning live in docs/guides/tpu-scheduling.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from typing import Optional
+
+from ..observability.flight_recorder import get_flight_recorder
+from ..observability.metrics import Counter, Gauge, Histogram
+
+# -- priority classes --------------------------------------------------------
+# Lower value = higher priority. Interactive flushes preempt everything;
+# catch-up (hydration) outranks compaction/GC; canary probes and warm
+# compiles ride last — a probe's job is to measure the device the real
+# traffic sees, not to displace it.
+
+CLASS_INTERACTIVE = 0
+CLASS_CATCHUP = 1
+CLASS_BACKGROUND = 2
+CLASS_CANARY = 3
+
+CLASS_NAMES = ("interactive", "catchup", "background", "canary")
+
+# lane-wait buckets: sub-millisecond grants are the common case, parked
+# background work can wait whole seconds behind an interactive burst
+_WAIT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+class LaneDeferred(Exception):
+    """Admission declined: the lane is parked (supervisor pause) or the
+    waiter's queue-wait deadline passed. Carries the class + wait so the
+    caller can record a `flush_deferred` flight event and reschedule."""
+
+    def __init__(self, lane_class: int, waited_s: float, reason: str) -> None:
+        super().__init__(f"{CLASS_NAMES[lane_class]} deferred ({reason})")
+        self.lane_class = lane_class
+        self.waited_s = waited_s
+        self.reason = reason
+
+
+class LaneTicket:
+    """One granted (or queued) admission. Always release() in finally."""
+
+    __slots__ = (
+        "lane", "lane_class", "effective_class", "site", "ignore_pause",
+        "enqueued_at", "granted_at", "seq", "future", "promoted", "weight",
+    )
+
+    def __init__(self, lane: "DeviceLane", lane_class: int, site: str,
+                 ignore_pause: bool, seq: int, weight: int = 0) -> None:
+        self.lane = lane
+        self.lane_class = lane_class
+        self.effective_class = lane_class
+        self.site = site
+        self.ignore_pause = ignore_pause
+        self.enqueued_at = time.monotonic()
+        self.granted_at: Optional[float] = None
+        self.seq = seq
+        self.future: Optional[asyncio.Future] = None
+        self.promoted = False
+        # tie-break within a class (lower first): canary probes pass
+        # queued warm-grid shapes so the watchdog's latency signal stays
+        # timely even mid-warmup
+        self.weight = weight
+
+    def should_yield(self) -> bool:
+        """True when strictly-higher-priority work is waiting: a holder
+        running multiple microbatches checks this between batches and
+        releases (preemption at batch granularity)."""
+        return self.lane.has_waiter(below_class=self.lane_class)
+
+    def release(self, preempted: bool = False) -> None:
+        self.lane._release(self, preempted=preempted)
+
+    # context-manager sugar for synchronous client blocks
+    def __enter__(self) -> "LaneTicket":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class DeviceLane:
+    """Priority-class admission arbiter for one device (capacity 1).
+
+    Process-global by default (`get_device_lane()`): every shard of a
+    sharded deployment — and every other device client in the process —
+    contends for the same chip, so they must share one arbiter.
+    Construct instances directly for tests/benches that need isolation.
+    """
+
+    def __init__(self, promote_after_s: float = 0.25) -> None:
+        # a waiter older than this is promoted to the interactive class
+        # (front of the queue): the starvation guard that keeps parked-
+        # looking background work flowing under a sustained burst
+        self.promote_after_s = float(promote_after_s)
+        self.paused = False
+        self._holder: Optional[LaneTicket] = None
+        self._waiters: list[LaneTicket] = []
+        self._seq = 0
+        self._created_at = time.monotonic()
+        self._busy_s = 0.0
+        # accounting (snapshot() + the metric objects below)
+        self.counters: dict[str, int] = {
+            "admissions": 0,
+            "preemptions": 0,
+            "starved_promotions": 0,
+            "deferrals": 0,
+            "dispatches_in_lane": 0,
+            "dispatches_bypass": 0,
+        }
+        self.class_admissions = [0] * len(CLASS_NAMES)
+        self.class_wait_s = [0.0] * len(CLASS_NAMES)
+        self.class_wait_max_s = [0.0] * len(CLASS_NAMES)
+        # exposition objects (adopted by the Metrics registry via
+        # metrics(), like the wire-telemetry collector)
+        self.wait_seconds = Histogram(
+            "hocuspocus_tpu_lane_wait_seconds",
+            "Device-lane queue wait before admission, by priority class",
+            buckets=_WAIT_BUCKETS,
+        )
+        self.admissions_total = Counter(
+            "hocuspocus_tpu_lane_admissions_total",
+            "Device-lane admissions granted, by priority class",
+        )
+        self.preemptions_total = Counter(
+            "hocuspocus_tpu_lane_preemptions_total",
+            "Holders that released between microbatches because "
+            "higher-priority work was waiting",
+        )
+        self.starved_total = Counter(
+            "hocuspocus_tpu_lane_starved_promotions_total",
+            "Aged waiters promoted past the starvation guard",
+        )
+        self.deferrals_total = Counter(
+            "hocuspocus_tpu_lane_deferrals_total",
+            "Admissions deferred (lane parked or deadline passed), by class",
+        )
+        self.queue_depth = Gauge(
+            "hocuspocus_tpu_lane_queue_depth",
+            "Waiters queued for the device lane, by priority class",
+        )
+        self.occupancy = Gauge(
+            "hocuspocus_tpu_lane_occupancy",
+            "Fraction of wall time the device lane was held since start",
+            fn=self._occupancy_fraction,
+        )
+
+    # -- admission -----------------------------------------------------------
+
+    def metrics(self) -> tuple:
+        return (
+            self.wait_seconds, self.admissions_total, self.preemptions_total,
+            self.starved_total, self.deferrals_total, self.queue_depth,
+            self.occupancy,
+        )
+
+    def contended(self) -> bool:
+        return bool(self._waiters)
+
+    def holder_info(self) -> "Optional[tuple[str, int, float]]":
+        """(site, class, held_seconds) of the active holder, None when
+        idle — lets the supervisor's watchdog tell a lane busy with
+        ACCOUNTED warm work apart from one camped on by a wedged flush,
+        and bound how long a single warm hold earns that benefit."""
+        holder = self._holder
+        if holder is None:
+            return None
+        held = (
+            0.0
+            if holder.granted_at is None
+            else time.monotonic() - holder.granted_at
+        )
+        return (holder.site, holder.lane_class, held)
+
+    def has_waiter(self, below_class: int) -> bool:
+        return any(w.effective_class < below_class for w in self._waiters)
+
+    def queue_depths(self) -> "list[int]":
+        depths = [0] * len(CLASS_NAMES)
+        for waiter in self._waiters:
+            depths[waiter.lane_class] += 1
+        return depths
+
+    async def admit(
+        self,
+        lane_class: int,
+        site: str = "",
+        ignore_pause: bool = False,
+        deadline_s: Optional[float] = None,
+        weight: int = 0,
+    ) -> LaneTicket:
+        """Wait for the device lane; returns the held ticket.
+
+        Raises `LaneDeferred` immediately when the lane is parked (and
+        the class is not pause-exempt), or after `deadline_s` of queue
+        wait — the caller records the deferral and reschedules rather
+        than pile blocked tasks onto a paused/wedged device.
+        """
+        if self.paused and not ignore_pause:
+            self._defer(lane_class, 0.0)
+            raise LaneDeferred(lane_class, 0.0, "parked")
+        self._seq += 1
+        ticket = LaneTicket(
+            self, lane_class, site, ignore_pause, self._seq, weight=weight
+        )
+        if self._holder is None and not self._waiters:
+            self._grant(ticket)
+            return ticket
+        ticket.future = asyncio.get_event_loop().create_future()
+        self._waiters.append(ticket)
+        self._refresh_depth_gauge()
+        # the holder may have released between our check and the append
+        # (same-task reentrancy cannot happen, but release() from a
+        # completed executor callback can): re-run the grant scan
+        self._grant_next()
+        try:
+            if deadline_s is None:
+                await ticket.future
+            else:
+                await asyncio.wait_for(asyncio.shield(ticket.future), deadline_s)
+        except asyncio.TimeoutError:
+            waited = time.monotonic() - ticket.enqueued_at
+            if ticket.granted_at is not None:
+                # granted in the same tick the deadline fired: keep it
+                return ticket
+            self._discard(ticket)
+            self._defer(lane_class, waited)
+            raise LaneDeferred(lane_class, waited, "deadline") from None
+        except LaneDeferred:
+            raise
+        except asyncio.CancelledError:
+            if ticket.granted_at is not None:
+                # granted and cancelled in the same tick: hand the lane on
+                self._release(ticket)
+            else:
+                self._discard(ticket)
+            raise
+        return ticket
+
+    def _grant(self, ticket: LaneTicket) -> None:
+        now = time.monotonic()
+        waited = now - ticket.enqueued_at
+        ticket.granted_at = now
+        self._holder = ticket
+        self.counters["admissions"] += 1
+        self.class_admissions[ticket.lane_class] += 1
+        self.class_wait_s[ticket.lane_class] += waited
+        if waited > self.class_wait_max_s[ticket.lane_class]:
+            self.class_wait_max_s[ticket.lane_class] = waited
+        cls = CLASS_NAMES[ticket.lane_class]
+        self.wait_seconds.observe(waited, **{"class": cls})
+        self.admissions_total.inc(**{"class": cls})
+
+    def _release(self, ticket: LaneTicket, preempted: bool = False) -> None:
+        if self._holder is not ticket:
+            return  # already released (idempotent: finally-blocks double up)
+        now = time.monotonic()
+        if ticket.granted_at is not None:
+            self._busy_s += now - ticket.granted_at
+        self._holder = None
+        if preempted:
+            self.counters["preemptions"] += 1
+            self.preemptions_total.inc()
+            get_flight_recorder().record(
+                "__plane__",
+                "lane_preempted",
+                lane_class=CLASS_NAMES[ticket.lane_class],
+                held_ms=round((now - (ticket.granted_at or now)) * 1000.0, 3),
+            )
+        self._grant_next()
+
+    def _grant_next(self) -> None:
+        if self._holder is not None or not self._waiters:
+            return
+        now = time.monotonic()
+        # starvation guard: promote aged waiters before picking
+        for waiter in self._waiters:
+            if (
+                not waiter.promoted
+                and waiter.effective_class > CLASS_INTERACTIVE
+                and now - waiter.enqueued_at > self.promote_after_s
+            ):
+                waiter.promoted = True
+                waiter.effective_class = CLASS_INTERACTIVE
+                self.counters["starved_promotions"] += 1
+                self.starved_total.inc()
+                get_flight_recorder().record(
+                    "__plane__",
+                    "lane_starved_promoted",
+                    lane_class=CLASS_NAMES[waiter.lane_class],
+                    wait_ms=round((now - waiter.enqueued_at) * 1000.0, 3),
+                )
+        eligible = [
+            w for w in self._waiters if not self.paused or w.ignore_pause
+        ]
+        if not eligible:
+            return
+        best = min(eligible, key=lambda w: (w.effective_class, w.weight, w.seq))
+        self._waiters.remove(best)
+        self._refresh_depth_gauge()
+        self._grant(best)
+        if best.future is not None and not best.future.done():
+            best.future.set_result(None)
+
+    def _discard(self, ticket: LaneTicket) -> None:
+        try:
+            self._waiters.remove(ticket)
+        except ValueError:
+            pass
+        self._refresh_depth_gauge()
+
+    def _defer(self, lane_class: int, waited_s: float) -> None:
+        self.counters["deferrals"] += 1
+        self.deferrals_total.inc(**{"class": CLASS_NAMES[lane_class]})
+
+    def _refresh_depth_gauge(self) -> None:
+        depths = self.queue_depths()
+        for i, name in enumerate(CLASS_NAMES):
+            self.queue_depth.set(depths[i], **{"class": name})
+
+    # -- park / drain (supervisor seam) --------------------------------------
+
+    def pause(self) -> None:
+        """Park the lane (breaker open / pause serving): queued waiters
+        that are not pause-exempt defer immediately — their tasks
+        reschedule instead of stacking onto a wedged device — and new
+        admissions defer at the door. The active holder is untouched
+        (its kernel is already launched; it releases on its own)."""
+        if self.paused:
+            return
+        self.paused = True
+        for waiter in list(self._waiters):
+            if waiter.ignore_pause:
+                continue
+            self._waiters.remove(waiter)
+            waited = time.monotonic() - waiter.enqueued_at
+            self._defer(waiter.lane_class, waited)
+            if waiter.future is not None and not waiter.future.done():
+                waiter.future.set_exception(
+                    LaneDeferred(waiter.lane_class, waited, "parked")
+                )
+        self._refresh_depth_gauge()
+
+    def resume(self) -> None:
+        if not self.paused:
+            return
+        self.paused = False
+        self._grant_next()
+
+    # -- dispatch accounting -------------------------------------------------
+
+    def note_dispatch(self, site: str, batches: int = 1) -> None:
+        """Called by the plane at every device dispatch site (flush
+        cycle, warm compile, canary, compact). A dispatch while no
+        ticket is held bypassed the arbiter — counted, and pinned to
+        zero by the scheduler-accounting test for every scheduled
+        pipeline path."""
+        if self._holder is not None:
+            self.counters["dispatches_in_lane"] += batches
+        else:
+            self.counters["dispatches_bypass"] += batches
+
+    def _occupancy_fraction(self) -> float:
+        wall = time.monotonic() - self._created_at
+        busy = self._busy_s
+        if self._holder is not None and self._holder.granted_at is not None:
+            busy += time.monotonic() - self._holder.granted_at
+        return round(busy / wall, 6) if wall > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        """JSON-able state for /debug/scheduler."""
+        depths = self.queue_depths()
+        per_class = {}
+        for i, name in enumerate(CLASS_NAMES):
+            admits = self.class_admissions[i]
+            per_class[name] = {
+                "queued": depths[i],
+                "admissions": admits,
+                "wait_ms_mean": (
+                    round(self.class_wait_s[i] / admits * 1000.0, 3)
+                    if admits
+                    else 0.0
+                ),
+                "wait_ms_max": round(self.class_wait_max_s[i] * 1000.0, 3),
+            }
+        return {
+            "paused": self.paused,
+            "held": self._holder is not None,
+            "holder_class": (
+                None
+                if self._holder is None
+                else CLASS_NAMES[self._holder.lane_class]
+            ),
+            "occupancy": self._occupancy_fraction(),
+            "promote_after_ms": round(self.promote_after_s * 1000.0, 3),
+            "classes": per_class,
+            "counters": dict(self.counters),
+        }
+
+
+_default_lane: Optional[DeviceLane] = None
+
+
+def get_device_lane() -> DeviceLane:
+    """The process-global arbiter (one device per process)."""
+    global _default_lane
+    if _default_lane is None:
+        _default_lane = DeviceLane()
+    return _default_lane
+
+
+def reset_device_lane() -> None:
+    """Drop the global lane (tests): the next get builds a fresh one."""
+    global _default_lane
+    _default_lane = None
+
+
+# -- arrival-aware batching governor -----------------------------------------
+
+
+class BatchGovernor:
+    """Per-shard flush cadence + batch-count policy from measured load.
+
+    Replaces the fixed `flush_interval_ms` timer with three regimes,
+    decided at schedule time from the op-arrival EWMA, the queue depth
+    and the lane's congestion signal:
+
+    - **drain**: queue depth at/past `drain_watermark` — flush NOW
+      (zero delay) and let the cycle run unbounded batches (unless the
+      lane is congested, where one batch per admission keeps the shard
+      preemptible).
+    - **steady**: arrivals fast enough that a base tick collects at
+      least ~one op — keep the configured base cadence.
+    - **sparse**: arrivals slower than one per tick — stretch the tick
+      (up to `max_stretch`x base) so dispatches amortize; free for the
+      edit->observe path because broadcasts build from host serve logs
+      and never wait on the device flush (docs/guides/tpu-merge-
+      pipeline.md).
+
+    The governor never changes WHAT is flushed — only when and in how
+    many kernel calls — so governor-on/off doc state is byte-identical
+    (pinned by the differential fuzz in tests/tpu/test_scheduler.py).
+    """
+
+    def __init__(
+        self,
+        base_interval_ms: float = 5.0,
+        max_stretch: float = 4.0,
+        drain_watermark: int = 256,
+        target_batch_ops: int = 32,
+        halflife_s: float = 0.5,
+    ) -> None:
+        self.base_s = max(base_interval_ms, 0.01) / 1000.0
+        self.max_stretch = max(float(max_stretch), 1.0)
+        self.drain_watermark = max(int(drain_watermark), 1)
+        self.target_batch_ops = max(int(target_batch_ops), 1)
+        self.halflife_s = max(float(halflife_s), 0.01)
+        self._rate = 0.0  # ops/s EWMA
+        self._last_arrival: Optional[float] = None
+        self.device_ms_ewma = 0.0  # per-batch device time
+        self.counters: dict[str, int] = {
+            "drains": 0,
+            "stretches": 0,
+            "steady_ticks": 0,
+            "congested_ticks": 0,
+            "congestion_caps": 0,
+            "parks": 0,
+        }
+        self.last_delay_s = self.base_s
+
+    # -- inputs --------------------------------------------------------------
+
+    def note_arrival(self, ops: int, now: Optional[float] = None) -> None:
+        if ops <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        if self._last_arrival is None:
+            self._rate = float(ops) / self.halflife_s
+        else:
+            dt = max(now - self._last_arrival, 1e-6)
+            inst = float(ops) / dt
+            alpha = 1.0 - math.exp(-dt / self.halflife_s)
+            self._rate += alpha * (inst - self._rate)
+        self._last_arrival = now
+
+    def arrival_rate(self, now: Optional[float] = None) -> float:
+        """Decayed ops/s: silence since the last arrival discounts the
+        EWMA, so a burst that stopped doesn't keep the tick short."""
+        if self._last_arrival is None:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        idle = max(now - self._last_arrival, 0.0)
+        return self._rate * math.exp(-idle / self.halflife_s)
+
+    def note_cycle(self, flush_stats: dict) -> None:
+        """Fold one completed flush cycle's measured device time into
+        the per-batch EWMA (feeds max_batches' burst cap). Empty cycles
+        are skipped — flush_stats only updates when batches ran, so
+        folding it again would just re-count the last real cycle."""
+        batches = int(flush_stats.get("batches", 0))
+        if batches <= 0:
+            return
+        device_ms = (
+            float(flush_stats.get("dispatch_ms", 0.0))
+            + float(flush_stats.get("device_sync_ms", 0.0))
+        ) / batches
+        self.device_ms_ewma += 0.25 * (device_ms - self.device_ms_ewma)
+
+    def note_park(self) -> None:
+        """The shard went idle (empty queues, timer not rescheduled)."""
+        self.counters["parks"] += 1
+
+    # -- policy --------------------------------------------------------------
+
+    def flush_delay_s(self, pending_ops: int, congested: bool = False) -> float:
+        if congested:
+            # congestion outranks the watermark: queued lane clients
+            # (hydration rounds, compaction) are about to drain their
+            # own backlog — an eager interactive tick would only do
+            # their work at interactive priority and deepen the queue
+            # it then waits in
+            self.counters["congested_ticks"] += 1
+            self.last_delay_s = self.base_s
+            return self.base_s
+        if pending_ops >= self.drain_watermark:
+            self.counters["drains"] += 1
+            self.last_delay_s = 0.0
+            return 0.0
+        rate = self.arrival_rate()
+        expected = rate * self.base_s  # ops a base tick would collect
+        if expected >= 1.0:
+            self.counters["steady_ticks"] += 1
+            self.last_delay_s = self.base_s
+            return self.base_s
+        if expected <= 0.0:
+            # first op after idle: full stretch — nothing else is
+            # coming, and the broadcast path doesn't wait on this tick
+            delay = self.base_s * self.max_stretch
+        else:
+            # stretch toward one-op-per-tick, capped at max_stretch
+            delay = min(self.base_s / expected, self.base_s * self.max_stretch)
+        if delay > self.base_s:
+            self.counters["stretches"] += 1
+        else:
+            self.counters["steady_ticks"] += 1
+        self.last_delay_s = delay
+        return delay
+
+    def max_batches(
+        self, pending_ops: int, congested: bool = False
+    ) -> Optional[int]:
+        """Kernel calls the cycle may run under one lane admission.
+
+        Always BOUNDED: past the watermark the cycle takes a burst of
+        batches and reschedules at zero delay — an unbounded inline
+        drain would run the whole background backlog at interactive
+        priority inside one lane hold (the exact head-of-line blocking
+        the arbiter exists to prevent)."""
+        if congested:
+            # one batch per admission: the lane re-arbitrates between
+            # microbatches, so waiting interactive work preempts here
+            self.counters["congestion_caps"] += 1
+            return 1
+        if pending_ops >= self.drain_watermark:
+            return self._burst_cap(8)
+        if pending_ops > self.target_batch_ops * 4:
+            return self._burst_cap(4)
+        return 1
+
+    def _burst_cap(self, ceiling: int) -> int:
+        """Burst size bounded by MEASURED device time: the batches of
+        one admission should fit roughly one base interval of device
+        work, so a slow backend stays preemptible between admissions
+        while a fast one drains in fewer lane round-trips."""
+        if self.device_ms_ewma <= 0.0:
+            return ceiling
+        budget_ms = self.base_s * 1000.0
+        return max(1, min(ceiling, int(budget_ms / self.device_ms_ewma)))
+
+    def snapshot(self) -> dict:
+        return {
+            "base_interval_ms": round(self.base_s * 1000.0, 3),
+            "max_stretch": self.max_stretch,
+            "drain_watermark": self.drain_watermark,
+            "arrival_rate_ops_s": round(self.arrival_rate(), 3),
+            "device_ms_ewma": round(self.device_ms_ewma, 3),
+            "last_delay_ms": round(self.last_delay_s * 1000.0, 3),
+            "counters": dict(self.counters),
+        }
+
+
+# -- cross-shard compile sharing ---------------------------------------------
+# The plane's jitted steps are module-level functions, so XLA's compile
+# cache is process-wide for unsharded planes: identical (arena geometry,
+# batch shape) keys compile exactly once per process. This registry
+# records which keys a warm pass has already covered so shard 2..N skip
+# the redundant no-op dispatch sweeps at boot (mesh-backed planes build
+# per-plane jitted closures and never share).
+
+_warmed_keys: "set[tuple]" = set()
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:
+        return "unknown"
+
+
+def warm_key(arena: str, num_docs: int, capacity: int, shape) -> tuple:
+    return (_backend_name(), arena, num_docs, capacity, tuple(shape))
+
+
+def shared_warm_filter(
+    arena: str, num_docs: int, capacity: int, shapes: "list[tuple]"
+) -> "tuple[list[tuple], list[tuple]]":
+    """Split `shapes` into (to_compile, covered) against the registry.
+    The caller compiles the first list and marks its CompileTracker
+    covered for the second (the process jit cache already holds them)."""
+    to_compile: "list[tuple]" = []
+    covered: "list[tuple]" = []
+    for shape in shapes:
+        key = warm_key(arena, num_docs, capacity, shape)
+        if key in _warmed_keys:
+            covered.append(shape)
+        else:
+            to_compile.append(shape)
+    return to_compile, covered
+
+
+def note_warmed(arena: str, num_docs: int, capacity: int, shape) -> None:
+    _warmed_keys.add(warm_key(arena, num_docs, capacity, shape))
+
+
+def reset_warm_registry() -> None:
+    """Tests: make every plane warm from scratch again."""
+    _warmed_keys.clear()

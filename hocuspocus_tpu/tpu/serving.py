@@ -848,16 +848,36 @@ class PlaneServing:
         if not batch:
             return
         plane = self.plane
-        # the whole drain — flush, refresh, triage, item encode — holds
-        # the flush lock: every step reads device state, and a
-        # concurrent executor-side flush donates the buffers it reads
-        async with plane.flush_lock:
-            tracer = get_tracer()
-            if tracer.enabled:
-                with tracer.span("serving.catchup_drain", batch=len(batch)):
+        # device-lane admission (tpu/scheduler.py): the drain flushes
+        # and runs the triage kernel — interactive class, a joiner is
+        # blocked on the reply. A parked lane (breaker open) resolves
+        # the batch to CPU fallback, exactly like abort_pending.
+        ticket = None
+        if plane.lane is not None:
+            from .scheduler import CLASS_INTERACTIVE, LaneDeferred
+
+            try:
+                ticket = await plane.lane.admit(
+                    CLASS_INTERACTIVE, site="sync"
+                )
+            except LaneDeferred:
+                for *_rest, future in batch:
+                    future.done() or future.set_result(None)
+                return
+        try:
+            # the whole drain — flush, refresh, triage, item encode —
+            # holds the flush lock: every step reads device state, and a
+            # concurrent executor-side flush donates the buffers it reads
+            async with plane.flush_lock:
+                tracer = get_tracer()
+                if tracer.enabled:
+                    with tracer.span("serving.catchup_drain", batch=len(batch)):
+                        await self._drain_catchup_locked(batch)
+                else:
                     await self._drain_catchup_locked(batch)
-            else:
-                await self._drain_catchup_locked(batch)
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     async def _drain_catchup_locked(self, batch: list) -> None:
         import asyncio
@@ -948,6 +968,7 @@ class PlaneServing:
             missing_from, missing_len = state_vector_diff(
                 jnp.asarray(server, jnp.int32), jnp.asarray(client, jnp.int32)
             )
+            plane._note_dispatch("sync")
             missing_from = np.asarray(missing_from)
             missing_len = np.asarray(missing_len)
             for i, (doc, local_sv, target_sv, columns, future) in enumerate(rows):

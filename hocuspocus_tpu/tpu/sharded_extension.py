@@ -28,14 +28,42 @@ from .merge_plane import TpuMergeExtension
 
 
 class ShardedTpuMergeExtension(Extension):
-    """Routes per-document hooks to one of N TpuMergeExtension shards."""
+    """Routes per-document hooks to one of N TpuMergeExtension shards.
+
+    Scheduling (tpu/scheduler.py): all shards share ONE device-lane
+    arbiter — they contend for the same chip, so their flushes,
+    hydration batches and compaction sweeps must be ordered by priority
+    class, not by whichever timer fires first. Each shard's flush and
+    broadcast timers get a deterministic phase offset (i/N of the
+    interval) so N shards stop tick-aligning their dispatches, and the
+    shared warm registry makes shard 2..N skip grid shapes shard 1
+    already compiled (the jitted steps are module-level — one XLA cache
+    per process, not N)."""
 
     priority = 900
 
     def __init__(self, shards: int = 4, **extension_kwargs) -> None:
         if shards < 1:
             raise ValueError("shards must be >= 1")
-        self.shards = [TpuMergeExtension(**extension_kwargs) for _ in range(shards)]
+        lane = extension_kwargs.pop("lane", None)
+        if lane is None:
+            from .scheduler import get_device_lane
+
+            lane = get_device_lane()
+        interval = float(extension_kwargs.get("flush_interval_ms", 5.0))
+        extension_kwargs.pop("phase_offset_ms", None)
+        self.shards = [
+            TpuMergeExtension(
+                lane=lane,
+                phase_offset_ms=(
+                    index * interval / shards if shards > 1 else None
+                ),
+                **extension_kwargs,
+            )
+            for index in range(shards)
+        ]
+        # False disables arbitration in every shard; mirror that here
+        self.lane = self.shards[0].lane
 
     def shard_for(self, document_name: str) -> TpuMergeExtension:
         digest = zlib.crc32(document_name.encode("utf-8"))
@@ -90,6 +118,19 @@ class ShardedTpuMergeExtension(Extension):
             for key, value in shard.plane.counters.items():
                 total[key] = total.get(key, 0) + value
         return total
+
+    def scheduler_snapshot(self) -> dict:
+        """Lane + per-shard governor state for /debug/scheduler."""
+        return {
+            "lane": None if self.lane is None else self.lane.snapshot(),
+            "governors": [
+                None if shard.governor is None else shard.governor.snapshot()
+                for shard in self.shards
+            ],
+            "phase_offsets_ms": [
+                shard.phase_offset_ms for shard in self.shards
+            ],
+        }
 
     def served_docs(self) -> int:
         return sum(len(shard._docs) for shard in self.shards)

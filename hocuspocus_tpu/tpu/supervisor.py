@@ -186,6 +186,7 @@ class PlaneSupervisor:
             "init_failures": 0,
             "canary_probes": 0,
             "canary_failures": 0,
+            "canary_busy_skips": 0,
             "degrades": 0,
             "attaches": 0,
         }
@@ -206,6 +207,10 @@ class PlaneSupervisor:
         self._init_result: Optional[tuple] = None  # (runtime, error)
         self._init_done: Optional[asyncio.Event] = None
         self._canary_future = None
+        # admission state of the outstanding probe: {"granted": bool}.
+        # A probe still QUEUED behind the device lane's warm-grid
+        # holder is a busy lane, not a sick device — see _canary.
+        self._canary_admission: Optional[dict] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -255,6 +260,11 @@ class PlaneSupervisor:
         runtime = self.runtime
         if runtime is None:
             return
+        # never leave a (possibly process-global) lane parked behind:
+        # the next deployment in this process must admit freely
+        lane = getattr(runtime, "lane", None)
+        if lane is not None:
+            lane.resume()
         if self.state == STATE_READY:
             try:
                 await runtime.on_destroy(Payload(instance=self._instance))
@@ -343,6 +353,11 @@ class PlaneSupervisor:
         runtime, instance = self.runtime, self._instance
         if runtime is None:
             return
+        lane = getattr(runtime, "lane", None)
+        if lane is not None:
+            # un-park the device lane BEFORE serving resumes: the first
+            # re-onboard flushes need admissions to flow again
+            lane.resume()
         for serving in runtime.servings():
             serving.paused = False
         self.counters["attaches"] += 1
@@ -380,8 +395,10 @@ class PlaneSupervisor:
                 ok, _latency = await self._canary()
                 if ok:
                     self.breaker.record_success()
-                elif self.breaker.record_failure():
+                elif ok is False and self.breaker.record_failure():
                     self._trip()
+                # ok is None: lane busy with accounted warm work — no
+                # verdict either way, probe again next tick
             elif (
                 self.state == STATE_DEGRADED
                 and self.runtime is not None
@@ -396,22 +413,34 @@ class PlaneSupervisor:
                         "TPU plane recovered; hot re-attaching served documents"
                     )
                     await self._reattach()
-                else:
+                elif ok is False:
                     self.breaker.record_failure()
 
-    async def _canary(self) -> "tuple[bool, Optional[float]]":
+    async def _canary(self) -> "tuple[Optional[bool], Optional[float]]":
         """One deadline-bounded canary merge across every plane.
 
         At most ONE probe thread is outstanding: a wedged probe blocks
         on the device (or the step lock a wedged flush holds), and
         every tick it stays unfinished counts as a deadline overrun
         instead of stacking another blocked thread.
+
+        Verdicts: True = pass, False = failure/overrun, None = no
+        verdict — the probe is still QUEUED behind the device lane's
+        warm-grid holder (tpu/scheduler.py). A lane busy compiling the
+        warm grid is bounded, accounted work, not a sick device;
+        counting those ticks as failures would false-trip the breaker
+        at every boot whose warm pass outlasts two probe windows. A
+        wedged FLUSH holding the lane still fails the tick — only the
+        "warmup" holder site earns the skip.
         """
         runtime = self.runtime
         if runtime is None:
             return False, None
         self.counters["canary_probes"] += 1
         if self._canary_future is not None and not self._canary_future.done():
+            if self._lane_busy_with_warmup():
+                self.counters["canary_busy_skips"] += 1
+                return None, None
             self.counters["canary_failures"] += 1
             return False, None
 
@@ -423,12 +452,34 @@ class PlaneSupervisor:
             # the flush lock for that), and a wedged flush HOLDING the
             # lock forever is precisely a deadline overrun. The device
             # step itself runs off the loop like every other step.
+            # The sweep admits through the device lane at the lowest
+            # class — a probe measures the device the real traffic
+            # sees, it never displaces that traffic — but pause-exempt:
+            # half-open recovery probes must reach a parked lane.
+            ticket = None
+            lane = getattr(runtime, "lane", None)
+            if lane is not None:
+                from .scheduler import CLASS_CANARY
+
+                ticket = await lane.admit(
+                    CLASS_CANARY, site="canary", ignore_pause=True
+                )
+            admission["granted"] = True
+            # the latency clock starts at GRANT: the deadline bounds the
+            # DEVICE's responsiveness, not the queue wait the busy-skip
+            # above already accounts for
             started = time.perf_counter()
-            for plane in runtime.planes():
-                async with plane.flush_lock:
-                    await loop.run_in_executor(None, plane.canary_probe)
+            try:
+                for plane in runtime.planes():
+                    async with plane.flush_lock:
+                        await loop.run_in_executor(None, plane.canary_probe)
+            finally:
+                if ticket is not None:
+                    ticket.release()
             return time.perf_counter() - started
 
+        admission = {"granted": getattr(runtime, "lane", None) is None}
+        self._canary_admission = admission
         future = asyncio.ensure_future(probe_all())
         # consume a late error so an abandoned probe never warns
         future.add_done_callback(
@@ -441,6 +492,12 @@ class PlaneSupervisor:
                 asyncio.shield(future), self.canary_deadline
             )
         except asyncio.TimeoutError:
+            if self._lane_busy_with_warmup():
+                self.counters["canary_busy_skips"] += 1
+                tracer.event(
+                    "supervisor.canary_busy", deadline_s=self.canary_deadline
+                )
+                return None, None
             self.counters["canary_failures"] += 1
             tracer.event(
                 "supervisor.canary_overrun", deadline_s=self.canary_deadline
@@ -457,6 +514,30 @@ class PlaneSupervisor:
             except Exception:
                 pass
         return True, latency
+
+    def _lane_busy_with_warmup(self) -> bool:
+        """True when the outstanding probe is still queued for the
+        device lane AND the lane's active holder is a warm-grid
+        admission that has held for less than the warm-hold budget.
+
+        Bounded on purpose, in both directions: a compile-sized hold is
+        accounted boot work (skipping those ticks stops the breaker
+        false-tripping at every boot whose warm pass outlasts two probe
+        windows), while a warm hold that outlives the budget is
+        indistinguishable from a wedged device and must fail the tick —
+        otherwise a device that wedges DURING warmup never trips, and
+        teardown hangs behind its flush lock."""
+        admission = self._canary_admission
+        if admission is None or admission.get("granted"):
+            return False
+        lane = getattr(self.runtime, "lane", None)
+        if lane is None:
+            return False
+        info = lane.holder_info()
+        if info is None or info[0] != "warmup":
+            return False
+        budget = max(4.0 * self.canary_deadline, 1.0)
+        return info[2] < budget
 
     def _trip(self) -> None:
         """Breaker just opened while serving: drain everything to CPU.
@@ -476,6 +557,13 @@ class PlaneSupervisor:
         for serving in runtime.servings():
             serving.paused = True
             serving.abort_pending()
+        # park the device lane: queued flush/hydration/compaction
+        # admissions defer (their tasks reschedule instead of stacking
+        # onto a wedged device); only pause-exempt canary probes pass,
+        # so half-open recovery can still reach the chip
+        lane = getattr(runtime, "lane", None)
+        if lane is not None:
+            lane.pause()
         try:
             runtime.degrade_all()
         except Exception:

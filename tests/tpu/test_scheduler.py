@@ -1,0 +1,516 @@
+"""Adaptive merge scheduling (tpu/scheduler.py): the device-lane
+arbiter, the arrival-aware batching governor, and their integration
+with the serving extension.
+
+The invariants pinned here:
+- lane grants are strictly priority-ordered (interactive > catch-up >
+  background > canary), FIFO within a class;
+- the starvation guard promotes aged background waiters so a sustained
+  interactive burst can never park them forever;
+- pause() (the supervisor's breaker-open action) defers every queued
+  non-exempt admission and parks the door; resume() restores flow;
+- `should_yield`/release(preempted=True) account batch-granularity
+  preemption;
+- the governor changes WHEN and IN HOW MANY kernel calls queued ops
+  flush — never what flushes: governor-on/off doc state is
+  byte-identical under a fuzzed mixed workload;
+- no device dispatch of the scheduled pipeline (flush, warm grid,
+  hydration, compaction) bypasses the lane (the scheduler-accounting
+  acceptance test);
+- shard 2..N of identically-shaped planes skip warm-grid shapes the
+  first plane already compiled (module-level jit cache).
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.tpu.merge_plane import MergePlane, TpuMergeExtension
+from hocuspocus_tpu.tpu.scheduler import (
+    CLASS_BACKGROUND,
+    CLASS_CANARY,
+    CLASS_CATCHUP,
+    CLASS_INTERACTIVE,
+    BatchGovernor,
+    DeviceLane,
+    LaneDeferred,
+    reset_warm_registry,
+)
+from hocuspocus_tpu.server.types import Payload
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion
+
+
+def _assert(cond, detail=None):
+    assert cond, detail
+
+
+# -- DeviceLane --------------------------------------------------------------
+
+
+async def test_lane_grants_by_priority_then_fifo():
+    lane = DeviceLane()
+    holder = await lane.admit(CLASS_INTERACTIVE, site="t")
+    order = []
+
+    async def wait_for(cls, tag):
+        ticket = await lane.admit(cls, site=tag)
+        order.append(tag)
+        ticket.release()
+
+    tasks = [
+        asyncio.ensure_future(wait_for(CLASS_BACKGROUND, "bg-1")),
+        asyncio.ensure_future(wait_for(CLASS_CANARY, "canary")),
+        asyncio.ensure_future(wait_for(CLASS_CATCHUP, "catchup")),
+        asyncio.ensure_future(wait_for(CLASS_INTERACTIVE, "live-1")),
+        asyncio.ensure_future(wait_for(CLASS_BACKGROUND, "bg-2")),
+        asyncio.ensure_future(wait_for(CLASS_INTERACTIVE, "live-2")),
+    ]
+    await asyncio.sleep(0)  # queue them all
+    assert lane.contended() and lane.has_waiter(below_class=CLASS_CATCHUP)
+    holder.release()
+    await asyncio.gather(*tasks)
+    assert order == ["live-1", "live-2", "catchup", "bg-1", "bg-2", "canary"]
+    assert lane.counters["admissions"] == 7
+    assert not lane.contended()
+
+
+async def test_lane_starvation_guard_promotes_aged_background():
+    lane = DeviceLane(promote_after_s=0.02)
+    holder = await lane.admit(CLASS_INTERACTIVE)
+    order = []
+
+    async def wait_for(cls, tag):
+        ticket = await lane.admit(cls, site=tag)
+        order.append(tag)
+        ticket.release()
+
+    aged = asyncio.ensure_future(wait_for(CLASS_BACKGROUND, "aged-bg"))
+    await asyncio.sleep(0.05)  # the background waiter ages past the guard
+    fresh = asyncio.ensure_future(wait_for(CLASS_INTERACTIVE, "fresh-live"))
+    await asyncio.sleep(0)
+    holder.release()
+    await asyncio.gather(aged, fresh)
+    # promotion lifts the aged waiter to the interactive class with its
+    # ORIGINAL sequence number: it outranks the younger interactive
+    assert order == ["aged-bg", "fresh-live"]
+    assert lane.counters["starved_promotions"] == 1
+    assert lane.starved_total.value() == 1
+
+
+async def test_lane_pause_parks_and_resume_restores():
+    lane = DeviceLane()
+    holder = await lane.admit(CLASS_INTERACTIVE)
+    queued = asyncio.ensure_future(lane.admit(CLASS_CATCHUP, site="queued"))
+    await asyncio.sleep(0)
+    lane.pause()
+    # the queued non-exempt waiter defers instead of stacking on a
+    # wedged device
+    with pytest.raises(LaneDeferred):
+        await queued
+    # the door defers immediately too, for every non-exempt class
+    for cls in (CLASS_INTERACTIVE, CLASS_CATCHUP, CLASS_BACKGROUND):
+        with pytest.raises(LaneDeferred):
+            await lane.admit(cls)
+    assert lane.counters["deferrals"] == 4
+    # pause-exempt canary admission still flows (half-open recovery)
+    holder.release()
+    probe = await lane.admit(CLASS_CANARY, ignore_pause=True)
+    probe.release()
+    lane.resume()
+    ticket = await lane.admit(CLASS_INTERACTIVE)
+    ticket.release()
+    assert lane.counters["admissions"] == 3
+
+
+async def test_lane_deadline_defers_queued_waiter():
+    lane = DeviceLane()
+    holder = await lane.admit(CLASS_INTERACTIVE)
+    started = time.monotonic()
+    with pytest.raises(LaneDeferred) as info:
+        await lane.admit(CLASS_BACKGROUND, deadline_s=0.02)
+    assert info.value.reason == "deadline"
+    assert time.monotonic() - started < 1.0
+    assert not lane.contended(), "deferred waiter must leave the queue"
+    holder.release()
+
+
+async def test_lane_preemption_accounting():
+    lane = DeviceLane()
+    bg = await lane.admit(CLASS_BACKGROUND)
+    assert not bg.should_yield()
+    live = asyncio.ensure_future(lane.admit(CLASS_INTERACTIVE))
+    await asyncio.sleep(0)
+    assert bg.should_yield(), "interactive waiter must signal preemption"
+    bg.release(preempted=True)
+    ticket = await live
+    ticket.release()
+    assert lane.counters["preemptions"] == 1
+    assert lane.preemptions_total.value() == 1
+
+
+async def test_lane_dispatch_accounting_flags_bypass():
+    lane = DeviceLane()
+    lane.note_dispatch("flush")
+    assert lane.counters["dispatches_bypass"] == 1
+    ticket = await lane.admit(CLASS_INTERACTIVE)
+    lane.note_dispatch("flush", batches=3)
+    ticket.release()
+    assert lane.counters["dispatches_in_lane"] == 3
+    assert lane.counters["dispatches_bypass"] == 1
+
+
+# -- BatchGovernor -----------------------------------------------------------
+
+
+def test_governor_drains_immediately_past_watermark():
+    governor = BatchGovernor(base_interval_ms=5.0, drain_watermark=100)
+    assert governor.flush_delay_s(pending_ops=100) == 0.0
+    # burst-bounded, never an unbounded inline drain (head-of-line risk)
+    assert governor.max_batches(pending_ops=100) == 8
+    assert governor.counters["drains"] == 1
+
+
+def test_governor_stretches_sparse_and_keeps_base_under_load():
+    governor = BatchGovernor(
+        base_interval_ms=5.0, max_stretch=4.0, drain_watermark=1000
+    )
+    # no arrivals yet: the first tick takes the full stretch — nothing
+    # else is coming and broadcasts don't wait on this tick
+    assert governor.flush_delay_s(pending_ops=1) == pytest.approx(0.02)
+    assert governor.counters["stretches"] == 1
+    # a sustained burst drives the EWMA past one op per base tick:
+    # cadence returns to base
+    now = time.monotonic()
+    for i in range(50):
+        governor.note_arrival(8, now=now + i * 0.001)
+    assert governor.arrival_rate(now=now + 0.05) > 200.0
+    assert governor.flush_delay_s(pending_ops=1) == pytest.approx(0.005)
+    # silence decays the rate back toward sparse
+    assert governor.arrival_rate(now=now + 30.0) < 1.0
+
+
+def test_governor_congestion_caps_batches_and_cadence():
+    governor = BatchGovernor(base_interval_ms=5.0, drain_watermark=100)
+    assert governor.max_batches(pending_ops=500, congested=True) == 1
+    assert governor.counters["congestion_caps"] == 1
+    # congested ticks never shorten below base even when sparse, and
+    # land in their own regime counter (not steady_ticks)
+    assert governor.flush_delay_s(pending_ops=1, congested=True) == (
+        pytest.approx(0.005)
+    )
+    assert governor.counters["congested_ticks"] == 1
+    assert governor.counters["steady_ticks"] == 0
+
+
+def test_governor_burst_cap_follows_measured_device_time():
+    """Measured per-batch device time bounds the watermark burst: one
+    admission's batches fit ~one base interval of device work."""
+    governor = BatchGovernor(base_interval_ms=5.0, drain_watermark=100)
+    assert governor.max_batches(pending_ops=1000) == 8  # no measurement yet
+    governor.note_cycle({"batches": 1, "dispatch_ms": 0.0, "device_sync_ms": 10.0})
+    assert governor.device_ms_ewma == pytest.approx(2.5)
+    assert governor.max_batches(pending_ops=1000) == 2  # 5ms budget / 2.5ms
+    # empty cycles do not re-fold the stale measurement
+    governor.note_cycle({"batches": 0})
+    assert governor.device_ms_ewma == pytest.approx(2.5)
+    # a very slow backend still dispatches one batch per admission
+    for _ in range(8):
+        governor.note_cycle({"batches": 1, "device_sync_ms": 100.0})
+    assert governor.max_batches(pending_ops=1000) == 1
+
+
+def test_governor_never_changes_what_flushes():
+    """Policy outputs are cadence + batch counts only: feeding wildly
+    different load histories never makes max_batches drop queued work
+    (None = drain all, ints >= 1)."""
+    governor = BatchGovernor(base_interval_ms=5.0, drain_watermark=64)
+    for pending in (0, 1, 63, 64, 100000):
+        for congested in (False, True):
+            batches = governor.max_batches(pending, congested)
+            assert batches is None or batches >= 1
+
+
+# -- cross-plane compile sharing ---------------------------------------------
+
+
+def test_shared_warm_registry_skips_covered_shapes():
+    reset_warm_registry()
+    first = MergePlane(num_docs=8, capacity=128)
+    grid = first.warmup_shapes()
+    assert first.warmup_compiles(shared=True) is True
+    assert first.compile_watch.fresh_compiles == len(grid)
+    # an identically-shaped plane skips every covered shape: no
+    # dispatches, tracker seeded so live flushes classify as the
+    # cache hits they are (module-level jit cache)
+    second = MergePlane(num_docs=8, capacity=128)
+    assert second.warmup_compiles(shared=True) is False
+    assert second.compile_watch.fresh_compiles == 0
+    for k, b in grid:
+        site = "integrate_sparse" if b < second.num_docs else "integrate_dense"
+        assert second.compile_watch.seen(site, (k, b))
+    # a different geometry is NOT covered (different compiled programs)
+    other = MergePlane(num_docs=8, capacity=256)
+    assert other.warmup_compiles(shared=True) is True
+    # direct (unshared) warmups keep their full per-plane behavior
+    third = MergePlane(num_docs=8, capacity=128)
+    third.warmup_compiles()
+    assert third.compile_watch.fresh_compiles == len(grid)
+    reset_warm_registry()
+
+
+# -- extension integration ---------------------------------------------------
+
+
+class _ServedDoc(Doc):
+    """Minimal server-document double for driving the extension's
+    capture/serve seams without a websocket stack."""
+
+    def __init__(self, name: str) -> None:
+        super().__init__()
+        self.name = name
+        self.sync_source = None
+        self.broadcast_source = None
+        self.broadcast_frames: list[bytes] = []
+
+    def get_connections_count(self) -> int:
+        return 1
+
+    def queue_broadcast(self, update: bytes, on_complete=None) -> None:
+        self.broadcast_frames.append(update)
+        if on_complete is not None:
+            on_complete(time.perf_counter())
+
+    def broadcast_update_frame(self, update: bytes) -> None:
+        self.broadcast_frames.append(update)
+
+
+def _scripted_workload(seed: int, docs: int, edits: int):
+    """Deterministic mixed workload: per-doc fixed-client source docs
+    emitting incremental updates (inserts + deletes), interleaved
+    across docs by a seeded schedule. Returns (names, updates) where
+    updates is a list of (name, update_bytes)."""
+    rng = random.Random(seed)
+    sources = {}
+    names = [f"diff-{i}" for i in range(docs)]
+    for i, name in enumerate(names):
+        source = Doc()
+        source.client_id = 1000 + i  # fixed ids => byte-stable updates
+        sources[name] = source
+    updates = []
+    for _ in range(edits):
+        name = names[rng.randrange(docs)]
+        source = sources[name]
+        text = source.get_text("t")
+        before = encode_state_as_update(source)
+        length = len(text.to_string())
+        if length > 8 and rng.random() < 0.3:
+            start = rng.randrange(length - 4)
+            text.delete(start, rng.randrange(1, 4))
+        else:
+            pos = rng.randrange(length + 1)
+            text.insert(pos, rng.choice("abcdef") * rng.randrange(1, 6))
+        # state-vector diff of this one edit
+        from hocuspocus_tpu.crdt import encode_state_vector
+
+        probe = Doc()
+        apply_update(probe, before)
+        updates.append(
+            (name, encode_state_as_update(source, encode_state_vector(probe)))
+        )
+    return names, updates, sources
+
+
+async def _run_workload(extension, names, updates):
+    docs = {}
+    for name in names:
+        doc = _ServedDoc(name)
+        docs[name] = doc
+        await extension.after_load_document(
+            Payload(instance=None, document_name=name, document=doc)
+        )
+    for i, (name, update) in enumerate(updates):
+        doc = docs[name]
+        apply_update(doc, update)
+        captured = extension.try_capture(doc, update, origin=None)
+        assert captured, f"update {i} fell off the plane"
+        if i % 7 == 0:
+            await asyncio.sleep(0.002)  # let timers interleave
+    # drain everything still queued, then close the broadcast tail
+    await extension._flush_now(max_batches=None, final=True)
+    extension._broadcast_served(cross_instance=False)
+    return docs
+
+
+async def test_governor_on_off_state_is_byte_identical():
+    """The differential acceptance test: the governor changes flush
+    cadence and batch counts, never content — the same fuzzed mixed
+    workload produces byte-identical plane-served state with the
+    governor (and lane) on vs off."""
+    names, updates, sources = _scripted_workload(seed=7, docs=3, edits=60)
+    ext_on = TpuMergeExtension(
+        serve=True,
+        num_docs=8,
+        capacity=2048,
+        flush_interval_ms=1,
+        governor=True,
+        lane=DeviceLane(),
+        native_lane=False,
+    )
+    ext_off = TpuMergeExtension(
+        serve=True,
+        num_docs=8,
+        capacity=2048,
+        flush_interval_ms=1,
+        governor=False,
+        lane=False,
+        native_lane=False,
+    )
+    docs_on = await _run_workload(ext_on, names, updates)
+    docs_off = await _run_workload(ext_off, names, updates)
+    for name in names:
+        want = sources[name].get_text("t").to_string()
+        assert ext_on.plane.text(name) == want
+        assert ext_off.plane.text(name) == want
+        served_on = ext_on.serving.encode_state_as_update(name, docs_on[name])
+        served_off = ext_off.serving.encode_state_as_update(
+            name, docs_off[name]
+        )
+        assert served_on is not None and served_on == served_off
+    ext_on.cancel_timers()
+    ext_off.cancel_timers()
+
+
+async def test_no_device_dispatch_bypasses_the_lane():
+    """The scheduler-accounting acceptance test: drive the full serving
+    pipeline — load-time presync flushes, live captures, the warm grid,
+    eviction, hydration — through a live server and assert every device
+    dispatch happened under a lane admission."""
+    lane = DeviceLane()
+    ext = TpuMergeExtension(
+        serve=True,
+        num_docs=8,
+        capacity=1024,
+        flush_interval_ms=1,
+        lane=lane,
+        evict_idle_secs=0.2,
+        hydrate_batch=4,
+    )
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="lane-doc")
+    b = new_provider(server, name="lane-doc")
+    try:
+        from tests.utils import wait_synced
+
+        await wait_synced(a, b)
+        a.document.get_text("t").insert(0, "through the lane;")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "through the lane;"
+            )
+        )
+        # idle out the doc so the residency sweep evicts it, then edit
+        # again: the hydration queue re-admits it through the lane
+        await retryable_assertion(
+            lambda: _assert(ext.plane.counters["docs_evicted"] >= 1),
+            timeout=15,
+        )
+        a.document.get_text("t").insert(0, "rehydrate;")
+        await retryable_assertion(
+            lambda: _assert(ext.plane.counters["docs_hydrated"] >= 1),
+            timeout=15,
+        )
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string()
+                == "rehydrate;through the lane;"
+            )
+        )
+        # warm grid + presync flushes + live flushes + hydration drain
+        # all dispatched — and every one under an admission
+        await retryable_assertion(
+            lambda: _assert(lane.counters["dispatches_in_lane"] > 0)
+        )
+        assert lane.counters["dispatches_bypass"] == 0, lane.snapshot()
+        assert lane.class_admissions[CLASS_INTERACTIVE] > 0
+        assert lane.class_admissions[CLASS_CATCHUP] > 0, "hydration rode the lane"
+        assert lane.class_admissions[CLASS_CANARY] > 0, "warm grid rode the lane"
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+    assert lane.counters["dispatches_bypass"] == 0
+
+
+async def test_debug_scheduler_endpoint_and_lane_metrics():
+    """`GET /debug/scheduler` serves the lane + governor state, and the
+    lane's telemetry families render on /metrics."""
+    import json
+
+    import aiohttp
+
+    from hocuspocus_tpu.observability import Metrics
+
+    lane = DeviceLane()
+    ext = TpuMergeExtension(
+        serve=True, num_docs=8, capacity=512, flush_interval_ms=1, lane=lane
+    )
+    server = await new_hocuspocus(extensions=[Metrics(), ext])
+    a = new_provider(server, name="sched-debug-doc")
+    try:
+        from tests.utils import wait_synced
+
+        await wait_synced(a)
+        a.document.get_text("t").insert(0, "observed")
+        await retryable_assertion(
+            lambda: _assert(lane.counters["admissions"] > 0)
+        )
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/debug/scheduler") as response:
+                assert response.status == 200
+                body = json.loads(await response.text())
+            async with session.get(f"{server.http_url}/metrics") as response:
+                metrics_text = await response.text()
+        assert body["lane"]["paused"] is False
+        assert body["lane"]["classes"]["interactive"]["admissions"] > 0
+        assert body["governors"][0]["drain_watermark"] == 256
+        assert body["phase_offsets_ms"] == [None]
+        assert "hocuspocus_tpu_lane_wait_seconds_bucket" in metrics_text
+        assert "hocuspocus_tpu_lane_admissions_total" in metrics_text
+        assert "hocuspocus_tpu_lane_occupancy" in metrics_text
+    finally:
+        a.destroy()
+        await server.destroy()
+
+
+async def test_sharded_router_staggers_phases_and_shares_one_lane():
+    from hocuspocus_tpu.tpu.sharded_extension import ShardedTpuMergeExtension
+
+    lane = DeviceLane()
+    ext = ShardedTpuMergeExtension(
+        shards=4, num_docs=8, capacity=256, flush_interval_ms=8.0, lane=lane
+    )
+    offsets = [shard.phase_offset_ms for shard in ext.shards]
+    assert offsets == [0.0, 2.0, 4.0, 6.0]
+    assert all(shard.lane is lane for shard in ext.shards)
+    assert ext.lane is lane
+    snapshot = ext.scheduler_snapshot()
+    assert snapshot["lane"]["paused"] is False
+    assert len(snapshot["governors"]) == 4
+    for shard in ext.shards:
+        shard.cancel_timers()
+
+
+async def test_phase_alignment_never_fires_early():
+    ext = TpuMergeExtension(
+        num_docs=8, capacity=256, flush_interval_ms=10.0,
+        phase_offset_ms=5.0, governor=False, lane=False,
+    )
+    interval = 0.010
+    for delay in (0.0, 0.004, 0.010):
+        aligned = ext._align_to_phase(delay, interval)
+        assert aligned >= delay
+        assert aligned <= delay + interval + 1e-9
+    ext.cancel_timers()

@@ -394,6 +394,101 @@ async def test_flapping_wedge_recover_wedge_is_accounted():
         await server.destroy()
 
 
+async def test_breaker_open_parks_lane_classes_and_resume_restores():
+    """Scheduler-vs-supervisor interaction (tpu/scheduler.py): tripping
+    the breaker must PARK the device lane — every queued or new
+    flush/hydration/compaction admission defers instead of stacking
+    blocked tasks onto the wedged device, while pause-exempt canary
+    probes still pass (half-open recovery needs the chip). Recovery
+    resumes the lane and admissions flow again."""
+    from hocuspocus_tpu.tpu.scheduler import (
+        CLASS_BACKGROUND,
+        CLASS_CANARY,
+        CLASS_CATCHUP,
+        CLASS_INTERACTIVE,
+        DeviceLane,
+        LaneDeferred,
+    )
+
+    lane = DeviceLane()
+    ext = _fast_ext(lane=lane)
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="lane-park-doc")
+    b = new_provider(server, name="lane-park-doc")
+    try:
+        await wait_synced(a, b)
+        await retryable_assertion(
+            lambda: _assert(
+                ext.supervisor.state == STATE_READY
+                and ext.runtime.is_served("lane-park-doc")
+            )
+        )
+        a.document.get_text("t").insert(0, "pre;")
+        await retryable_assertion(
+            lambda: _assert(b.document.get_text("t").to_string() == "pre;")
+        )
+        wedge = _WedgeableStep(ext.plane)
+        wedge.wedge()
+        a.document.get_text("t").insert(0, "mid;")
+        await retryable_assertion(
+            lambda: _assert(ext.supervisor.state == STATE_DEGRADED),
+            timeout=15,
+        )
+        # the trip parked the lane: every non-exempt class defers at the
+        # door — flush timers, hydration rounds and compaction sweeps
+        # all reschedule instead of queueing against the wedge
+        assert lane.paused, "breaker-open must park the device lane"
+        deferrals_before = lane.counters["deferrals"]
+        for cls in (CLASS_INTERACTIVE, CLASS_CATCHUP, CLASS_BACKGROUND):
+            try:
+                ticket = await lane.admit(cls, site="test")
+            except LaneDeferred:
+                continue
+            ticket.release()
+            raise AssertionError(f"class {cls} admitted through a parked lane")
+        assert lane.counters["deferrals"] >= deferrals_before + 3
+        # deferred flushes surface in the plane's flight recorder so
+        # /debug/docs explains scheduling-induced latency
+        from hocuspocus_tpu.observability.flight_recorder import (
+            get_flight_recorder,
+        )
+
+        b.document.get_text("t").insert(0, "cpu;")  # CPU path keeps flowing
+        await retryable_assertion(
+            lambda: _assert(a.document.get_text("t").to_string() == "cpu;mid;pre;")
+        )
+        # recovery: the wedge clears, the half-open canary passes
+        # (pause-exempt admission), the lane resumes with serving
+        wedge.recover()
+        await retryable_assertion(
+            lambda: _assert(
+                ext.supervisor.state == STATE_READY and not lane.paused,
+                ext.supervisor.snapshot(),
+            ),
+            timeout=20,
+        )
+        assert lane.class_admissions[CLASS_CANARY] > 0, "canary rode the lane"
+        ticket = await lane.admit(CLASS_INTERACTIVE, site="test")
+        ticket.release()
+        a.document.get_text("t").insert(0, "back;")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "back;cpu;mid;pre;"
+            )
+        )
+        # __plane__ carries the park's paper trail for operators
+        events = [
+            e["event"] for e in get_flight_recorder().events("__plane__")
+        ]
+        assert "supervisor.transition" in events
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+    # teardown must never leave a (possibly process-global) lane parked
+    assert not lane.paused
+
+
 async def test_abort_pending_resolves_stranded_sync_waiters():
     """A batched sync waiter stranded behind a wedged flush must not
     stall its client: abort_pending resolves it to None (CPU fallback)

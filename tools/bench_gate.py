@@ -5,8 +5,11 @@ Compares the newest two `BENCH_*.json` artifacts (or two explicit
 files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms`,
 `extra.wire_load.ingress.p99_ms`,
 `extra.fanout_storm.merge_to_last_write_p99_ms`,
-`extra.replica_storm.merge_to_remote_broadcast_p99_ms`, and the
-durability plane's `extra.wal_load.append_p99_ms` +
+`extra.replica_storm.merge_to_remote_broadcast_p99_ms`, the adaptive
+scheduler's `extra.mixed_load.governor_on.interactive_p99_ms`
+(interactive merge→broadcast under concurrent hydration+compaction
+with the lane arbiter + governor on), and the durability plane's
+`extra.wal_load.append_p99_ms` +
 `extra.wal_load.wal_on.merge_to_last_write_p99_ms` — and exits nonzero
 when any stage regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
 (latency on shared CPU runners is noisy; the gate is for on-chip
@@ -99,6 +102,13 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
         p99 = replica.get("merge_to_remote_broadcast_p99_ms")
         if isinstance(p99, (int, float)) and not isinstance(p99, bool):
             stages["replica_storm.merge_to_remote_broadcast"] = float(p99)
+    mixed = extra.get("mixed_load")
+    if isinstance(mixed, dict):
+        governor_on = mixed.get("governor_on")
+        if isinstance(governor_on, dict):
+            p99 = governor_on.get("interactive_p99_ms")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["mixed_load.interactive_p99"] = float(p99)
     wal = extra.get("wal_load")
     if isinstance(wal, dict):
         append_p99 = wal.get("append_p99_ms")
