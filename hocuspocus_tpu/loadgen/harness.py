@@ -23,11 +23,11 @@ from typing import Any, Optional
 
 import numpy as np
 
-from .aio import await_synced
-from .provider import HocuspocusProvider
-from .provider.inprocess import InProcessProviderSocket
-from .server import Configuration, Server
-from .tpu import ShardedTpuMergeExtension, TpuMergeExtension
+from ..aio import await_synced
+from ..provider import HocuspocusProvider
+from ..provider.inprocess import InProcessProviderSocket
+from ..server import Configuration, Server
+from ..tpu import ShardedTpuMergeExtension, TpuMergeExtension
 
 
 class ServedLoadHarness:
@@ -43,6 +43,9 @@ class ServedLoadHarness:
     - shards / shard_rows / capacity / flush_interval_ms: plane layout
       per instance (rows must exceed num_docs/shards + hash skew).
     - docs_per_socket: provider multiplexing width per in-process socket.
+    - seed: RNG seed behind every random choice the harness makes
+      (timed-edit sizes, background payload widths); recorded in the
+      result dict so any run is reproducible from its artifact.
     """
 
     def __init__(
@@ -59,6 +62,7 @@ class ServedLoadHarness:
         sync_timeout: float = 600.0,
         background_fraction: int = 16,
         with_metrics: bool = False,
+        seed: int = 0,
         progress=None,
     ) -> None:
         self.num_docs = num_docs
@@ -78,6 +82,16 @@ class ServedLoadHarness:
         # ingress-stage quantiles off metrics[0] after the run
         self.with_metrics = with_metrics
         self.metrics: list[Any] = []
+        # seed: every random choice the harness makes (timed edit sizes,
+        # background payload widths) draws from a seeded generator, and
+        # the seed is stamped into the result dict — any bench or
+        # scenario run is reproducible from its artifact alone. The
+        # timed path and the concurrent background task get INDEPENDENT
+        # streams: sharing one would interleave draws by event-loop
+        # timing, making the recorded seed non-reproducing.
+        self.seed = int(seed)
+        self.rng = np.random.default_rng([self.seed, 0])
+        self._bg_rng = np.random.default_rng([self.seed, 1])
         self._progress = progress or (lambda msg: None)
 
         self.servers: list[Server] = []
@@ -87,6 +101,13 @@ class ServedLoadHarness:
         self.readers: list[HocuspocusProvider] = []
         self._mini_redis = None
         self._bg_len: list[int] = []
+
+    @property
+    def mini_redis(self):
+        """The in-process MiniRedis backing a multi-instance run (None
+        single-instance or against a real REDIS_HOST) — the scenario
+        runner's replication-lag injection point."""
+        return self._mini_redis
 
     # -- topology ----------------------------------------------------------
 
@@ -99,7 +120,7 @@ class ServedLoadHarness:
             if host:
                 redis_cfg = (host, int(os.environ.get("REDIS_PORT", 6379)))
             else:
-                from .net.mini_redis import MiniRedis
+                from ..net.mini_redis import MiniRedis
 
                 self._mini_redis = await MiniRedis().start()
                 redis_cfg = ("127.0.0.1", self._mini_redis.port)
@@ -123,7 +144,7 @@ class ServedLoadHarness:
                 planes = [ext.plane]
             extensions: list[Any] = []
             if redis_cfg is not None:
-                from .extensions import Redis
+                from ..extensions import Redis
 
                 extensions.append(
                     Redis(
@@ -134,7 +155,7 @@ class ServedLoadHarness:
                     )
                 )
             if self.with_metrics:
-                from .observability import Metrics
+                from ..observability import Metrics
 
                 metrics = Metrics()
                 self.metrics.append(metrics)
@@ -187,28 +208,41 @@ class ServedLoadHarness:
 
     # -- measurement -------------------------------------------------------
 
-    async def _one_edit(self, i: int) -> float:
-        """Writer inserts; returns seconds until the reader's doc shows
-        the grown text. Event-driven: woken by reader doc updates."""
-        d = i % self.sampled
-        wtext = self.writers[d].document.get_text("body")
-        rdoc = self.readers[d].document
+    async def timed_edit(
+        self,
+        doc: int,
+        size: int,
+        timeout_s: float = 30.0,
+        raise_on_timeout: bool = True,
+    ) -> "Optional[float]":
+        """Writer inserts `size` units into sampled doc `doc`; returns
+        seconds until the reader's doc shows the grown text (None on
+        timeout when not raising). Event-driven: woken by reader doc
+        updates. Shared by the bench edit loop and the scenario runner —
+        the straggler-safe measurement logic must exist exactly once.
+
+        The target is the WRITER's post-insert length: after a swallowed
+        straggler, a reader-relative target (+size over current reader
+        length) would be satisfied by the straggler's late bytes and
+        record a bogus ~0 latency; the writer high-water mark requires
+        THIS edit to have landed."""
+        wtext = self.writers[doc].document.get_text("body")
+        rdoc = self.readers[doc].document
         rtext = rdoc.get_text("body")
-        # target = WRITER's post-insert length: after a swallowed
-        # straggler, a reader-relative target (+16 over current reader
-        # length) would be satisfied by the straggler's late bytes and
-        # record a bogus ~0 latency; the writer high-water mark requires
-        # THIS edit to have landed
-        expected = len(wtext) + 16
+        expected = len(wtext) + size
         wake = asyncio.Event()
         handler = lambda *args: wake.set()  # noqa: E731
         rdoc.on("update", handler)
         try:
             t0 = time.perf_counter()
-            wtext.insert(len(wtext), "x" * 16)
+            wtext.insert(len(wtext), "x" * size)
             while len(rtext) < expected:
-                if time.perf_counter() - t0 > 30:
-                    raise TimeoutError(f"edit {i} never observed by reader")
+                if time.perf_counter() - t0 > timeout_s:
+                    if raise_on_timeout:
+                        raise TimeoutError(
+                            f"edit on doc {doc} never observed by reader"
+                        )
+                    return None
                 wake.clear()
                 try:
                     await asyncio.wait_for(wake.wait(), timeout=0.25)
@@ -218,6 +252,12 @@ class ServedLoadHarness:
         finally:
             rdoc.off("update", handler)
 
+    async def _one_edit(self, i: int) -> float:
+        """One bench-loop edit: rng-sized insert on the i-th sampled doc."""
+        return await self.timed_edit(
+            i % self.sampled, int(self.rng.integers(8, 25))
+        )
+
     async def _background_load(self, stop: asyncio.Event) -> None:
         """Steady inserts across ~1/background_fraction of the
         non-sampled population per tick, so flushes run at real batch
@@ -226,10 +266,11 @@ class ServedLoadHarness:
         n = self.background_fraction
         while not stop.is_set():
             for d in range(self.sampled + tick % n, self.num_docs, n):
+                width = int(self._bg_rng.integers(4, 13))
                 self.writers[d].document.get_text("body").insert(
-                    self._bg_len[d], "y" * 8
+                    self._bg_len[d], "y" * width
                 )
-                self._bg_len[d] += 8
+                self._bg_len[d] += width
                 await asyncio.sleep(0)
                 if stop.is_set():
                     return
@@ -285,6 +326,7 @@ class ServedLoadHarness:
                 "unit": "ms",
                 "extra": {
                     "docs": self.num_docs,
+                    "seed": self.seed,
                     "instances": self.instances,
                     "cross_instance": self.instances > 1,
                     "shards": self.shards,

@@ -1,0 +1,376 @@
+"""Built-in scenario library: the production mixes the paper promises.
+
+Each factory returns a :class:`~.scenario.Scenario` sized by keyword
+arguments (defaults are CI-scale; pass bigger numbers for real storms).
+``get_scenario(name, **overrides)`` resolves by registry name — the
+``python -m hocuspocus_tpu.loadgen`` CLI, bench.py's scenario-suite
+pass and ``tools/bench_capture.py`` all go through it.
+
+The mixes (ROADMAP item 5, Collabs arXiv:2212.02618 composed multi-user
+workloads, Eg-walker arXiv:2409.14252 realistic-concurrency merges):
+
+- ``smoke``            — tiny three-phase mix for tier-1 CI
+- ``diurnal``          — trough → ramp → peak → ramp-down edit rates
+- ``flash_crowd``      — a join storm lands on one hot doc mid-run
+- ``reconnect_herd``   — flaky-mobile clients drop and resync in herds
+- ``mega_doc``         — one outsized doc among thousands of small ones
+- ``replication_lag``  — cross-instance lag injected into mini_redis
+- ``storm``            — flash crowd + reconnect herd composed (slow)
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+from .scenario import OpEvent, PhaseSpec, Scenario
+
+
+def _spread(rng: random.Random, count: int, duration_ms: int) -> "list[int]":
+    """`count` op times spread over the phase with seeded jitter."""
+    if count <= 0:
+        return []
+    step = duration_ms / count
+    return sorted(
+        min(int(i * step + rng.random() * step), duration_ms - 1)
+        for i in range(count)
+    )
+
+
+def _edit_gen(
+    rate_per_s: float,
+    size_lo: int = 8,
+    size_hi: int = 24,
+    mega_every: int = 0,
+    mega_lo: int = 192,
+    mega_hi: int = 384,
+) -> Callable:
+    """Steady random-doc edit traffic at `rate_per_s` (logical time).
+
+    With ``mega_every`` = N, every Nth op targets doc 0 with a
+    mega-sized insert — the one-big-doc-among-thousands mix."""
+
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        count = max(int(rate_per_s * phase.duration_ms / 1000), 1)
+        ops = []
+        for i, at in enumerate(_spread(rng, count, phase.duration_ms)):
+            if mega_every and i % mega_every == 0:
+                doc, size = 0, rng.randrange(mega_lo, mega_hi)
+            else:
+                doc = rng.randrange(scenario.num_docs)
+                size = rng.randrange(size_lo, size_hi)
+            ops.append(OpEvent(at, phase.name, "edit", doc=doc, size=size))
+        return ops
+
+    return gen
+
+
+def _compose(*gens: Callable) -> Callable:
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        ops = []
+        for sub in gens:
+            ops.extend(sub(rng, scenario, phase))
+        return ops
+
+    return gen
+
+
+def _join_storm_gen(joins: int, doc: int = 0, window_frac: float = 0.5) -> Callable:
+    """`joins` new clients pile onto one hot doc inside the first
+    `window_frac` of the phase — the flash-crowd front edge."""
+
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        window = max(int(phase.duration_ms * window_frac), 1)
+        return [
+            OpEvent(at, phase.name, "join", doc=doc, value=i)
+            for i, at in enumerate(_spread(rng, joins, window))
+        ]
+
+    return gen
+
+
+def _leave_gen(leaves: int, doc: int = 0) -> Callable:
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        return [
+            OpEvent(at, phase.name, "leave", doc=doc)
+            for at in _spread(rng, leaves, phase.duration_ms)
+        ]
+
+    return gen
+
+
+def _reconnect_gen(reconnects: int) -> Callable:
+    """Flaky-mobile herd: measured docs drop and resync repeatedly."""
+
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        return [
+            OpEvent(
+                at,
+                phase.name,
+                "reconnect",
+                doc=rng.randrange(max(scenario.sampled, 1)),
+            )
+            for at in _spread(rng, reconnects, phase.duration_ms)
+        ]
+
+    return gen
+
+
+def _lag_gen(lag_ms: int, at_ms: int = 0) -> Callable:
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        return [OpEvent(at_ms, phase.name, "lag", value=lag_ms)]
+
+    return gen
+
+
+# -- the library -------------------------------------------------------------
+
+
+def smoke(
+    num_docs: int = 6,
+    phase_ms: int = 800,
+    rate: float = 20.0,
+) -> Scenario:
+    """Tier-1 CI mix: edits, one tiny join wave, a leave — seconds on CPU."""
+    return Scenario(
+        name="smoke",
+        description="tiny three-phase mix proving the harness end to end",
+        num_docs=num_docs,
+        sampled=min(4, num_docs),
+        shards=1,
+        capacity=512,
+        shard_rows=max(num_docs * 2, 16),
+        docs_per_socket=num_docs,
+        phases=[
+            PhaseSpec("warm", phase_ms, _edit_gen(rate), slo_e2e_ms=1000.0),
+            PhaseSpec(
+                "burst",
+                phase_ms,
+                _compose(_edit_gen(rate * 2), _join_storm_gen(2)),
+                slo_e2e_ms=1000.0,
+            ),
+            PhaseSpec(
+                "cool",
+                phase_ms,
+                _compose(_edit_gen(rate / 2), _leave_gen(2)),
+                slo_e2e_ms=1000.0,
+            ),
+        ],
+    )
+
+
+def diurnal(
+    num_docs: int = 48,
+    phase_ms: int = 2000,
+    peak_rate: float = 120.0,
+) -> Scenario:
+    """A day of traffic compressed into four phases: trough, morning
+    ramp, peak, evening ramp-down. The peak phase carries the tight
+    SLO; the trough proves the idle floor doesn't rot."""
+    return Scenario(
+        name="diurnal",
+        description="diurnal ramp: trough -> ramp -> peak -> ramp-down",
+        num_docs=num_docs,
+        sampled=min(12, num_docs),
+        shards=2,
+        capacity=768,
+        phases=[
+            PhaseSpec("trough", phase_ms, _edit_gen(peak_rate / 8)),
+            PhaseSpec("ramp_up", phase_ms, _edit_gen(peak_rate / 2)),
+            PhaseSpec("peak", phase_ms, _edit_gen(peak_rate), slo_e2e_ms=500.0),
+            PhaseSpec("ramp_down", phase_ms, _edit_gen(peak_rate / 4)),
+        ],
+    )
+
+
+def flash_crowd(
+    num_docs: int = 32,
+    joins: int = 24,
+    phase_ms: int = 2000,
+) -> Scenario:
+    """A hot doc goes viral: a join storm lands mid-run while steady
+    edits continue everywhere (PR 7's join-storm sync cache under a
+    composed mix, not an isolated pass)."""
+    return Scenario(
+        name="flash_crowd",
+        description="flash-crowd join storm on one hot doc",
+        num_docs=num_docs,
+        sampled=min(8, num_docs),
+        shards=2,
+        capacity=768,
+        params={"joins": joins},
+        phases=[
+            PhaseSpec("steady", phase_ms, _edit_gen(40.0)),
+            PhaseSpec(
+                "storm",
+                phase_ms,
+                _compose(_edit_gen(40.0), _join_storm_gen(joins)),
+                slo_e2e_ms=1000.0,
+                slo_objective=0.90,
+            ),
+            PhaseSpec(
+                "drain",
+                phase_ms,
+                _compose(_edit_gen(20.0), _leave_gen(joins)),
+            ),
+        ],
+    )
+
+
+def reconnect_herd(
+    num_docs: int = 32,
+    reconnects: int = 16,
+    phase_ms: int = 2000,
+) -> Scenario:
+    """Flaky-mobile herd: a subway tunnel's worth of clients drop and
+    resync while edits continue — catch-up tiering and SyncStep2 under
+    churn, measured as resync latency."""
+    return Scenario(
+        name="reconnect_herd",
+        description="flaky-mobile reconnect herd over steady edits",
+        num_docs=num_docs,
+        sampled=min(8, num_docs),
+        shards=2,
+        capacity=768,
+        params={"reconnects": reconnects},
+        phases=[
+            PhaseSpec("steady", phase_ms, _edit_gen(40.0)),
+            PhaseSpec(
+                "herd",
+                phase_ms,
+                _compose(_edit_gen(40.0), _reconnect_gen(reconnects)),
+                slo_e2e_ms=2000.0,
+                slo_objective=0.90,
+            ),
+            PhaseSpec("recovered", phase_ms, _edit_gen(40.0)),
+        ],
+    )
+
+
+def mega_doc(
+    num_docs: int = 64,
+    phase_ms: int = 2000,
+) -> Scenario:
+    """One mega-document among a small-doc population: every 4th op is
+    an outsized insert into doc 0. The merge plane must keep the small
+    docs' latency flat while the mega doc's row grows."""
+    return Scenario(
+        name="mega_doc",
+        description="one mega-doc among a population of small docs",
+        num_docs=num_docs,
+        sampled=min(8, num_docs),
+        shards=2,
+        capacity=4096,
+        mega_doc=True,
+        phases=[
+            PhaseSpec("steady", phase_ms, _edit_gen(40.0, mega_every=8)),
+            PhaseSpec(
+                "mega_burst",
+                phase_ms,
+                _edit_gen(60.0, mega_every=4),
+                slo_e2e_ms=1000.0,
+            ),
+            PhaseSpec("settle", phase_ms, _edit_gen(30.0, mega_every=8)),
+        ],
+    )
+
+
+def replication_lag(
+    num_docs: int = 16,
+    phase_ms: int = 1500,
+    lag_ms: int = 40,
+) -> Scenario:
+    """Cross-instance mix: writers on instance A, readers on instance B
+    through mini_redis; the middle phase injects publish latency, so the
+    lagged phase's SLO must absorb exactly the injected delay — and the
+    recovered phase must return to the healthy budget."""
+    return Scenario(
+        name="replication_lag",
+        description="cross-instance replication lag via mini_redis injection",
+        num_docs=num_docs,
+        sampled=min(6, num_docs),
+        instances=2,
+        shards=1,
+        capacity=512,
+        docs_per_socket=num_docs,
+        params={"lag_ms": lag_ms},
+        phases=[
+            PhaseSpec("healthy", phase_ms, _edit_gen(24.0), slo_e2e_ms=1000.0),
+            PhaseSpec(
+                "lagged",
+                phase_ms,
+                _compose(_lag_gen(lag_ms), _edit_gen(24.0)),
+                slo_e2e_ms=2000.0,
+                slo_objective=0.90,
+            ),
+            PhaseSpec(
+                "recovered",
+                phase_ms,
+                _compose(_lag_gen(0), _edit_gen(24.0)),
+                slo_e2e_ms=1000.0,
+            ),
+        ],
+    )
+
+
+def storm(
+    num_docs: int = 64,
+    joins: int = 48,
+    reconnects: int = 32,
+    phase_ms: int = 3000,
+) -> Scenario:
+    """The composed worst hour: flash crowd AND reconnect herd over a
+    peak edit rate — the slow-marked stress scenario."""
+    return Scenario(
+        name="storm",
+        description="composed flash crowd + reconnect herd at peak rate",
+        num_docs=num_docs,
+        sampled=min(12, num_docs),
+        shards=4,
+        capacity=768,
+        params={"joins": joins, "reconnects": reconnects},
+        phases=[
+            PhaseSpec("build_up", phase_ms, _edit_gen(60.0)),
+            PhaseSpec(
+                "landfall",
+                phase_ms,
+                _compose(
+                    _edit_gen(80.0),
+                    _join_storm_gen(joins),
+                    _reconnect_gen(reconnects),
+                ),
+                slo_e2e_ms=2000.0,
+                slo_objective=0.85,
+            ),
+            PhaseSpec(
+                "aftermath",
+                phase_ms,
+                _compose(_edit_gen(40.0), _leave_gen(joins)),
+            ),
+        ],
+    )
+
+
+SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
+    "smoke": smoke,
+    "diurnal": diurnal,
+    "flash_crowd": flash_crowd,
+    "reconnect_herd": reconnect_herd,
+    "mega_doc": mega_doc,
+    "replication_lag": replication_lag,
+    "storm": storm,
+}
+
+# the default suite bench.py / bench_capture run: fast enough for every
+# round, covers the single-instance AND cross-instance paths
+BENCH_SUITE = ("smoke", "replication_lag")
+
+
+def get_scenario(name: str, **overrides) -> Scenario:
+    try:
+        factory = SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; known: {', '.join(sorted(SCENARIOS))}"
+        ) from None
+    return factory(**overrides)

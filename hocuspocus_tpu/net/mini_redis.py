@@ -70,6 +70,15 @@ class MiniRedis:
         # unrelated keepalive can't eat the injected fault)
         self.drop_publishes = 0
         self.drop_channel: Optional[bytes] = None
+        # latency injection (scenario harness): every delivered publish
+        # is delayed by this many ms before it reaches the subscriber's
+        # queue — models cross-region replication lag. FIFO order is
+        # preserved even when the latency is LOWERED mid-run: new
+        # deliveries floor their deadline to the latest already
+        # scheduled one (`_deliver_floor`), so a fast frame can never
+        # overtake a slow one still in flight
+        self.publish_latency_ms = 0
+        self._deliver_floor = 0.0
         # keys mid-migration (ASK emulation): a keyed command on such a
         # key answers -ASK <slot> target; the target executes it only
         # on an ASKING-flagged connection, like a real resharding window
@@ -89,29 +98,58 @@ class MiniRedis:
         return None
 
     def _deliver(self, channel: bytes, payload: bytes) -> int:
+        """Returns the receiver count for the PUBLISH reply; the
+        `delivered` counter is incremented at ACTUAL enqueue time (in
+        `_enqueue`), so a delayed frame that later hits a full queue or
+        a departed subscriber never double-counts against the drop
+        counters."""
         receivers = self.subscribers.get(channel, set())
         message = _array([_bulk(b"message"), _bulk(channel), _bulk(payload)])
-        delivered = 0
+        targeted = 0
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        # floor STRICTLY past the latest in-flight deadline: lowering
+        # the injected latency must not let new frames overtake
+        # scheduled ones, and an EQUAL deadline is not enough — the
+        # event loop's timer heap breaks ties arbitrarily
+        deadline = now + self.publish_latency_ms / 1000.0
+        if self._deliver_floor > now and deadline <= self._deliver_floor:
+            deadline = self._deliver_floor + 1e-4
         for sub_writer in list(receivers):
-            queue = self._sub_queues.get(sub_writer)
-            if queue is None:
+            if sub_writer not in self._sub_queues:
                 receivers.discard(sub_writer)  # connection already gone
                 continue
-            try:
-                queue.put_nowait(message)
-                delivered += 1
-            except asyncio.QueueFull:
-                # slow subscriber: drop the frame AND the client (its
-                # backlog dies with it) — matches real redis pub/sub
-                # under client-output-buffer-limit, and the extension's
-                # anti-entropy must absorb exactly this
-                self.counters["dropped_slow"] += 1
-                self._disconnect_slow(sub_writer)
-                wire = get_wire_telemetry()
-                if wire.enabled:
-                    wire.record_publish(0, dropped=True)
-        self.counters["delivered"] += delivered
-        return delivered
+            if deadline > now:
+                # injected replication lag: the frame sits "in flight"
+                # until its deadline before landing in the queue; the
+                # reply counts it optimistically (outcome unknown yet)
+                loop.call_later(deadline - now, self._enqueue, sub_writer, message)
+                targeted += 1
+            else:
+                targeted += self._enqueue(sub_writer, message)
+        if deadline > now:
+            self._deliver_floor = deadline
+        return targeted
+
+    def _enqueue(self, sub_writer: asyncio.StreamWriter, message: bytes) -> int:
+        queue = self._sub_queues.get(sub_writer)
+        if queue is None:
+            return 0  # subscriber left while the frame was in flight
+        try:
+            queue.put_nowait(message)
+            self.counters["delivered"] += 1
+            return 1
+        except asyncio.QueueFull:
+            # slow subscriber: drop the frame AND the client (its
+            # backlog dies with it) — matches real redis pub/sub
+            # under client-output-buffer-limit, and the extension's
+            # anti-entropy must absorb exactly this
+            self.counters["dropped_slow"] += 1
+            self._disconnect_slow(sub_writer)
+            wire = get_wire_telemetry()
+            if wire.enabled:
+                wire.record_publish(0, dropped=True)
+            return 0
 
     def _disconnect_slow(self, writer: asyncio.StreamWriter) -> None:
         self.counters["slow_disconnects"] += 1

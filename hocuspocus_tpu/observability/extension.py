@@ -48,7 +48,8 @@ class Metrics(Extension):
         self.expose_tracer = expose_tracer
         # /debug/trace (Perfetto JSON), /debug/profile (on-demand jax
         # profiler capture), /debug/docs[/<name>] (flight recorder),
-        # /debug/slo (burn-rate rollup)
+        # /debug/slo (burn-rate rollup), /debug/loadgen (scenario-run
+        # timeline)
         self.debug_endpoints = debug_endpoints
         self._instance = None
         self._plane_owner = None  # extension owning plane(s), for /debug/docs
@@ -694,6 +695,14 @@ class Metrics(Extension):
             if path == "/debug/slo":
                 self.slo.maybe_sample()
                 self._serve_json(data, self.slo.status())
+            if path == "/debug/loadgen":
+                # live scenario-run timeline (docs/guides/load-testing.md):
+                # the loadgen runner narrates into a process-global
+                # singleton; imported lazily so serving /metrics never
+                # pulls the loadgen package (and its server/tpu imports)
+                from ..loadgen.timeline import get_loadgen_timeline
+
+                self._serve_json(data, get_loadgen_timeline().status())
             if path == "/debug/scheduler":
                 self._serve_json(data, self._scheduler_overview())
             if path == "/debug/trace":

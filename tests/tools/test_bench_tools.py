@@ -1,0 +1,202 @@
+"""Bench tooling: gate trajectory handling, SLO-verdict gating, probe cache.
+
+Covers the observability-loop plumbing around the scenario harness:
+`tools/bench_gate.py` must exit cleanly on an empty/fresh trajectory,
+gate on the scenario-suite SLO verdict when present, and surface
+capture staleness; `bench.py` must pay each backend-probe timeout at
+most once per process.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load(name: str, relpath: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO_DIR, relpath)
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+bench_gate = _load("_test_bench_gate", "tools/bench_gate.py")
+bench = _load("_test_bench", "bench.py")
+
+
+def _write(path, payload, mtime=None):
+    with open(path, "w") as fh:
+        json.dump(payload, fh)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+
+
+def _artifact(suite_verdict=None, stale=False, stages=None, breached=()):
+    extra = {"backend": "cpu"}
+    if stages is not None:
+        extra["update_e2e"] = {
+            stage: {"p99_ms": p99, "p50_ms": p99 / 2, "count": 100}
+            for stage, p99 in stages.items()
+        }
+    if suite_verdict is not None:
+        extra["scenario_suite"] = {
+            "verdict": suite_verdict,
+            "scenarios": {
+                "smoke": {"verdict": suite_verdict, "breached": list(breached)}
+            },
+        }
+    if stale:
+        extra["stale_capture"] = True
+        extra["capture_artifact"] = "benchmarks/results/old.json"
+    return {"metric": "m", "value": 1.0, "unit": "x", "extra": extra}
+
+
+# -- trajectory handling -------------------------------------------------------
+
+
+def test_gate_empty_trajectory_skips_cleanly(tmp_path, capsys):
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "no prior round" in out
+    assert "gate skipped" in out
+
+
+def test_gate_missing_directory_skips_cleanly(tmp_path, capsys):
+    assert bench_gate.main(["--dir", str(tmp_path / "nope")]) == 0
+    assert "no prior round" in capsys.readouterr().out
+
+
+def test_gate_single_artifact_passes_without_prior_round(tmp_path, capsys):
+    _write(tmp_path / "BENCH_r01.json", _artifact(suite_verdict="pass"))
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "pairwise p99 gate skipped" in out
+    assert "scenario_suite: pass" in out
+
+
+def test_gate_unparseable_current_skips(tmp_path, capsys):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    assert "SKIP" in capsys.readouterr().out
+
+
+# -- scenario-suite SLO verdict gating ----------------------------------------
+
+
+def test_gate_fails_on_scenario_suite_verdict(tmp_path, capsys):
+    """A breached scenario SLO fails the round even with no prior round
+    to compare p99s against."""
+    _write(
+        tmp_path / "BENCH_r01.json",
+        _artifact(suite_verdict="fail", breached=["burst:latency"]),
+    )
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "scenario_suite verdict 'fail'" in out
+    assert "smoke:burst:latency" in out
+
+
+def test_gate_fails_on_scenario_suite_error(tmp_path):
+    _write(tmp_path / "BENCH_r01.json", _artifact(suite_verdict="error"))
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_gate_scenario_verdict_gates_alongside_pairwise(tmp_path, capsys):
+    """Verdict fail + healthy p99s still fails; healthy verdict + healthy
+    p99s passes."""
+    _write(
+        tmp_path / "BENCH_r01.json",
+        _artifact(suite_verdict="pass", stages={"total": 10.0}),
+        mtime=1_000_000,
+    )
+    _write(
+        tmp_path / "BENCH_r02.json",
+        _artifact(suite_verdict="fail", stages={"total": 10.0}),
+        mtime=2_000_000,
+    )
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+    _write(
+        tmp_path / "BENCH_r02.json",
+        _artifact(suite_verdict="pass", stages={"total": 10.0}),
+        mtime=2_000_000,
+    )
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_gate_pairwise_regression_still_detected(tmp_path, capsys):
+    _write(
+        tmp_path / "BENCH_r01.json",
+        _artifact(stages={"total": 10.0}),
+        mtime=1_000_000,
+    )
+    _write(
+        tmp_path / "BENCH_r02.json",
+        _artifact(stages={"total": 20.0}),
+        mtime=2_000_000,
+    )
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+# -- capture staleness ---------------------------------------------------------
+
+
+def test_gate_stale_capture_warns_by_default(tmp_path, capsys):
+    _write(tmp_path / "BENCH_r01.json", _artifact(suite_verdict="pass", stale=True))
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    assert "STALE capture" in capsys.readouterr().out
+
+
+def test_gate_stale_capture_fails_under_fail_stale(tmp_path):
+    _write(tmp_path / "BENCH_r01.json", _artifact(suite_verdict="pass", stale=True))
+    assert bench_gate.main(["--dir", str(tmp_path), "--fail-stale"]) == 1
+
+
+# -- bench.py probe cache ------------------------------------------------------
+
+
+def test_probe_timeout_paid_once_per_label(monkeypatch):
+    """A hung probe costs PROBE_TIMEOUT exactly once per env label per
+    process; repeats answer from the cache."""
+    bench._probe_cache.clear()
+    calls = []
+
+    def hang(*args, **kwargs):
+        calls.append(kwargs.get("env", {}).get("JAX_PLATFORMS", "<inherit>"))
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", hang)
+    assert bench._probe(None) is None
+    assert bench._probe(None) is None  # cached, no new subprocess
+    assert bench._probe("") is None
+    assert bench._probe("") is None
+    assert len(calls) == 2
+    assert bench._probe_cached(None) and bench._probe_cached("")
+    bench._probe_cache.clear()
+
+
+def test_probe_cache_keeps_live_backend(monkeypatch):
+    bench._probe_cache.clear()
+    calls = []
+
+    class FakeProc:
+        returncode = 0
+        stdout = "PROBE tpu 8\n"
+        stderr = ""
+
+    def probe_ok(*args, **kwargs):
+        calls.append(1)
+        return FakeProc()
+
+    monkeypatch.setattr(bench.subprocess, "run", probe_ok)
+    assert bench._probe(None) == "tpu"
+    assert bench._probe(None) == "tpu"
+    assert len(calls) == 1
+    bench._probe_cache.clear()

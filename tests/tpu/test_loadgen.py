@@ -32,6 +32,8 @@ async def test_loadgen_single_instance():
     assert result["value"] > 0
     assert result["extra"]["docs"] == 96
     assert result["extra"]["samples"] == 12
+    # reproducibility: the harness RNG seed rides in the artifact
+    assert result["extra"]["seed"] == 0
     health = result["extra"]["plane_health"][0]
     assert health["plane_broadcasts"] > 0
     assert health["cpu_fallbacks"] == 0

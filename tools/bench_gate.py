@@ -20,7 +20,8 @@ rounds and deliberate local runs):
     python tools/bench_gate.py --current BENCH_r06.json --previous BENCH_r05.json
 
 Safety rails (exit 0 with a SKIP note, never a false alarm):
-- fewer than two artifacts, or either file unreadable/unparseable,
+- an empty or single-round trajectory ("no prior round — gate skipped"),
+- either file unreadable/unparseable,
 - the two rounds ran on different backends (a CPU-fallback round must
   not be compared against an on-chip round),
 - a stage present in only one round (new stages are informational).
@@ -28,6 +29,16 @@ Safety rails (exit 0 with a SKIP note, never a false alarm):
 A stage regresses when `current_p99 > previous_p99 * (1 + tolerance) +
 floor_ms` — the absolute floor keeps micro-stage jitter (fractions of a
 millisecond) from tripping the relative check.
+
+Two checks look at the CURRENT round alone (they don't need a prior
+round, so they run even on a fresh trajectory):
+- the scenario-suite SLO verdict (`extra.scenario_suite.verdict`, from
+  the loadgen burn-rate harness): a `fail`/`error` verdict fails the
+  gate — a breached SLO is a regression even when every raw p99 moved
+  inside tolerance;
+- capture staleness (`extra.stale_capture`): a stale headline is
+  reported loudly, and fails the gate under `--fail-stale` (the
+  bench_capture workflow's enforcement hook).
 """
 
 from __future__ import annotations
@@ -127,6 +138,45 @@ def backend_of(payload: dict) -> "str | None":
     return extra.get("backend")
 
 
+def current_round_checks(payload: dict, fail_stale: bool) -> "tuple[list[str], list[str]]":
+    """Checks on the newest round alone -> (failures, notes)."""
+    failures: "list[str]" = []
+    notes: "list[str]" = []
+    extra = payload.get("extra") or {}
+    suite = extra.get("scenario_suite")
+    if isinstance(suite, dict):
+        verdict = suite.get("verdict")
+        scenarios = suite.get("scenarios") or {}
+        detail = ", ".join(
+            f"{name}={s.get('verdict')}" for name, s in sorted(scenarios.items())
+        )
+        if verdict == "pass":
+            notes.append(f"OK   scenario_suite: pass ({detail})")
+        elif verdict in ("fail", "error"):
+            breached = [
+                f"{name}:{target}"
+                for name, s in sorted(scenarios.items())
+                for target in (s.get("breached") or [])
+            ]
+            failures.append(
+                f"scenario_suite verdict {verdict!r}"
+                + (f" (breached: {', '.join(breached)})" if breached else f" ({detail})")
+            )
+        else:
+            notes.append(f"NOTE scenario_suite: verdict {verdict!r}")
+    if extra.get("stale_capture"):
+        note = (
+            "STALE capture: headline value is a re-cited on-chip run "
+            f"({extra.get('capture_artifact', '?')}, "
+            f"mtime {extra.get('capture_mtime_utc', '?')})"
+        )
+        if fail_stale:
+            failures.append(note)
+        else:
+            notes.append(f"WARN {note}")
+    return failures, notes
+
+
 def compare(
     previous: dict,
     current: dict,
@@ -189,33 +239,73 @@ def main(argv: "list[str] | None" = None) -> int:
     parser.add_argument(
         "--dir", default=_REPO_DIR, help="directory holding BENCH_*.json"
     )
+    parser.add_argument(
+        "--fail-stale",
+        action="store_true",
+        help="treat a stale_capture headline in the current round as a failure",
+    )
     args = parser.parse_args(argv)
 
     if bool(args.current) != bool(args.previous):
         # a half-pinned comparison would silently fall through to the
         # newest-two scan and gate a pair the user did not ask about
         parser.error("--current and --previous must be given together")
+    prev_path: "str | None" = None
     if args.current and args.previous:
         prev_path, cur_path = args.previous, args.current
     else:
         artifacts = find_artifacts(args.dir)
-        if len(artifacts) < 2:
-            print(f"SKIP: fewer than two BENCH_*.json under {args.dir}")
+        if not artifacts:
+            # an empty trajectory is a fresh start, not an error — but
+            # say so explicitly rather than silently passing
+            print(f"no prior round — gate skipped (no BENCH_*.json under {args.dir})")
             return 0
-        prev_path, cur_path = artifacts[-2], artifacts[-1]
+        cur_path = artifacts[-1]
+        if len(artifacts) >= 2:
+            prev_path = artifacts[-2]
 
-    previous = load_round(prev_path)
     current = load_round(cur_path)
-    if previous is None or current is None:
-        print("SKIP: could not parse one or both artifacts")
+    if current is None:
+        print(f"SKIP: could not parse {os.path.basename(cur_path)}")
         return 0
 
-    print(f"bench_gate: {os.path.basename(prev_path)} -> {os.path.basename(cur_path)}")
-    regressions, notes = compare(previous, current, args.tolerance, args.floor_ms)
+    # current-round checks run regardless of trajectory depth: the
+    # scenario-suite SLO verdict and capture staleness are properties of
+    # THIS round, not a comparison
+    failures, cur_notes = current_round_checks(current, args.fail_stale)
+
+    if prev_path is None:
+        print(
+            f"bench_gate: {os.path.basename(cur_path)} "
+            "(no prior round — pairwise p99 gate skipped)"
+        )
+        notes = cur_notes
+        regressions: "list[str]" = []
+    else:
+        previous = load_round(prev_path)
+        if previous is None:
+            print(
+                f"bench_gate: {os.path.basename(cur_path)} "
+                f"(previous round unreadable — pairwise p99 gate skipped)"
+            )
+            notes = cur_notes
+            regressions = []
+        else:
+            print(
+                f"bench_gate: {os.path.basename(prev_path)} -> "
+                f"{os.path.basename(cur_path)}"
+            )
+            regressions, notes = compare(
+                previous, current, args.tolerance, args.floor_ms
+            )
+            notes = notes + cur_notes
     for note in notes:
         print(f"  {note}")
-    if regressions:
-        print(f"REGRESSION: {len(regressions)} stage(s) over budget")
+    problems = regressions + failures
+    if problems:
+        print(f"REGRESSION: {len(problems)} check(s) failed")
+        for problem in problems:
+            print(f"  FAIL {problem}")
         return 1
     print("PASS: no stage regressed beyond tolerance")
     return 0
