@@ -215,6 +215,65 @@ def build_parser() -> argparse.ArgumentParser:
         "breaker, draining served docs to the CPU path until a recovery "
         "probe passes (default 3; see docs/guides/tpu-supervisor.md)",
     )
+    # overload control plane (docs/guides/overload.md): the hysteresis
+    # degradation ladder (GREEN -> BROWNOUT-1 -> BROWNOUT-2 -> RED)
+    # driven by live load signals, plus per-tenant token-bucket
+    # admission at connect/auth and message ingress.
+    parser.add_argument(
+        "--overload",
+        choices=("on", "off"),
+        default="on",
+        help="overload control plane: 'on' (default) samples load "
+        "signals (event-loop lag, send queues, device-lane depth, WAL "
+        "commit latency, replication inbox) into a brownout ladder — "
+        "park maintenance, stretch awareness, defer catch-up, reject "
+        "new work at RED with 503 + Retry-After; 'off' disables all "
+        "shedding and admission",
+    )
+    parser.add_argument(
+        "--overload-hold-secs",
+        type=float,
+        default=2.0,
+        help="hysteresis hold: the ladder steps DOWN one rung only "
+        "after this many seconds of sustained calm (escalation is "
+        "always immediate); prevents rung flapping (default 2)",
+    )
+    parser.add_argument(
+        "--overload-retry-after",
+        type=float,
+        default=1.0,
+        help="Retry-After seconds on 503 rejections (RED state, tenant "
+        "quota, and the drain path share the same rejection; default 1)",
+    )
+    parser.add_argument(
+        "--tenant-connect-rate",
+        type=float,
+        default=0.0,
+        help="per-tenant connect/auth admission rate, document channels "
+        "per second (token bucket; 0 = unlimited, the default). A "
+        "tenant over quota is refused without touching other tenants' "
+        "buckets",
+    )
+    parser.add_argument(
+        "--tenant-connect-burst",
+        type=float,
+        default=8.0,
+        help="per-tenant connect bucket burst capacity (default 8)",
+    )
+    parser.add_argument(
+        "--tenant-msg-rate",
+        type=float,
+        default=0.0,
+        help="per-tenant message-ingress admission rate, frames per "
+        "second (0 = unlimited, the default); over-quota frames are "
+        "counted, and at RED the channel closes 1013 Try Again Later",
+    )
+    parser.add_argument(
+        "--tenant-msg-burst",
+        type=float,
+        default=256.0,
+        help="per-tenant message bucket burst capacity (default 256)",
+    )
     # observability (docs/guides/observability.md): Prometheus /metrics,
     # end-to-end update lifecycle tracing with Perfetto export
     # (/debug/trace), on-demand device profiles (/debug/profile) and the
@@ -298,6 +357,22 @@ async def run(args: argparse.Namespace) -> None:
             Metrics(
                 slo_e2e_p99_ms=args.slo_e2e_ms,
                 slo_error_rate=args.slo_error_rate,
+            )
+        )
+    if args.overload == "on":
+        # the process-global degradation ladder + tenant admission
+        # (docs/guides/overload.md); priority 990 so it configures
+        # right after Metrics lights the wire collector
+        from .server.overload import OverloadExtension
+
+        extensions.append(
+            OverloadExtension(
+                hold_s=args.overload_hold_secs,
+                retry_after_s=args.overload_retry_after,
+                connect_rate=args.tenant_connect_rate,
+                connect_burst=args.tenant_connect_burst,
+                message_rate=args.tenant_msg_rate,
+                message_burst=args.tenant_msg_burst,
             )
         )
     if args.wal_dir:

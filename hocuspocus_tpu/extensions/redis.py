@@ -150,6 +150,14 @@ class Redis(Extension):
         # doc makes peers send back whatever we missed (and vice versa)
         if hasattr(self.sub, "on_reconnect"):
             self.sub.on_reconnect = self._resync_after_reconnect
+        # the OUTBOUND half of the same story: the pipelined publish
+        # lane arms its resync hook whenever an outage forced it to
+        # shed buffered publishes (byte cap / overflow / unreachable
+        # server) and fires it once on the next successful reconnect —
+        # the join-batch exchange below pulls back exactly the window
+        # the sheds dropped
+        if hasattr(self.pub, "on_resync"):
+            self.pub.on_resync = self._resync_after_reconnect
         self.instance = None
         # plane-served docs: last anti-entropy SyncStep1 publish per
         # doc, plus trailing timers so a QUIESCENT doc still gets one

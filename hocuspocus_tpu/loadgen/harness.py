@@ -63,6 +63,8 @@ class ServedLoadHarness:
         background_fraction: int = 16,
         with_metrics: bool = False,
         seed: int = 0,
+        overload: "Optional[dict]" = None,
+        anti_entropy_s: "Optional[float]" = None,
         progress=None,
     ) -> None:
         self.num_docs = num_docs
@@ -82,6 +84,13 @@ class ServedLoadHarness:
         # ingress-stage quantiles off metrics[0] after the run
         self.with_metrics = with_metrics
         self.metrics: list[Any] = []
+        # overload: per-instance OverloadExtension options — the
+        # scenario runner's seam for driving the degradation ladder
+        # (docs/guides/overload.md). anti_entropy_s tightens the Redis
+        # extension's anti-entropy cadence so partition-heal scenarios
+        # reconverge inside CI-scale phases.
+        self.overload = overload
+        self.anti_entropy_s = anti_entropy_s
         # seed: every random choice the harness makes (timed edit sizes,
         # background payload widths) draws from a seeded generator, and
         # the seed is stamped into the result dict — any bench or
@@ -146,14 +155,21 @@ class ServedLoadHarness:
             if redis_cfg is not None:
                 from ..extensions import Redis
 
-                extensions.append(
-                    Redis(
-                        host=redis_cfg[0],
-                        port=redis_cfg[1],
-                        identifier=f"loadgen-{i}",
-                        disconnect_delay=100,
-                    )
+                redis_ext = Redis(
+                    host=redis_cfg[0],
+                    port=redis_cfg[1],
+                    identifier=self.redis_identifier(i),
+                    disconnect_delay=100,
                 )
+                if self.anti_entropy_s is not None:
+                    redis_ext.plane_anti_entropy_seconds = float(
+                        self.anti_entropy_s
+                    )
+                extensions.append(redis_ext)
+            if self.overload is not None:
+                from ..server.overload import OverloadExtension
+
+                extensions.append(OverloadExtension(**self.overload))
             if self.with_metrics:
                 from ..observability import Metrics
 
@@ -167,6 +183,11 @@ class ServedLoadHarness:
                 plane.warmup_compiles()
             self.servers.append(server)
             self.extensions.append(ext)
+
+    def redis_identifier(self, instance: int) -> str:
+        """The identifier instance `instance`'s Redis extension frames
+        its publishes with — the mini_redis partition-injection key."""
+        return f"loadgen-{instance}"
 
     def _counters(self, instance: int = 0) -> dict:
         ext = self.extensions[instance]

@@ -68,6 +68,13 @@ class ScenarioRunner:
         self._progress = progress or (lambda msg: None)
 
         pop = schedule.population
+        params = pop.get("params") or {}
+        # scenario-scoped overload control plane: params["overload"]
+        # installs an OverloadExtension per instance with the given
+        # tuning (docs/guides/overload.md); the runner resets the
+        # process-global controller at teardown
+        self._overload_config = params.get("overload")
+        self._verify_convergence = bool(params.get("verify_convergence"))
         self.harness = ServedLoadHarness(
             num_docs=pop["num_docs"],
             instances=pop["instances"],
@@ -79,6 +86,8 @@ class ScenarioRunner:
             docs_per_socket=pop.get("docs_per_socket", 64),
             with_metrics=with_metrics,
             seed=schedule.seed,
+            overload=self._overload_config,
+            anti_entropy_s=params.get("anti_entropy_s"),
             progress=self._progress,
         )
 
@@ -162,9 +171,16 @@ class ScenarioRunner:
 
     # -- op execution --------------------------------------------------------
 
-    async def _await_synced(self, provider) -> "Optional[float]":
+    async def _await_synced(
+        self, provider, abort: "Optional[asyncio.Event]" = None
+    ) -> "Optional[float]":
+        """Seconds until the provider syncs; None on timeout OR when
+        `abort` fires first (e.g. admission denied — the op must fail
+        fast, not burn the op timeout)."""
         t0 = time.perf_counter()
         while not provider.synced:
+            if abort is not None and abort.is_set():
+                return None
             if time.perf_counter() - t0 > self.op_timeout_s:
                 return None
             await asyncio.sleep(0.002)
@@ -179,8 +195,12 @@ class ScenarioRunner:
         provider = HocuspocusProvider(
             name=f"load-{doc}", websocket_provider=socket
         )
+        # overload admission refuses at auth with permission-denied —
+        # the join must FAIL FAST (a bad op), not burn the op timeout
+        denied = asyncio.Event()
+        provider.on("authentication_failed", lambda *args: denied.set())
         provider.attach()
-        latency = await self._await_synced(provider)
+        latency = await self._await_synced(provider, abort=denied)
         self._joined.setdefault(doc, []).append(provider)
         return latency
 
@@ -214,13 +234,31 @@ class ScenarioRunner:
             redis.publish_latency_ms = value
         return 0.0
 
+    def _op_partition(self, value: int) -> "Optional[float]":
+        """One-way partition of instance 0's publisher at the mini_redis
+        hop (value 1), or heal (value 0). Drops are accounted in the
+        server's `dropped_partition` counter — never silent."""
+        redis = self.harness.mini_redis
+        if redis is not None:
+            if value:
+                redis.partition_publisher(self.harness.redis_identifier(0))
+            else:
+                redis.heal_partition()
+        return 0.0
+
+    def _op_overload(self, value: int) -> "Optional[float]":
+        from ..server.overload import get_overload_controller
+
+        get_overload_controller().inject_pressure(float(value))
+        return 0.0
+
     async def _execute(self, op) -> None:
         """Run one op; measured kinds feed the phase histogram and the
         success counters. A timeout is a bad event, never an abort."""
         measured = True
         latency: "Optional[float]" = 0.0
         if op.kind == "edit":
-            if op.doc < self.harness.sampled:
+            if op.doc < self.harness.sampled and not op.value:
                 latency = await self.harness.timed_edit(
                     op.doc,
                     max(op.size, 1),
@@ -228,7 +266,10 @@ class ScenarioRunner:
                     raise_on_timeout=False,
                 )
             else:
-                # background traffic: fire-and-forget, load not signal
+                # background traffic (non-sampled doc, or an edit
+                # flagged fire-and-forget — e.g. during a partition
+                # phase whose observation channel is deliberately
+                # dead): load, not signal
                 wtext = self.harness.writers[op.doc].document.get_text("body")
                 wtext.insert(len(wtext), "b" * max(op.size, 1))
                 measured = False
@@ -241,6 +282,12 @@ class ScenarioRunner:
             latency = await self._op_reconnect(op.doc)
         elif op.kind == "lag":
             latency = self._op_lag(op.value)
+            measured = False
+        elif op.kind == "partition":
+            latency = self._op_partition(op.value)
+            measured = False
+        elif op.kind == "overload":
+            latency = self._op_overload(op.value)
             measured = False
         ok = latency is not None
         if measured:
@@ -332,6 +379,67 @@ class ScenarioRunner:
             f"phase {name} done: {summary['measured_ops']} measured ops, "
             f"p99={summary['latency_p99_ms']}ms"
         )
+
+    async def _check_convergence(self, timeout_s: float = 8.0) -> dict:
+        """Partition-heal acceptance: every sampled doc's server-side
+        state must converge BYTE-IDENTICALLY across the two instances
+        (encode_state_as_update orders structs deterministically, so
+        equal logical state means equal bytes). Waits out the trailing
+        anti-entropy exchange; a doc still diverged at the deadline is
+        reported and latches the verdict."""
+        from ..crdt import encode_state_as_update
+
+        harness = self.harness
+        docs_a = harness.servers[0].hocuspocus.documents
+        docs_b = harness.servers[1].hocuspocus.documents
+        names = [f"load-{d}" for d in range(harness.sampled)]
+        pending = set(names)
+        t0 = time.perf_counter()
+        while pending and time.perf_counter() - t0 < timeout_s:
+            for name in list(pending):
+                doc_a, doc_b = docs_a.get(name), docs_b.get(name)
+                if doc_a is None or doc_b is None:
+                    continue
+                try:
+                    if encode_state_as_update(doc_a) == encode_state_as_update(
+                        doc_b
+                    ):
+                        pending.discard(name)
+                except Exception:
+                    pass
+            if pending:
+                await asyncio.sleep(0.05)
+        return {
+            "docs_checked": len(names),
+            "converged": not pending,
+            "diverged": sorted(pending),
+            "wait_ms": round((time.perf_counter() - t0) * 1000, 1),
+        }
+
+    def _chaos_evidence(self) -> dict:
+        """Overload/partition accounting attached to the artifact: the
+        ladder's transition history + shed counters, mini_redis's
+        partition-drop accounting, and the publish lane's shed
+        counters — 'every shed publish accounted' is checkable from
+        the artifact alone."""
+        evidence: dict = {}
+        if self._overload_config:
+            from ..server.overload import get_overload_controller
+
+            evidence["overload"] = get_overload_controller().status()
+        mini = self.harness.mini_redis
+        if mini is not None:
+            evidence["mini_redis"] = dict(mini.counters)
+        publish = {}
+        for i, server in enumerate(self.harness.servers):
+            for ext in getattr(server.hocuspocus, "_extensions", []):
+                pub = getattr(ext, "pub", None)
+                counters = getattr(pub, "counters", None)
+                if isinstance(counters, dict):
+                    publish[f"instance{i}"] = dict(counters)
+        if publish:
+            evidence["publish_lane"] = publish
+        return evidence
 
     def _lane_counters(self) -> "Optional[dict]":
         total: "dict[str, int]" = {}
@@ -435,6 +543,30 @@ class ScenarioRunner:
                 if phase_index < len(phase_order):
                     self._start_phase(phase_order[phase_index])
             elapsed = time.perf_counter() - t0
+            if self._overload_config:
+                # the schedule is over: stop the ladder's sampler NOW so
+                # teardown churn (provider/server destruction stalls the
+                # loop) can't smear spurious transitions into the
+                # flight recorder after the measured run
+                from ..server.overload import get_overload_controller
+
+                get_overload_controller().stop()
+
+            convergence = None
+            if self._verify_convergence and harness.instances > 1:
+                convergence = await self._check_convergence()
+                if not convergence["converged"]:
+                    # zero-silent-loss acceptance: divergence after the
+                    # heal window is a latched failure like any breach
+                    self._breached["convergence"] = True
+                    get_flight_recorder().record(
+                        "__loadgen__",
+                        "convergence_failed",
+                        diverged=",".join(convergence["diverged"]),
+                    )
+                    self._progress(
+                        f"CONVERGENCE FAILED: {convergence['diverged']}"
+                    )
 
             verdict = "fail" if any(self._breached.values()) else "pass"
             slo_status = self.engine.status()
@@ -495,6 +627,11 @@ class ScenarioRunner:
                     ],
                 },
             }
+            if convergence is not None:
+                result["extra"]["convergence"] = convergence
+            chaos = self._chaos_evidence()
+            if chaos:
+                result["extra"].update(chaos)
             return result
         finally:
             timeline.end_run(verdict)
@@ -513,6 +650,12 @@ class ScenarioRunner:
         self._join_sockets.clear()
         await asyncio.sleep(0)
         await self.harness._teardown()
+        if self._overload_config:
+            # the controller is process-global: a scenario that tuned +
+            # drove it must hand the next run a cold GREEN one
+            from ..server.overload import get_overload_controller
+
+            get_overload_controller().reset()
 
 
 async def run_scenario(

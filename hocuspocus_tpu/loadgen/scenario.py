@@ -23,11 +23,19 @@ Op kinds (the whole DSL — small on purpose):
 ==========  ============================================================
 ``edit``    writer inserts ``size`` units into doc ``doc``; measured
             end-to-end when the doc is sampled (writer→reader observe)
+            — unless ``value`` is nonzero (fire-and-forget background
+            traffic even on a sampled doc, e.g. during a partition)
 ``join``    a new provider joins doc ``doc`` (time-to-synced measured)
 ``leave``   the oldest scenario-joined provider on doc ``doc`` leaves
 ``reconnect`` drop + rejoin a provider on doc ``doc`` (resync measured)
 ``lag``     set cross-instance replication latency to ``value`` ms
             (mini_redis injection; no-op on single-instance runs)
+``partition`` ``value`` 1 = one-way-partition instance 0's publisher at
+            the mini_redis hop (its publishes blackhole, accounted);
+            0 = heal — anti-entropy then reconverges the instances
+``overload`` inject ``value`` rungs of synthetic pressure into the
+            overload ladder (server/overload.py; 1=brownout1 … 3=red,
+            0 clears) — drives shed/admission behavior deterministically
 ==========  ============================================================
 
 Everything here is stdlib-only and import-light: compiling and hashing
@@ -45,7 +53,7 @@ from typing import Callable, Optional, Sequence
 
 SCHEDULE_VERSION = 1
 
-OP_KINDS = ("edit", "join", "leave", "reconnect", "lag")
+OP_KINDS = ("edit", "join", "leave", "reconnect", "lag", "partition", "overload")
 
 
 @dataclass(frozen=True)

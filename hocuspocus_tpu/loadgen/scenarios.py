@@ -16,6 +16,11 @@ workloads, Eg-walker arXiv:2409.14252 realistic-concurrency merges):
 - ``mega_doc``         — one outsized doc among thousands of small ones
 - ``replication_lag``  — cross-instance lag injected into mini_redis
 - ``storm``            — flash crowd + reconnect herd composed (slow)
+- ``overload_storm``   — injected RED pressure: brownout shedding +
+  admission rejections while interactive p99 holds, hysteresis-clean
+  recovery to GREEN
+- ``partition_heal``   — one-way mini_redis partition, accounted drops,
+  anti-entropy heal to byte-identical convergence
 """
 
 from __future__ import annotations
@@ -44,11 +49,15 @@ def _edit_gen(
     mega_every: int = 0,
     mega_lo: int = 192,
     mega_hi: int = 384,
+    background: bool = False,
 ) -> Callable:
     """Steady random-doc edit traffic at `rate_per_s` (logical time).
 
     With ``mega_every`` = N, every Nth op targets doc 0 with a
-    mega-sized insert — the one-big-doc-among-thousands mix."""
+    mega-sized insert — the one-big-doc-among-thousands mix. With
+    ``background`` the edits are fire-and-forget even on sampled docs
+    (``OpEvent.value = 1``) — traffic that must keep flowing while its
+    observation channel is deliberately broken (a partition phase)."""
 
     def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
         count = max(int(rate_per_s * phase.duration_ms / 1000), 1)
@@ -59,7 +68,16 @@ def _edit_gen(
             else:
                 doc = rng.randrange(scenario.num_docs)
                 size = rng.randrange(size_lo, size_hi)
-            ops.append(OpEvent(at, phase.name, "edit", doc=doc, size=size))
+            ops.append(
+                OpEvent(
+                    at,
+                    phase.name,
+                    "edit",
+                    doc=doc,
+                    size=size,
+                    value=1 if background else 0,
+                )
+            )
         return ops
 
     return gen
@@ -119,6 +137,26 @@ def _reconnect_gen(reconnects: int) -> Callable:
 def _lag_gen(lag_ms: int, at_ms: int = 0) -> Callable:
     def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
         return [OpEvent(at_ms, phase.name, "lag", value=lag_ms)]
+
+    return gen
+
+
+def _partition_gen(on: bool, at_ms: int = 0) -> Callable:
+    """Start (on=True) or heal (on=False) the one-way mini_redis
+    partition of instance 0's publisher."""
+
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        return [OpEvent(at_ms, phase.name, "partition", value=1 if on else 0)]
+
+    return gen
+
+
+def _overload_gen(rung: int, at_ms: int = 0) -> Callable:
+    """Inject `rung` rungs of synthetic pressure into the overload
+    ladder (0 clears)."""
+
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        return [OpEvent(at_ms, phase.name, "overload", value=rung)]
 
     return gen
 
@@ -351,6 +389,136 @@ def storm(
     )
 
 
+def overload_storm(
+    num_docs: int = 12,
+    phase_ms: int = 1200,
+    joins: int = 3,
+    hold_s: float = 0.1,
+) -> Scenario:
+    """The overload control plane under deterministic pressure
+    (docs/guides/overload.md): a calm phase, then synthetic RED-rung
+    pressure lands WITH a join wave — the ladder must reject the new
+    joins (shed/reject counters go nonzero) while the already-admitted
+    interactive edits keep their p99, then a recovery phase clears the
+    pressure and the ladder must walk back to GREEN one rung per hold
+    window (hysteresis-clean: the flight recorder shows a monotonic
+    descent, never a flap). The runner installs an OverloadExtension
+    from ``params["overload"]`` and attaches the controller's
+    transition/shed evidence to the artifact."""
+    return Scenario(
+        name="overload_storm",
+        description="brownout ladder + admission under injected RED pressure",
+        num_docs=num_docs,
+        sampled=min(6, num_docs),
+        shards=1,
+        capacity=512,
+        docs_per_socket=num_docs,
+        params={
+            "overload": {
+                "hold_s": hold_s,
+                "sample_interval_s": min(hold_s / 2, 0.05),
+                "awareness_stretch_ms": 100.0,
+                "catchup_retry_s": 0.1,
+                # the INJECTED signal alone drives this scenario's
+                # ladder: ambient signals (loop lag on a loaded CI
+                # runner, send queues) are parked far out of range so
+                # the transition path is deterministic
+                "thresholds": {
+                    "loop_lag_ms": (1e7, 2e7, 3e7),
+                    "send_queue_depth": (1e7, 2e7, 3e7),
+                    "backpressure_per_s": (1e7, 2e7, 3e7),
+                    "lane_depth": (1e7, 2e7, 3e7),
+                    "wal_commit_ms": (1e7, 2e7, 3e7),
+                    "inbox_depth": (1e7, 2e7, 3e7),
+                },
+            }
+        },
+        phases=[
+            PhaseSpec("calm", phase_ms, _edit_gen(20.0), slo_e2e_ms=1000.0),
+            PhaseSpec(
+                "storm",
+                phase_ms,
+                _compose(
+                    _overload_gen(3),  # straight to RED at phase start
+                    _edit_gen(40.0),
+                    _join_storm_gen(joins),
+                ),
+                # the acceptance bar: interactive edit p99 HOLDS while
+                # the ladder sheds — the joins are the sacrificed load
+                # (they fail fast with permission-denied), so the
+                # op-success objective tolerates exactly them
+                slo_e2e_ms=1000.0,
+                slo_objective=0.90,
+                error_objective=0.85,
+            ),
+            PhaseSpec(
+                "recover",
+                phase_ms,
+                _compose(_overload_gen(0), _edit_gen(20.0)),
+                slo_e2e_ms=1000.0,
+            ),
+        ],
+    )
+
+
+def partition_heal(
+    num_docs: int = 8,
+    phase_ms: int = 1500,
+    anti_entropy_s: float = 0.25,
+) -> Scenario:
+    """Partition-heal chaos (docs/guides/overload.md): writers on
+    instance A, readers on instance B; the middle phase one-way
+    blackholes A's publishes at the mini_redis hop (every drop is
+    accounted in ``dropped_partition`` — zero silent loss) while edits
+    keep flowing fire-and-forget; the heal phase ends the partition and
+    measures edits end to end again — their latency INCLUDES the
+    anti-entropy exchange that pulls back the partition-era updates.
+    ``params["verify_convergence"]`` makes the runner assert the
+    instances' documents converge byte-identically after the schedule
+    (a failure latches the verdict to fail)."""
+    return Scenario(
+        name="partition_heal",
+        description="one-way mini_redis partition, anti-entropy heal, "
+        "byte-identical convergence",
+        num_docs=num_docs,
+        sampled=min(4, num_docs),
+        instances=2,
+        shards=1,
+        capacity=512,
+        docs_per_socket=num_docs,
+        params={
+            "verify_convergence": True,
+            "anti_entropy_s": anti_entropy_s,
+        },
+        phases=[
+            PhaseSpec("healthy", phase_ms, _edit_gen(16.0), slo_e2e_ms=1000.0),
+            PhaseSpec(
+                "partitioned",
+                phase_ms,
+                _compose(
+                    _partition_gen(True),
+                    # fire-and-forget even on sampled docs: the traffic
+                    # must keep flowing while its replication channel is
+                    # deliberately dead (measuring here would only time
+                    # out — the HEAL phase measures the recovery)
+                    _edit_gen(16.0, background=True),
+                ),
+            ),
+            PhaseSpec(
+                "healed",
+                phase_ms,
+                _compose(_partition_gen(False), _edit_gen(12.0)),
+                # the first measured edits carry the heal: their
+                # latency includes the anti-entropy exchange pulling
+                # back everything the partition dropped
+                slo_e2e_ms=2000.0,
+                slo_objective=0.90,
+                error_objective=0.90,
+            ),
+        ],
+    )
+
+
 SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "smoke": smoke,
     "diurnal": diurnal,
@@ -359,11 +527,14 @@ SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "mega_doc": mega_doc,
     "replication_lag": replication_lag,
     "storm": storm,
+    "overload_storm": overload_storm,
+    "partition_heal": partition_heal,
 }
 
 # the default suite bench.py / bench_capture run: fast enough for every
-# round, covers the single-instance AND cross-instance paths
-BENCH_SUITE = ("smoke", "replication_lag")
+# round, covers the single-instance, cross-instance, overload-shed and
+# partition-heal paths
+BENCH_SUITE = ("smoke", "replication_lag", "overload_storm", "partition_heal")
 
 
 def get_scenario(name: str, **overrides) -> Scenario:

@@ -58,6 +58,7 @@ class MiniRedis:
             "dropped_injected": 0,
             "dropped_slow": 0,
             "slow_disconnects": 0,
+            "dropped_partition": 0,
         }
         # cluster emulation: list of (start, end, MiniRedis) covering the
         # slot space; keyed commands off this node's ranges answer MOVED,
@@ -79,6 +80,19 @@ class MiniRedis:
         # overtake a slow one still in flight
         self.publish_latency_ms = 0
         self._deliver_floor = 0.0
+        # partition injection (chaos hardening): a ONE-WAY network
+        # partition modeled at the pub/sub hop. Payloads from the Redis
+        # extension are identifier-prefixed ([1-byte idLen][identifier]
+        # [frame]); publishes whose identifier is in this set vanish in
+        # flight — the publisher's write succeeds (it is none the
+        # wiser, exactly like a blackholed link), subscribers never see
+        # the frame, and every drop is ACCOUNTED in
+        # counters["dropped_partition"] so partition-heal tests can
+        # assert zero silent loss. The reverse direction (and every
+        # other publisher) keeps flowing: that is what makes it
+        # one-way. Heal with `heal_partition()`; the extensions'
+        # anti-entropy SyncStep1 exchange then closes the gap.
+        self.partitioned_identifiers: "set[bytes]" = set()
         # keys mid-migration (ASK emulation): a keyed command on such a
         # key answers -ASK <slot> target; the target executes it only
         # on an ASKING-flagged connection, like a real resharding window
@@ -86,6 +100,36 @@ class MiniRedis:
 
     def configure_cluster(self, ranges: list[tuple[int, int, "MiniRedis"]]) -> None:
         self.cluster_ranges = ranges
+
+    # -- partition injection -------------------------------------------------
+
+    def partition_publisher(self, identifier: "str | bytes") -> None:
+        """Blackhole every publish whose payload carries `identifier`
+        (one-way partition: that instance's outbound replication dies,
+        everything else keeps flowing)."""
+        if isinstance(identifier, str):
+            identifier = identifier.encode()
+        self.partitioned_identifiers.add(identifier)
+
+    def heal_partition(self, identifier: "str | bytes | None" = None) -> None:
+        """End the partition (one identifier, or all when None)."""
+        if identifier is None:
+            self.partitioned_identifiers.clear()
+            return
+        if isinstance(identifier, str):
+            identifier = identifier.encode()
+        self.partitioned_identifiers.discard(identifier)
+
+    def _partition_drops(self, payload: bytes) -> bool:
+        """True when the payload's publisher identifier is partitioned."""
+        if not self.partitioned_identifiers:
+            return False
+        try:
+            id_len = payload[0]
+            identifier = payload[1 : id_len + 1]
+        except Exception:
+            return False
+        return identifier in self.partitioned_identifiers
 
     def _owns(self, key: bytes) -> Optional["MiniRedis"]:
         """None if this node owns the key's slot, else the owning node."""
@@ -338,6 +382,21 @@ class MiniRedis:
                         writer.write(b"-ERR unsupported script\r\n")
                 elif command == b"PUBLISH":
                     channel, payload = args[0], args[1]
+                    if self._partition_drops(payload):
+                        # one-way partition: the publisher's command
+                        # succeeds (a blackholed link gives no error),
+                        # the frame never reaches any subscriber, the
+                        # drop is accounted — never silent
+                        self.counters["dropped_partition"] += 1
+                        wire = get_wire_telemetry()
+                        if wire.enabled:
+                            wire.record_publish(0, dropped=True)
+                        writer.write(b":0\r\n")
+                        try:
+                            await writer.drain()
+                        except (ConnectionError, OSError):
+                            break
+                        continue
                     if self.drop_publishes > 0 and (
                         self.drop_channel is None or channel == self.drop_channel
                     ):

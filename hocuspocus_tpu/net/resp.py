@@ -330,6 +330,16 @@ class PipelinedRedisClient(RedisClient):
       cycle), pending work is SHED: futures fail with ConnectionError,
       publishes are counted dropped. The extension's anti-entropy
       SyncStep1 exchange heals dropped replication frames.
+    - The outbox is BYTE-bounded (`max_outbox_bytes`) as well as
+      command-bounded: during a transport outage, enqueues past the cap
+      shed the OLDEST buffered publishes first (counted in
+      `counters["dropped"]`/`["shed_bytes"]`) instead of growing toward
+      OOM — newest state wins, and everything shed is recoverable
+      because CRDT sync is state-based. Any shed (cap, overflow, or
+      unreachable-server) arms `on_resync`: the first successful
+      reconnect afterwards fires it once, so the owner (the Redis
+      extension) can run its anti-entropy exchange and heal exactly the
+      window the outage dropped.
     """
 
     def __init__(
@@ -337,6 +347,7 @@ class PipelinedRedisClient(RedisClient):
         host: str = "127.0.0.1",
         port: int = 6379,
         max_pending: int = 65536,
+        max_outbox_bytes: int = 8 * 1024 * 1024,
         reconnect_delay: float = 0.05,
     ) -> None:
         super().__init__(host, port)
@@ -345,6 +356,7 @@ class PipelinedRedisClient(RedisClient):
         self._flush_task: Optional[asyncio.Task] = None
         self._reply_task: Optional[asyncio.Task] = None
         self.max_pending = max_pending
+        self.max_outbox_bytes = max_outbox_bytes
         self.reconnect_delay = reconnect_delay
         self.counters = {
             "publishes": 0,
@@ -354,7 +366,15 @@ class PipelinedRedisClient(RedisClient):
             "reply_errors": 0,
             "resyncs": 0,
             "dropped": 0,
+            "shed_bytes": 0,
         }
+        # armed by any shed; fired (once) after the next successful
+        # reconnect so the owner can anti-entropy-heal the gap. May be
+        # sync or async; async callbacks run as tracked tasks.
+        self.on_resync: Optional[Callable[[], Any]] = None
+        self._needs_resync = False
+        self._resync_tasks: set = set()
+        self._outbox_bytes = 0
         from ..observability.wire import get_wire_telemetry
 
         get_wire_telemetry().track_redis_pipeline(self)
@@ -374,6 +394,7 @@ class PipelinedRedisClient(RedisClient):
             raise ConnectionError("redis client closed")
         if self.pending >= self.max_pending:
             self.counters["dropped"] += 1
+            self._needs_resync = True
             return
         self.counters["publishes"] += 1
         self._enqueue(
@@ -410,7 +431,36 @@ class PipelinedRedisClient(RedisClient):
         is_publish: bool = False,
     ) -> None:
         self._outbox.append(_PipelinedCommand(encoded, future, is_publish))
+        self._outbox_bytes += len(encoded)
+        if self._outbox_bytes > self.max_outbox_bytes:
+            self._shed_outbox_overflow()
         self._schedule_flush()
+
+    def _shed_outbox_overflow(self) -> None:
+        """Byte cap crossed (the server is unreachable or drowning):
+        shed the OLDEST buffered publishes until the outbox fits.
+        Commands carrying futures (lock traffic) are never silently
+        dropped — they keep their order and fail through the normal
+        shed/resend paths — and the NEWEST command always survives:
+        the cap bounds accumulation across an outage, not single-frame
+        size, so one outsized full-state frame still ships (shedding it
+        on enqueue would loop forever: the anti-entropy heal republishes
+        the same frame). Newest-state-wins is safe: CRDT sync is
+        state-based and the armed `on_resync` heals the gap."""
+        kept: "list[_PipelinedCommand]" = []
+        shed = 0
+        while len(self._outbox) > 1 and self._outbox_bytes > self.max_outbox_bytes:
+            command = self._outbox.popleft()
+            if command.is_publish and command.future is None:
+                self._outbox_bytes -= len(command.encoded)
+                self.counters["dropped"] += 1
+                self.counters["shed_bytes"] += len(command.encoded)
+                shed += 1
+            else:
+                kept.append(command)
+        self._outbox.extendleft(reversed(kept))
+        if shed:
+            self._needs_resync = True
 
     def _schedule_flush(self) -> None:
         if self._flush_task is not None and not self._flush_task.done():
@@ -437,9 +487,11 @@ class PipelinedRedisClient(RedisClient):
                     if not await self._reconnect():
                         self._shed_pending()
                         return
+                    self._fire_resync_if_armed()
                 self._ensure_reply_reader()
                 batch = list(self._outbox)
                 self._outbox.clear()
+                self._outbox_bytes = 0
                 self._inflight.extend(batch)
                 oldest_wait = time.perf_counter() - batch[0].enqueued_at
                 try:
@@ -480,6 +532,26 @@ class PipelinedRedisClient(RedisClient):
                     await asyncio.sleep(self.reconnect_delay)
         return False
 
+    def _fire_resync_if_armed(self) -> None:
+        """First successful reconnect after a shed: hand the owner one
+        anti-entropy opportunity (the Redis extension publishes
+        SyncStep1 + QueryAwareness per loaded doc, pulling every frame
+        the outage window dropped)."""
+        if not self._needs_resync:
+            return
+        self._needs_resync = False
+        callback = self.on_resync
+        if callback is None:
+            return
+        try:
+            result = callback()
+        except Exception:
+            return
+        if asyncio.iscoroutine(result):
+            task = asyncio.ensure_future(result)
+            self._resync_tasks.add(task)
+            task.add_done_callback(self._resync_tasks.discard)
+
     def _shed_pending(self) -> None:
         """Server unreachable after retries: fail futures, count dropped
         publishes. Pending work must not wedge callers forever."""
@@ -487,6 +559,8 @@ class PipelinedRedisClient(RedisClient):
         for queue in (self._inflight, self._outbox):
             while queue:
                 self._fail(queue.popleft(), error)
+        self._outbox_bytes = 0
+        self._needs_resync = True
 
     def _fail(self, command: _PipelinedCommand, error: Exception) -> None:
         if command.future is not None:
@@ -523,8 +597,11 @@ class PipelinedRedisClient(RedisClient):
             command.attempts += 1
             if command.attempts >= 2:
                 self._fail(command, ConnectionError("redis connection lost"))
+                if command.is_publish:
+                    self._needs_resync = True
             else:
                 requeue.append(command)
+                self._outbox_bytes += len(command.encoded)
         self._outbox.extendleft(reversed(requeue))
 
     # -- the reply reader --------------------------------------------------
@@ -578,6 +655,10 @@ class PipelinedRedisClient(RedisClient):
                 command = queue.popleft()
                 if command.future is not None and not command.future.done():
                     command.future.set_exception(error)
+        self._outbox_bytes = 0
+        for task in list(self._resync_tasks):
+            task.cancel()
+        self._resync_tasks.clear()
         super().close()
 
 

@@ -108,6 +108,17 @@ class Metrics(Extension):
         self.wire = get_wire_telemetry()
         for metric in self.wire.metrics():
             reg.register(metric)
+        # overload control plane (server/overload.py): ladder state,
+        # transitions, shed accounting, admission counters and signal
+        # gauges — adopted like the wire collector so every deployment
+        # scraping /metrics can alert on brownouts
+        from ..server.overload import get_overload_controller
+
+        for metric in get_overload_controller().metrics():
+            try:
+                reg.register(metric)
+            except ValueError:
+                pass  # already adopted (shared registry, repeat bind)
         # compile tracker exposition (observability/device_watch.py):
         # shared by every plane/shard in the process
         for metric in compile_metrics():
@@ -694,7 +705,14 @@ class Metrics(Extension):
         if self.debug_endpoints:
             if path == "/debug/slo":
                 self.slo.maybe_sample()
-                self._serve_json(data, self.slo.status())
+                status = self.slo.status()
+                # overload ladder state rides the SLO surface: burn
+                # rates say the budget is going, the rung says what the
+                # server is already doing about it
+                from ..server.overload import get_overload_controller
+
+                status["overload"] = get_overload_controller().status()
+                self._serve_json(data, status)
             if path == "/debug/loadgen":
                 # live scenario-run timeline (docs/guides/load-testing.md):
                 # the loadgen runner narrates into a process-global

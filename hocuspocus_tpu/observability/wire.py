@@ -329,6 +329,22 @@ class WireTelemetry:
                 continue
         return total
 
+    # -- overload-controller signal reads (server/overload.py) ---------------
+
+    def queue_depth_total(self) -> int:
+        """Summed live send-queue depth (the overload ladder's
+        send_queue_depth signal; same read as the gauge)."""
+        return self._total_queue_depth()
+
+    def inbox_depth_total(self) -> int:
+        """Summed inbound replication inbox depth."""
+        return self._total_inbox_depth()
+
+    def backpressure_total(self) -> float:
+        """Cumulative watermark crossings (the ladder differentiates
+        this into a rate)."""
+        return float(sum(self.backpressure_events._values.values()))
+
     # -- pub/sub -------------------------------------------------------------
 
     def record_publish(self, delivered: int, dropped: bool = False) -> None:

@@ -15,6 +15,11 @@ MESSAGE_TOO_BIG = CloseEvent(1009, "Message Too Big")
 # "Service Restart" code — clients SHOULD reconnect (another instance,
 # or this one after restart), unlike the 4xxx application rejections
 SERVICE_RESTART = CloseEvent(1012, "Service Restart")
+# overload control plane (docs/guides/overload.md): 1013 is the
+# standard "Try Again Later" code — the server is shedding load, the
+# client should back off and reconnect (the transport overflow policy
+# and RED-state ingress enforcement both close with it)
+TRY_AGAIN_LATER = CloseEvent(1013, "Try Again Later")
 RESET_CONNECTION = CloseEvent(4205, "Reset Connection")
 UNAUTHORIZED = CloseEvent(4401, "Unauthorized")
 FORBIDDEN = CloseEvent(4403, "Forbidden")

@@ -6,6 +6,12 @@ from .document import Document
 from .hocuspocus import Hocuspocus, RequestInfo, REDIS_ORIGIN
 from .types import WAL_ORIGIN
 from .message_receiver import MessageReceiver
+from .overload import (
+    OverloadController,
+    OverloadExtension,
+    get_overload_controller,
+    resolve_tenant,
+)
 from .server import Server
 from .transports import CallbackWebSocketTransport
 from .types import Configuration, ConnectionConfiguration, Extension, Payload
@@ -21,6 +27,10 @@ __all__ = [
     "REDIS_ORIGIN",
     "WAL_ORIGIN",
     "MessageReceiver",
+    "OverloadController",
+    "OverloadExtension",
+    "get_overload_controller",
+    "resolve_tenant",
     "Server",
     "CallbackWebSocketTransport",
     "Configuration",

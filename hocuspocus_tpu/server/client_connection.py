@@ -24,6 +24,7 @@ from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
 from . import logger
 from .connection import Connection
 from .document import Document
+from .overload import get_overload_controller, resolve_tenant
 from .types import ConnectionConfiguration, Payload
 
 
@@ -248,6 +249,35 @@ class ClientConnection:
                 )
                 if auth_started is not None:
                     wire.record_auth(time.perf_counter() - auth_started, ok=True)
+                # connect/auth admission (docs/guides/overload.md):
+                # AFTER the hook chain, so a tenant stamped into the
+                # context by an auth hook is honored and an invalid
+                # token never drains a victim's bucket. RED refuses
+                # every new document channel; the tenant's connect
+                # bucket is CHARGED here — one token per channel
+                # actually established (the upgrade path only peeked).
+                # Refusal answers permission-denied (the same protocol
+                # behavior in-process embedders and websocket clients
+                # see) and un-establishes the channel so a retry can
+                # re-attempt once pressure eases.
+                overload = get_overload_controller()
+                if overload.enabled:
+                    tenant = resolve_tenant(
+                        request=self.request, context=hook_payload.context
+                    )
+                    refusal = overload.admit_connect(tenant)
+                    if refusal is not None:
+                        self.document_connections_established.discard(
+                            document_name
+                        )
+                        message = OutgoingMessage(
+                            document_name
+                        ).write_permission_denied(
+                            f"overloaded: {refusal}; "
+                            f"retry-after={overload.retry_after_s:g}s"
+                        )
+                        self.transport.send(message.to_bytes())
+                        return
                 hook_payload.connection_config.is_authenticated = True
                 message = OutgoingMessage(document_name).write_authenticated(
                     hook_payload.connection_config.read_only

@@ -2,17 +2,24 @@
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Any, Callable, Optional
 
 from ..observability.tracing import get_tracer
 from ..observability.wire import get_wire_telemetry
-from ..protocol.close_events import CloseError, CloseEvent, RESET_CONNECTION
+from ..protocol.close_events import (
+    CloseError,
+    CloseEvent,
+    RESET_CONNECTION,
+    TRY_AGAIN_LATER,
+)
 from ..protocol.message import IncomingMessage, OutgoingMessage
 from . import logger
 from .document import Document
 from .fanout import CatchupTier
 from .message_receiver import MessageReceiver
+from .overload import RED, get_overload_controller, resolve_tenant
 
 
 async def _default_async_callback(*args: Any) -> None:
@@ -48,6 +55,11 @@ class Connection:
         # is past the backpressure watermark, then heals it with one
         # SV-diff frame at drain time
         self.catchup = CatchupTier(self)
+        # admission identity (server/overload.py): resolved once — the
+        # auth hook chain has already merged its context additions by
+        # the time a Connection exists
+        self.tenant = resolve_tenant(request=request, context=context)
+        self._quota_heal_handle: Optional[object] = None
         self.document.add_connection(self)
         self.send_current_awareness()
 
@@ -98,6 +110,9 @@ class Connection:
             # a catch-up tier mid-excursion must not fire its drain
             # exit into a closing channel
             self.catchup.deactivate()
+            if self._quota_heal_handle is not None:
+                self._quota_heal_handle.cancel()
+                self._quota_heal_handle = None
             self.document.remove_connection(self)
             for callback in self.callbacks["on_close"]:
                 callback(self.document, event)
@@ -105,6 +120,22 @@ class Connection:
                 event.reason if event is not None else "Server closed the connection"
             )
             self.send(close_message.to_bytes())
+
+    def _send_quota_heal(self) -> None:
+        """Deferred quota-drop heal: one SyncStep1 after the bucket's
+        refill window, so the client's Step2 reply can actually pass."""
+        self._quota_heal_handle = None
+        if self.transport.is_closed or not self.document.has_connection(self):
+            return
+        try:
+            heal = (
+                OutgoingMessage(self.document.name)
+                .create_sync_message()
+                .write_first_sync_step_for(self.document)
+            )
+            self.send(heal.to_bytes())
+        except Exception:
+            pass
 
     def send_current_awareness(self) -> None:
         if not self.document.has_awareness_states():
@@ -115,6 +146,33 @@ class Connection:
         self.send(message.to_bytes())
 
     async def handle_message(self, data: bytes) -> None:
+        overload = get_overload_controller()
+        if overload.enabled and not overload.admit_message(self.tenant):
+            # ingress over quota: counted always; enforcement is
+            # rung-gated — at RED the channel closes 1013 (Try Again
+            # Later) so a runaway client stops feeding the event loop
+            if overload.rung >= RED:
+                self.close(TRY_AGAIN_LATER)
+                return
+            # below RED the frame is dropped, but never SILENTLY: a
+            # dropped Update would otherwise diverge forever (the
+            # client believes itself synced and never retransmits).
+            # Schedule ONE SyncStep1 for after the refill window — sent
+            # now, the client's Step2 answer would land in the same
+            # empty bucket and die with everything else; sent after
+            # refill, the Step2 re-offers everything the drops lost
+            # (state-based sync makes the re-delivery lossless, and a
+            # reply dropped anyway just re-arms the heal)
+            if self._quota_heal_handle is None:
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    loop = None
+                if loop is not None:
+                    self._quota_heal_handle = loop.call_later(
+                        1.0, self._send_quota_heal
+                    )
+            return
         message = IncomingMessage(data)
         document_name = message.read_var_string()
         if document_name != self.document.name:

@@ -65,6 +65,7 @@ from ..observability.wire import get_wire_telemetry
 from ..protocol.frames import build_update_frame
 from ..protocol.message import OutgoingMessage
 from ..protocol.sync import coalesce_updates
+from .overload import get_overload_controller
 
 
 class CatchupTier:
@@ -96,12 +97,13 @@ class CatchupTier:
     frame lands.
     """
 
-    __slots__ = ("connection", "active", "_exit_task")
+    __slots__ = ("connection", "active", "_exit_task", "_retry_handle")
 
     def __init__(self, connection) -> None:
         self.connection = connection
         self.active = False
         self._exit_task = None
+        self._retry_handle = None
 
     def maybe_enter(self) -> bool:
         """Called right AFTER a frame was enqueued to this connection —
@@ -132,10 +134,34 @@ class CatchupTier:
         and no-ops; an in-flight exit task sees the dead channel and
         drops its payload."""
         self.active = False
+        if self._retry_handle is not None:
+            self._retry_handle.cancel()
+            self._retry_handle = None
+
+    def _retry_drain(self) -> None:
+        self._retry_handle = None
+        self._on_drain()
 
     def _on_drain(self) -> None:
         if not self.active:
             return
+        overload = get_overload_controller()
+        if overload.enabled and overload.defer_catchup():
+            # BROWNOUT-2: serving the full-state catch-up frame is
+            # exactly the expensive encode the ladder exists to shed —
+            # stay in the tier (frames keep eliding, queue stays O(1))
+            # and re-check once pressure may have eased
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                pass  # sync context: proceed with the exit below
+            else:
+                overload.shed("catchup_deferred")
+                if self._retry_handle is None:
+                    self._retry_handle = loop.call_later(
+                        overload.catchup_retry_s, self._retry_drain
+                    )
+                return
         # resume streaming NOW: frames from here on are enqueued in
         # order, and anything they might depend on arrives in the
         # catch-up frame (pending-structs buffering client-side)
@@ -217,6 +243,11 @@ class DocumentFanout:
         self._pending_awareness: set[int] = set()
         self._on_complete: list[Callable[[float], Any]] = []
         self._scheduled = False
+        # BROWNOUT-1 awareness stretch (server/overload.py): an
+        # awareness-only tick may be parked on a call_later instead of
+        # call_soon; an update arriving meanwhile upgrades it back to
+        # immediate (updates never wait on the stretch)
+        self._delay_handle: Optional[asyncio.TimerHandle] = None
         # cross-instance replication seam (extensions/redis.py): when
         # set, the tick hands its LOCAL-origin updates — and, when the
         # whole tick is local, the already-built wire frame — to the
@@ -258,10 +289,28 @@ class DocumentFanout:
 
     def queue_awareness(self, changed_clients: Iterable[int]) -> None:
         self._pending_awareness.update(changed_clients)
-        self._schedule()
+        delay = 0.0
+        if not self._pending_updates:
+            # awareness-only tick: the overload ladder may stretch its
+            # cadence (presence is ephemeral — a late frame is merely
+            # stale, and the LWW encode happens at delivery time anyway)
+            delay = get_overload_controller().awareness_delay_s()
+        self._schedule(delay)
 
-    def _schedule(self) -> None:
+    def _schedule(self, delay_s: float = 0.0) -> None:
         if self._scheduled:
+            if delay_s == 0.0 and self._delay_handle is not None:
+                # an update landed while an awareness-stretch timer was
+                # parked: upgrade to an immediate tick
+                self._delay_handle.cancel()
+                self._delay_handle = None
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    self._scheduled = False
+                    self.flush()
+                    return
+                loop.call_soon(self.flush)
             return
         try:
             loop = asyncio.get_running_loop()
@@ -269,12 +318,17 @@ class DocumentFanout:
             self.flush()  # no loop (direct/test use): immediate
             return
         self._scheduled = True
-        loop.call_soon(self.flush)
+        if delay_s > 0.0:
+            get_overload_controller().shed("awareness_stretched")
+            self._delay_handle = loop.call_later(delay_s, self.flush)
+        else:
+            loop.call_soon(self.flush)
 
     # -- the tick ----------------------------------------------------------
 
     def flush(self) -> None:
         self._scheduled = False
+        self._delay_handle = None
         pending = self._pending_updates
         replicate_flags = self._pending_replicate
         awareness_clients = self._pending_awareness
@@ -346,24 +400,34 @@ class DocumentFanout:
             if awareness_clients and (
                 audience or self.replicate_awareness is not None
             ):
-                # built at delivery time: awareness is per-client LWW
-                # state, so the freshest encode wins
-                message = OutgoingMessage(
-                    document.name
-                ).create_awareness_update_message(
-                    document.awareness, list(awareness_clients)
-                )
-                data = message.to_bytes()
-                if audience:
-                    elided += self.deliver(audience, data)
-                if self.replicate_awareness is not None:
-                    # awareness piggybacks on the tick: the SAME frame
-                    # bytes cross the instance boundary (encode once,
-                    # both sides)
-                    try:
-                        self.replicate_awareness(data)
-                    except Exception:
-                        pass
+                overload = get_overload_controller()
+                if overload.enabled and overload.elide_awareness():
+                    # BROWNOUT-2: presence fan-out is pure overhead
+                    # while the ladder is shedding — drop the tick's
+                    # awareness entirely (LWW state reconverges on the
+                    # first tick after de-escalation)
+                    overload.shed(
+                        "awareness_elided", max(len(audience), 1)
+                    )
+                else:
+                    # built at delivery time: awareness is per-client
+                    # LWW state, so the freshest encode wins
+                    message = OutgoingMessage(
+                        document.name
+                    ).create_awareness_update_message(
+                        document.awareness, list(awareness_clients)
+                    )
+                    data = message.to_bytes()
+                    if audience:
+                        elided += self.deliver(audience, data)
+                    if self.replicate_awareness is not None:
+                        # awareness piggybacks on the tick: the SAME
+                        # frame bytes cross the instance boundary
+                        # (encode once, both sides)
+                        try:
+                            self.replicate_awareness(data)
+                        except Exception:
+                            pass
             if wire.enabled and elided:
                 wire.record_catchup_elided(elided)
             if callbacks:
@@ -421,6 +485,13 @@ class DocumentFanout:
 
     def close(self) -> None:
         """Drop pending work (document destroyed)."""
+        if self._delay_handle is not None:
+            # the cancelled timer would have been the flush that resets
+            # _scheduled; clear the flag too or a straggler enqueue
+            # racing destroy would park forever behind it
+            self._delay_handle.cancel()
+            self._delay_handle = None
+            self._scheduled = False
         self._pending_updates = []
         self._pending_replicate = []
         self._pending_awareness = set()

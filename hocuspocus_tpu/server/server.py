@@ -18,6 +18,11 @@ from aiohttp import WSMsgType, web
 from . import logger
 from ..protocol.close_events import MESSAGE_TOO_BIG, SERVICE_RESTART
 from .hocuspocus import Hocuspocus, RequestInfo
+from .overload import (
+    get_overload_controller,
+    resolve_tenant,
+    service_unavailable_response,
+)
 from .transports import CallbackWebSocketTransport
 from .types import Configuration, Payload
 
@@ -178,16 +183,31 @@ class Server:
         return web.Response(text="Welcome to hocuspocus-tpu!")
 
     async def _handle_websocket(self, request: web.Request):
+        overload = get_overload_controller()
         if self._draining:
             # upgrade refused with 503 + Retry-After: balancers fail the
             # health check over to another instance; direct clients back
             # off and reconnect (the provider treats any connect failure
-            # as retryable)
-            return web.Response(
-                status=503,
-                text="Draining",
-                headers={"Retry-After": "1"},
+            # as retryable). Shares the one rejection helper with
+            # RED-state admission below — identical wire behavior.
+            overload.count_drain_rejection()
+            return service_unavailable_response(
+                "draining", overload.retry_after_s
             )
+        if overload.enabled:
+            # overload control plane (docs/guides/overload.md): RED
+            # refuses every new upgrade; a tenant with an empty connect
+            # bucket is refused before the handshake is paid (peek only
+            # — the charge lands at auth)
+            tenant = resolve_tenant(
+                headers=request.headers,
+                parameters=dict(request.rel_url.query),
+            )
+            refusal = overload.admit_upgrade(tenant)
+            if refusal is not None:
+                return service_unavailable_response(
+                    refusal, overload.retry_after_s
+                )
         request_info = RequestInfo(
             headers=dict(request.headers),
             url=str(request.rel_url),

@@ -77,6 +77,11 @@ class Durability(Extension):
 
     async def on_configure(self, data: Payload) -> None:
         self._instance = data.instance
+        # overload control plane: group-commit latency feeds the
+        # ladder's wal_commit_ms signal (server/overload.py)
+        from ..server.overload import get_overload_controller
+
+        get_overload_controller().register_wal(self.wal)
 
     async def after_load_document(self, data: Payload) -> None:
         document = data.document

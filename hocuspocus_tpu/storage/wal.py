@@ -39,6 +39,7 @@ import asyncio
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Any, Iterable, Optional
 from urllib.parse import quote
@@ -466,6 +467,11 @@ class WalManager:
             "replayed_bytes": 0,
             "torn_tail_records": 0,
             "corrupt_records": 0,
+            # last group-commit duration: the overload ladder's
+            # wal_commit_ms signal (server/overload.py) — a disk that
+            # starts taking hundreds of ms per tick is backpressure the
+            # front door must see
+            "commit_last_ms": 0.0,
         }
 
     @property
@@ -562,6 +568,7 @@ class WalManager:
     def _commit(self, pending: "dict[str, list]") -> None:
         """Executor thread: write every dirty doc's batch, then make the
         whole tick durable with ONE journal fsync (tick mode)."""
+        commit_started = time.perf_counter()
         batch_records = 0
         journal_entries: "list[bytes]" = []
         journal_meta: "list[tuple[str, int, bytes]]" = []
@@ -654,6 +661,9 @@ class WalManager:
                 self._journal_rotate()
         self.stats["commit_batches"] += 1
         self.stats["commit_batch_records_last"] = batch_records
+        self.stats["commit_last_ms"] = round(
+            (time.perf_counter() - commit_started) * 1000, 3
+        )
 
     # -- commit journal (executor thread) ----------------------------------
 

@@ -293,6 +293,15 @@ class ResidencyManager:
         own so client traffic interleaves."""
         if self.paused:
             return
+        # overload ladder (server/overload.py): BROWNOUT-1 parks the
+        # maintenance sweeps — eviction snapshots and compaction are
+        # exactly the deferrable background device work the ladder
+        # exists to shed first. The park is counted; the next GREEN
+        # tick resumes where this one left off.
+        from ..server.overload import get_overload_controller
+
+        if not get_overload_controller().maintenance_allowed():
+            return
         if self.evict_idle_secs > 0 and self.extension is not None:
             now = time.monotonic()
             candidates = []

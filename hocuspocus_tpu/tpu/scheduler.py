@@ -201,6 +201,16 @@ class DeviceLane:
             "Fraction of wall time the device lane was held since start",
             fn=self._occupancy_fraction,
         )
+        # overload control plane (server/overload.py): queued lane
+        # waiters feed the ladder's lane_depth signal (weakly held —
+        # test lanes fall out on their own). Lazy import: the scheduler
+        # must stay importable without the server stack resident.
+        try:
+            from ..server.overload import get_overload_controller
+
+            get_overload_controller().register_lane(self)
+        except Exception:
+            pass
 
     # -- admission -----------------------------------------------------------
 

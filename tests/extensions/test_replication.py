@@ -416,3 +416,137 @@ async def test_mini_redis_disconnects_slow_subscriber():
         writer.close()
     finally:
         await redis.stop()
+
+
+# -- outage hardening: byte-capped outbox + partition heal (ISSUE 12) ---------
+
+
+def test_pipelined_outbox_byte_cap_sheds_oldest_publishes():
+    """During a transport outage the outbox must stay byte-bounded:
+    enqueues past `max_outbox_bytes` shed the OLDEST publishes with
+    accounting (never OOM), the newest frames survive, and the shed
+    arms the resync hook."""
+    # no running loop: publishes buffer without a flush task, exactly
+    # like an outage window between flush cycles
+    client = PipelinedRedisClient(port=1, max_outbox_bytes=2048)
+    payload = b"p" * 128
+    for i in range(64):
+        client.publish_nowait("lane", b"%03d-" % i + payload)
+    assert client.counters["dropped"] > 0
+    assert client.counters["shed_bytes"] > 0
+    assert client._outbox_bytes <= client.max_outbox_bytes
+    # oldest-first: the latest publish is still buffered, the first is gone
+    encoded = b"".join(c.encoded for c in client._outbox)
+    assert b"063-" in encoded
+    assert b"000-" not in encoded
+    assert client._needs_resync
+    # accounting closes: dropped + buffered == published
+    assert client.counters["dropped"] + len(client._outbox) == 64
+    client.close()
+
+
+async def test_pipelined_resync_fires_after_outage_heals():
+    """Publishes shed while the server is unreachable arm `on_resync`;
+    the first successful reconnect fires it exactly once (the Redis
+    extension wires this to its SyncStep1 anti-entropy exchange)."""
+    redis = await MiniRedis().start()
+    port = redis.port
+    fired = []
+    client = PipelinedRedisClient(port=port, reconnect_delay=0.01)
+    client.on_resync = lambda: fired.append(1)
+    try:
+        client.publish_nowait("lane", b"before")
+        await retryable_assertion(lambda: _assert(client.pending == 0))
+        await redis.stop()
+        # outage: these publishes are shed with accounting
+        for i in range(4):
+            client.publish_nowait("lane", b"lost-%d" % i)
+        await retryable_assertion(lambda: _assert(client.counters["dropped"] > 0))
+        assert not fired, "resync must wait for the reconnect"
+        redis = await MiniRedis(port=port).start()
+        client.publish_nowait("lane", b"after")
+        await retryable_assertion(lambda: _assert(fired == [1]))
+        # later flushes do not re-fire a consumed resync
+        client.publish_nowait("lane", b"steady")
+        await retryable_assertion(lambda: _assert(client.pending == 0))
+        assert fired == [1]
+    finally:
+        client.close()
+        await redis.stop()
+
+
+async def test_one_way_partition_accounted_and_healed_by_anti_entropy():
+    """Chaos acceptance (docs/guides/overload.md): one-way partition
+    instance A's publishes at the mini_redis hop — every dropped
+    publish is ACCOUNTED (`dropped_partition`), B diverges, and after
+    the heal the anti-entropy SyncStep1 exchange reconverges both
+    instances to byte-identical state with zero silent loss."""
+    redis = await MiniRedis().start()
+    ext_a = Redis(port=redis.port, identifier="pt-a", disconnect_delay=100)
+    ext_b = Redis(port=redis.port, identifier="pt-b", disconnect_delay=100)
+    # CI-scale anti-entropy cadence so the heal lands inside the test
+    ext_a.plane_anti_entropy_seconds = 0.2
+    ext_b.plane_anti_entropy_seconds = 0.2
+    server_a = await new_hocuspocus(extensions=[ext_a])
+    server_b = await new_hocuspocus(extensions=[ext_b])
+    provider_a = new_provider(server_a, name="part-doc")
+    provider_b = new_provider(server_b, name="part-doc")
+    try:
+        await wait_synced(provider_a, provider_b)
+        text_a = provider_a.document.get_text("t")
+        text_a.insert(0, "linked.")
+        await retryable_assertion(
+            lambda: _assert(
+                provider_b.document.get_text("t").to_string() == "linked."
+            )
+        )
+        # ONE-WAY partition: A's publishes blackhole, B->A still flows
+        redis.partition_publisher("pt-a")
+        text_a.insert(0, "dark-")
+        await retryable_assertion(
+            lambda: _assert(redis.counters["dropped_partition"] > 0)
+        )
+        # B never sees the partition-era edit (the drop is real)
+        await asyncio.sleep(0.3)
+        assert provider_b.document.get_text("t").to_string() == "linked."
+        dropped = redis.counters["dropped_partition"]
+        assert dropped > 0
+        # heal: the next change's anti-entropy exchange reconverges
+        redis.heal_partition()
+        text_a.insert(0, "healed-")
+
+        def converged():
+            sa = provider_a.document.get_text("t").to_string()
+            sb = provider_b.document.get_text("t").to_string()
+            _assert(sa == sb == "healed-dark-linked.")
+            _assert(
+                encode_state_as_update(provider_a.document)
+                == encode_state_as_update(provider_b.document)
+            )
+
+        await retryable_assertion(converged, timeout=20)
+    finally:
+        provider_a.destroy()
+        provider_b.destroy()
+        await server_a.destroy()
+        await server_b.destroy()
+        await redis.stop()
+
+
+def test_pipelined_outbox_cap_never_sheds_a_single_oversized_frame():
+    """The byte cap bounds ACCUMULATION, not single-frame size: one
+    frame larger than the whole cap must survive enqueue (shedding it
+    would loop forever — the anti-entropy heal republishes the same
+    frame), while older buffered publishes still shed around it."""
+    client = PipelinedRedisClient(port=1, max_outbox_bytes=1024)
+    client.publish_nowait("lane", b"old-" + b"x" * 256)
+    client.publish_nowait("lane", b"huge-" + b"y" * 4096)  # alone > cap
+    assert any(b"huge-" in c.encoded for c in client._outbox)
+    assert client.counters["dropped"] == 1  # the old frame, not the huge one
+    # and a lone oversized enqueue on an empty outbox is never dropped
+    client2 = PipelinedRedisClient(port=1, max_outbox_bytes=64)
+    client2.publish_nowait("lane", b"z" * 1024)
+    assert len(client2._outbox) == 1
+    assert client2.counters["dropped"] == 0
+    client.close()
+    client2.close()

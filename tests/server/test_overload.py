@@ -1,0 +1,544 @@
+"""Overload control plane (ISSUE 12, docs/guides/overload.md).
+
+Covers the hysteresis degradation ladder (immediate escalation, one
+rung down per hold window, never a flap), per-tenant token-bucket
+admission at connect/auth (a tenant over quota cannot starve another
+tenant's joins), the shared 503 + Retry-After rejection between the
+drain path and RED-state admission, the provider reconnect backoff
+ladder climbing across repeated 503s, RED-state ingress enforcement
+(close 1013), the brownout fan-out behaviors (awareness
+stretch/elision, catch-up deferral), and the /healthz + /debug/slo
+surfaces (200-always convention).
+"""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from hocuspocus_tpu.observability.wire import get_wire_telemetry
+from hocuspocus_tpu.provider import HocuspocusProvider
+from hocuspocus_tpu.provider.inprocess import InProcessProviderSocket
+from hocuspocus_tpu.server import OverloadExtension, RequestInfo
+from hocuspocus_tpu.server.overload import (
+    BROWNOUT1,
+    BROWNOUT2,
+    GREEN,
+    RED,
+    OverloadController,
+    TokenBucket,
+    get_overload_controller,
+    resolve_tenant,
+)
+
+from tests.utils import (
+    new_hocuspocus,
+    new_provider,
+    new_provider_websocket,
+    retryable_assertion,
+    wait_synced,
+)
+
+
+def _assert(cond):
+    assert cond
+
+
+@pytest.fixture(autouse=True)
+def _reset_controller():
+    """The controller is process-global: every test starts and ends at
+    a cold, disabled GREEN."""
+    controller = get_overload_controller()
+    controller.reset()
+    controller.disable()
+    yield
+    controller.reset()
+    controller.disable()
+
+
+# -- token bucket / tenancy ---------------------------------------------------
+
+
+def test_token_bucket_refill_and_burst():
+    bucket = TokenBucket(rate=10.0, burst=2)
+    now = time.monotonic()
+    assert bucket.take(now=now)
+    assert bucket.take(now=now)
+    assert not bucket.take(now=now)  # burst exhausted
+    assert bucket.peek(now=now + 0.2)  # refilled ~2 tokens
+    assert bucket.take(now=now + 0.2)
+    # rate<=0 is unlimited
+    assert all(TokenBucket(0, 1).take() for _ in range(100))
+
+
+def test_resolve_tenant_precedence():
+    assert resolve_tenant() == "default"
+    assert resolve_tenant(headers={"x-tenant": "acme"}) == "acme"
+    assert resolve_tenant(parameters={"tenant": "qp"}) == "qp"
+    assert (
+        resolve_tenant(context={"tenant": "ctx"}, headers={"x-tenant": "h"})
+        == "ctx"
+    )
+    request = RequestInfo(headers={"x-tenant": "hdr"}, url="/?tenant=qp")
+    assert resolve_tenant(request=request) == "hdr"
+
+
+# -- the ladder ---------------------------------------------------------------
+
+
+def test_ladder_escalates_immediately_and_descends_one_rung_per_hold():
+    controller = OverloadController()
+    controller.configure(hold_s=0.05).enable()
+    controller.inject_pressure(3)
+    assert controller.rung == RED, "escalation must be immediate"
+    controller.inject_pressure(0)
+    assert controller.rung == RED, "de-escalation must wait out the hold"
+    rungs = [controller.rung]
+    for _ in range(3):
+        time.sleep(0.06)
+        controller.sample()
+        rungs.append(controller.rung)
+    assert rungs == [RED, BROWNOUT2, BROWNOUT1, GREEN], rungs
+    # the transition history is the monotonic descent, no flapping
+    path = [(t["from_rung"], t["to_rung"]) for t in controller.transitions]
+    assert path == [
+        ("green", "red"),
+        ("red", "brownout2"),
+        ("brownout2", "brownout1"),
+        ("brownout1", "green"),
+    ]
+
+
+def test_ladder_oscillating_signal_never_flaps():
+    """A signal bouncing across the BROWNOUT-1 threshold within the
+    hold window must hold the rung steady (the hysteresis guarantee)."""
+    controller = OverloadController()
+    controller.configure(hold_s=10.0).enable()
+    for _ in range(20):
+        controller.inject_pressure(1)  # at threshold
+        controller.inject_pressure(0.5)  # below it (hold re-arms)
+    assert controller.rung == BROWNOUT1
+    assert len(controller.transitions) == 1, "one escalation, zero flaps"
+
+
+def test_connect_quota_isolated_per_tenant():
+    """Tenant A exhausting its connect bucket cannot starve tenant B."""
+    controller = OverloadController()
+    controller.configure(connect_rate=0.001, connect_burst=2).enable()
+    assert controller.admit_connect("a") is None
+    assert controller.admit_connect("a") is None
+    assert controller.admit_connect("a") == "tenant-quota"
+    # B's bucket is untouched
+    assert controller.admit_connect("b") is None
+    # upgrade-path PEEK does not consume B's remaining budget...
+    assert controller.admit_upgrade("b") is None
+    assert controller.admit_connect("b") is None  # ...so this still admits
+    assert controller.admit_upgrade("a") == "tenant-quota"
+
+
+# -- connect/auth admission through the real handshake ------------------------
+
+
+async def _join(server, name, tenant):
+    """Attach a provider under `tenant`; returns (provider, socket,
+    outcome) where outcome is 'synced' or 'denied'."""
+    socket = InProcessProviderSocket(
+        server, request=RequestInfo(headers={"x-tenant": tenant})
+    )
+    provider = HocuspocusProvider(name=name, websocket_provider=socket)
+    denied = asyncio.Event()
+    provider.on("authentication_failed", lambda *a: denied.set())
+    provider.attach()
+    for _ in range(500):
+        if provider.synced:
+            return provider, socket, "synced"
+        if denied.is_set():
+            return provider, socket, "denied"
+        await asyncio.sleep(0.01)
+    return provider, socket, "timeout"
+
+
+async def test_tenant_quota_rejects_without_starving_other_tenants():
+    server = await new_hocuspocus(
+        extensions=[OverloadExtension(connect_rate=0.001, connect_burst=2)]
+    )
+    cleanup = []
+    try:
+        outcomes_a = []
+        for i in range(3):
+            provider, socket, outcome = await _join(server, f"doc-a{i}", "a")
+            cleanup.append((provider, socket))
+            outcomes_a.append(outcome)
+        assert outcomes_a == ["synced", "synced", "denied"]
+        # tenant B joins fine AFTER A was refused
+        provider, socket, outcome = await _join(server, "doc-b", "b")
+        cleanup.append((provider, socket))
+        assert outcome == "synced"
+        controller = get_overload_controller()
+        assert controller.rejected_total.value(
+            scope="connect", reason="tenant_quota"
+        ) == 1
+    finally:
+        for provider, socket in cleanup:
+            provider.destroy()
+            socket.destroy()
+        await server.destroy()
+
+
+async def test_red_refuses_new_channels_but_keeps_existing_ones():
+    server = await new_hocuspocus(extensions=[OverloadExtension()])
+    cleanup = []
+    try:
+        provider, socket, outcome = await _join(server, "doc-ok", "t")
+        cleanup.append((provider, socket))
+        assert outcome == "synced"
+        get_overload_controller().inject_pressure(3)  # RED
+        provider2, socket2, outcome2 = await _join(server, "doc-red", "t")
+        cleanup.append((provider2, socket2))
+        assert outcome2 == "denied"
+        # the established channel keeps working at RED (admitted work
+        # is never shed)
+        text = provider.document.get_text("t")
+        text.insert(0, "still-served")
+        await retryable_assertion(
+            lambda: _assert(
+                server.hocuspocus.documents["doc-ok"]
+                .get_text("t")
+                .to_string()
+                == "still-served"
+            )
+        )
+    finally:
+        for provider, socket in cleanup:
+            provider.destroy()
+            socket.destroy()
+        await server.destroy()
+
+
+# -- the shared 503 + Retry-After rejection -----------------------------------
+
+
+async def _upgrade_503(server) -> "tuple[int, str]":
+    """Attempt a websocket upgrade; returns (status, retry_after)."""
+    async with aiohttp.ClientSession() as session:
+        try:
+            ws = await session.ws_connect(server.web_socket_url)
+        except aiohttp.WSServerHandshakeError as error:
+            return error.status, error.headers.get("Retry-After", "")
+        await ws.close()
+        return 101, ""
+
+
+async def test_red_and_drain_emit_identical_503_rejections():
+    """The satellite contract: RED-state admission and Server.drain()
+    share one rejection helper — same status, same Retry-After."""
+    server = await new_hocuspocus(extensions=[OverloadExtension()])
+    try:
+        get_overload_controller().inject_pressure(3)
+        red_status, red_retry = await _upgrade_503(server)
+        assert (red_status, red_retry) == (503, "1")
+        controller = get_overload_controller()
+        assert controller.rejected_total.value(scope="upgrade", reason="red") == 1
+        get_overload_controller().inject_pressure(0)
+        controller.reset()  # back to GREEN so only drain rejects below
+        await server.drain(timeout_secs=0.5)
+        drain_status, drain_retry = await _upgrade_503(server)
+        assert (drain_status, drain_retry) == (red_status, red_retry)
+        assert (
+            controller.rejected_total.value(scope="upgrade", reason="draining")
+            == 1
+        )
+    finally:
+        await server.destroy()
+
+
+async def test_provider_backoff_ladder_keeps_climbing_across_503s():
+    """Repeated 503s must climb the reconnect ladder — no
+    thundering-herd re-dial at a fixed floor (the PR-9 flap ladder
+    extended to quota rejections)."""
+    server = await new_hocuspocus(extensions=[OverloadExtension()])
+    get_overload_controller().inject_pressure(3)  # RED: every upgrade 503s
+    socket = new_provider_websocket(server)
+    attempts: list[int] = []
+
+    def recording_backoff(attempt: int) -> float:
+        attempts.append(attempt)
+        return 0.01
+
+    socket._backoff_delay = recording_backoff
+    provider = HocuspocusProvider(name="doc-backoff", websocket_provider=socket)
+    try:
+        provider.attach()
+        await retryable_assertion(lambda: _assert(len(attempts) >= 4))
+        # strictly climbing: each consecutive failure raises the ladder
+        assert attempts == sorted(attempts)
+        assert attempts[-1] > attempts[0]
+        assert not provider.synced
+    finally:
+        provider.destroy()
+        socket.destroy()
+        await server.destroy()
+
+
+# -- message-ingress enforcement ----------------------------------------------
+
+
+async def test_ingress_quota_closes_1013_at_red():
+    wire = get_wire_telemetry()
+    wire.enable()
+    closes_before = wire.channel_closes.value(code="1013")
+    server = await new_hocuspocus(
+        extensions=[OverloadExtension(message_rate=0.001, message_burst=3)]
+    )
+    provider, socket, outcome = await _join(server, "doc-ingress", "t")
+    try:
+        assert outcome == "synced"
+        controller = get_overload_controller()
+        controller.inject_pressure(3)  # RED
+        # burn through the burst: each edit ships at least one frame
+        for i in range(8):
+            provider.document.get_text("t").insert(0, "x")
+            await asyncio.sleep(0.01)
+        await retryable_assertion(
+            lambda: _assert(
+                wire.channel_closes.value(code="1013") > closes_before
+            )
+        )
+        assert controller.rejected_total.value(
+            scope="message", reason="tenant_quota"
+        ) > 0
+    finally:
+        provider.destroy()
+        socket.destroy()
+        await server.destroy()
+
+
+# -- brownout fan-out behaviors -----------------------------------------------
+
+
+async def test_brownout2_elides_awareness_fanout():
+    server = await new_hocuspocus(extensions=[OverloadExtension()])
+    provider_a, socket_a, _ = await _join(server, "doc-aw", "t")
+    provider_b, socket_b, _ = await _join(server, "doc-aw", "t")
+    try:
+        controller = get_overload_controller()
+        shed_before = controller.shed_total.value(reason="awareness_elided")
+        controller.inject_pressure(2)  # BROWNOUT-2
+        provider_a.set_awareness_field("cursor", {"pos": 1})
+        await retryable_assertion(
+            lambda: _assert(
+                controller.shed_total.value(reason="awareness_elided")
+                > shed_before
+            )
+        )
+        # de-escalate and prove presence reconverges
+        controller.inject_pressure(0)
+        controller.reset()
+        controller.enable()
+        provider_a.set_awareness_field("cursor", {"pos": 2})
+
+        def b_sees_cursor():
+            states = provider_b.awareness.get_states()
+            _assert(
+                any(
+                    (state or {}).get("cursor") == {"pos": 2}
+                    for state in states.values()
+                )
+            )
+
+        await retryable_assertion(b_sees_cursor)
+    finally:
+        for provider, socket in (
+            (provider_a, socket_a),
+            (provider_b, socket_b),
+        ):
+            provider.destroy()
+            socket.destroy()
+        await server.destroy()
+
+
+async def test_brownout1_stretches_awareness_tick():
+    server = await new_hocuspocus(extensions=[OverloadExtension()])
+    provider_a, socket_a, _ = await _join(server, "doc-st", "t")
+    provider_b, socket_b, _ = await _join(server, "doc-st", "t")
+    try:
+        controller = get_overload_controller()
+        stretched_before = controller.shed_total.value(
+            reason="awareness_stretched"
+        )
+        controller.inject_pressure(1)  # BROWNOUT-1
+        provider_a.set_awareness_field("cursor", {"pos": 9})
+        await retryable_assertion(
+            lambda: _assert(
+                controller.shed_total.value(reason="awareness_stretched")
+                > stretched_before
+            )
+        )
+
+        # the stretched tick still DELIVERS (deferred, not dropped)
+        def b_sees_cursor():
+            states = provider_b.awareness.get_states()
+            _assert(
+                any(
+                    (state or {}).get("cursor") == {"pos": 9}
+                    for state in states.values()
+                )
+            )
+
+        await retryable_assertion(b_sees_cursor)
+    finally:
+        for provider, socket in (
+            (provider_a, socket_a),
+            (provider_b, socket_b),
+        ):
+            provider.destroy()
+            socket.destroy()
+        await server.destroy()
+
+
+async def test_brownout2_defers_catchup_exit_until_pressure_eases():
+    """A catch-up tier drain at BROWNOUT-2 must stay in elision and
+    retry; the exit proceeds once the ladder descends."""
+    from hocuspocus_tpu.server.document import Document
+    from hocuspocus_tpu.server.fanout import CatchupTier
+
+    controller = get_overload_controller()
+    controller.configure(hold_s=0.02, catchup_retry_s=0.05).enable()
+    document = Document("catchup-doc")
+
+    class _Transport:
+        is_closed = False
+
+    class _Conn:
+        transport = _Transport()
+
+    connection = _Conn()
+    connection.document = document
+    tier = CatchupTier(connection)
+    tier.active = True
+    controller.inject_pressure(2)  # BROWNOUT-2
+    deferred_before = controller.shed_total.value(reason="catchup_deferred")
+    tier._on_drain()
+    assert tier.active, "exit must be deferred at BROWNOUT-2"
+    assert (
+        controller.shed_total.value(reason="catchup_deferred")
+        > deferred_before
+    )
+    assert tier._retry_handle is not None
+    controller.inject_pressure(0)
+    for _ in range(3):
+        await asyncio.sleep(0.03)
+        controller.sample()
+    await retryable_assertion(lambda: _assert(not tier.active), timeout=3)
+
+
+# -- health / debug surfaces --------------------------------------------------
+
+
+async def test_healthz_always_200_and_carries_rung_plus_shed_reasons():
+    """The repo-wide /healthz convention: degraded still answers 200 —
+    the body carries the ladder rung and active shed reasons."""
+    from hocuspocus_tpu.observability import Metrics
+
+    server = await new_hocuspocus(
+        extensions=[Metrics(), OverloadExtension()]
+    )
+    try:
+        controller = get_overload_controller()
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/healthz") as response:
+                assert response.status == 200
+                body = await response.json()
+                assert body["status"] == "ok"
+            controller.inject_pressure(2)
+            controller.shed("awareness_elided")
+            async with session.get(f"{server.http_url}/healthz") as response:
+                assert response.status == 200, "degraded must still be 200"
+                body = await response.json()
+                assert body["status"] == "degraded"
+                section = body["extensions"]["OverloadExtension"]
+                assert section["rung"] == 2
+                assert section["state"] == "brownout2"
+                assert "awareness_elided" in section["shed_reasons"]
+            async with session.get(f"{server.http_url}/debug/slo") as response:
+                assert response.status == 200
+                body = await response.json()
+                assert body["overload"]["state"] == "brownout2"
+                assert body["overload"]["signals"]["injected"]["rung"] == 2
+    finally:
+        await server.destroy()
+
+
+async def test_overload_metrics_exposed():
+    from hocuspocus_tpu.observability import Metrics
+
+    server = await new_hocuspocus(extensions=[Metrics(), OverloadExtension()])
+    try:
+        get_overload_controller().inject_pressure(1)
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/metrics") as response:
+                text = await response.text()
+        assert "hocuspocus_overload_state 1" in text
+        assert 'hocuspocus_overload_transitions_total{' in text
+        assert 'hocuspocus_overload_signal{signal="injected"}' in text
+    finally:
+        await server.destroy()
+
+
+async def test_soft_quota_drop_heals_via_sync_step1():
+    """Below RED an over-quota frame is dropped but never silently:
+    the server answers with a rate-limited SyncStep1, the client's
+    Step2 reply re-offers what the drops lost, and the document
+    reconverges once the bucket refills."""
+    server = await new_hocuspocus(
+        extensions=[OverloadExtension(message_rate=5.0, message_burst=2)]
+    )
+    provider, socket, outcome = await _join(server, "doc-heal", "t")
+    try:
+        assert outcome == "synced"
+        text = provider.document.get_text("t")
+        # burst well past the bucket at GREEN: some frames are dropped
+        for i in range(8):
+            text.insert(len(text), chr(ord("a") + i))
+            await asyncio.sleep(0.005)
+        controller = get_overload_controller()
+        await retryable_assertion(
+            lambda: _assert(
+                controller.shed_total.value(reason="messages_throttled") > 0
+            )
+        )
+        # the heal exchange recovers every dropped edit server-side
+        await retryable_assertion(
+            lambda: _assert(
+                server.hocuspocus.documents["doc-heal"]
+                .get_text("t")
+                .to_string()
+                == "abcdefgh"
+            ),
+            timeout=15,
+        )
+    finally:
+        provider.destroy()
+        socket.destroy()
+        await server.destroy()
+
+
+async def test_fanout_close_with_parked_awareness_timer_unwedges():
+    """close() while an awareness-stretch timer is parked must reset
+    the tick flag — a straggler enqueue racing destroy would otherwise
+    park forever behind a cancelled timer."""
+    from hocuspocus_tpu.server.document import Document
+
+    controller = get_overload_controller()
+    controller.configure(awareness_stretch_ms=5000.0).enable()
+    controller.inject_pressure(1)  # BROWNOUT-1: awareness ticks park
+    document = Document("fanout-close-doc")
+    fanout = document.fanout
+    fanout.queue_awareness([1])
+    assert fanout._delay_handle is not None
+    assert fanout._scheduled
+    fanout.close()
+    assert fanout._delay_handle is None
+    assert not fanout._scheduled, "a cancelled parked tick must not wedge"
+    document.destroy()

@@ -200,3 +200,31 @@ def test_probe_cache_keeps_live_backend(monkeypatch):
     assert bench._probe(None) == "tpu"
     assert len(calls) == 1
     bench._probe_cache.clear()
+
+
+def test_gate_extracts_overload_storm_interactive_p99():
+    """The overload_storm storm-phase p99 (interactive latency while
+    the ladder sheds) is a gated stage, compared across rounds like any
+    other."""
+    payload = _artifact()
+    payload["extra"]["scenario_suite"] = {
+        "verdict": "pass",
+        "scenarios": {
+            "overload_storm": {
+                "verdict": "pass",
+                "breached": [],
+                "phase_p99_ms": {"calm": 2.0, "storm": 5.0, "recover": 2.0},
+            }
+        },
+    }
+    stages = bench_gate.stage_p99s(payload)
+    assert stages["overload_storm.interactive_p99"] == 5.0
+    # a regressed storm p99 fails the pairwise compare
+    current = json.loads(json.dumps(payload))
+    current["extra"]["scenario_suite"]["scenarios"]["overload_storm"][
+        "phase_p99_ms"
+    ]["storm"] = 50.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert any("overload_storm.interactive_p99" in r for r in regressions)

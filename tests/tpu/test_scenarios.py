@@ -310,3 +310,81 @@ async def test_storm_scenario_composed_mix():
     assert result["extra"]["ops_measured"] > 0
     for health in result["extra"]["plane_health"]:
         assert health["cpu_fallbacks"] == 0
+
+
+# -- overload_storm / partition_heal (ISSUE 12) -------------------------------
+
+
+async def test_overload_storm_scenario_sheds_and_recovers_hysteresis_clean():
+    """The overload-control acceptance run: injected RED pressure lands
+    with a join wave — the ladder rejects the joins (shed/reject
+    counters nonzero) while interactive edit p99 holds (the verdict
+    stays pass), and recovery walks back to GREEN one rung per hold
+    window with zero flapping."""
+    from hocuspocus_tpu.server.overload import get_overload_controller
+
+    recorder = get_flight_recorder()
+    overload_events_before = len(recorder.events("__overload__"))
+    schedule = get_scenario("overload_storm", hold_s=0.05).compile(seed=7)
+    runner = ScenarioRunner(schedule, time_scale=3.0)
+    result = await runner.run()
+
+    assert result["verdict"] == "pass", result["slo"]["breached_targets"]
+    # load actually exceeded capacity: the joins were sacrificed
+    storm = next(p for p in result["phases"] if p["name"] == "storm")
+    assert storm["failed_ops"] > 0, "RED must have rejected the join wave"
+    assert storm["latency_p99_ms"] is not None
+    overload = result["extra"]["overload"]
+    assert overload["shed"].get("connects_rejected", 0) > 0
+
+    # hysteresis-clean recovery: strictly monotonic descent back to
+    # GREEN — one escalation to red, then one rung down per hold
+    # window, never a re-escalation or flap
+    path = [(t["from_rung"], t["to_rung"]) for t in overload["transitions"]]
+    assert path == [
+        ("green", "red"),
+        ("red", "brownout2"),
+        ("brownout2", "brownout1"),
+        ("brownout1", "green"),
+    ], path
+    # the same story in the flight recorder's __overload__ ring
+    ring = [
+        (event["from_rung"], event["to_rung"])
+        for event in recorder.events("__overload__")[overload_events_before:]
+        if event["event"] == "rung_change"
+    ]
+    assert ring == path
+    # teardown left the process-global controller cold for the next run
+    controller = get_overload_controller()
+    assert not controller.enabled
+    assert controller.rung == 0
+
+
+async def test_partition_heal_scenario_converges_byte_identically():
+    """The chaos acceptance run: a one-way mini_redis partition drops
+    instance A's publishes (every drop accounted), edits keep flowing,
+    and after the heal the anti-entropy exchange reconverges both
+    instances byte-identically — the runner latches the verdict on
+    convergence, so pass IS the zero-silent-loss proof."""
+    schedule = get_scenario("partition_heal").compile(seed=7)
+    runner = ScenarioRunner(schedule, time_scale=3.0)
+    result = await runner.run()
+
+    assert result["verdict"] == "pass", result["slo"]["breached_targets"]
+    convergence = result["extra"]["convergence"]
+    assert convergence["converged"] is True
+    assert convergence["diverged"] == []
+    assert convergence["docs_checked"] == schedule.population["sampled"]
+    # the partition was real AND accounted: publishes were blackholed
+    assert result["extra"]["mini_redis"]["dropped_partition"] > 0
+    # the healed phase measured real edits (their latency includes the
+    # anti-entropy heal) and none failed
+    healed = next(p for p in result["phases"] if p["name"] == "healed")
+    assert healed["measured_ops"] > 0
+    assert healed["failed_ops"] == 0
+    # the partitioned phase deliberately measured nothing (its
+    # observation channel was dead by design)
+    partitioned = next(
+        p for p in result["phases"] if p["name"] == "partitioned"
+    )
+    assert partitioned["measured_ops"] == 0

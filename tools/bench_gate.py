@@ -8,7 +8,10 @@ files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms`,
 `extra.replica_storm.merge_to_remote_broadcast_p99_ms`, the adaptive
 scheduler's `extra.mixed_load.governor_on.interactive_p99_ms`
 (interactive merge→broadcast under concurrent hydration+compaction
-with the lane arbiter + governor on), and the durability plane's
+with the lane arbiter + governor on), the overload control plane's
+`extra.scenario_suite.scenarios.overload_storm.phase_p99_ms.storm`
+(gated as `overload_storm.interactive_p99`: interactive edit p99 while
+the brownout ladder is at RED and shedding), and the durability plane's
 `extra.wal_load.append_p99_ms` +
 `extra.wal_load.wal_on.merge_to_last_write_p99_ms` — and exits nonzero
 when any stage regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
@@ -120,6 +123,17 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
             p99 = governor_on.get("interactive_p99_ms")
             if isinstance(p99, (int, float)) and not isinstance(p99, bool):
                 stages["mixed_load.interactive_p99"] = float(p99)
+    suite = extra.get("scenario_suite")
+    if isinstance(suite, dict):
+        # shed-mode interactive latency: the overload_storm scenario's
+        # storm-phase p99 is measured WHILE the ladder is at RED and
+        # shedding — a regression here means brownout mode stopped
+        # protecting the interactive path
+        storm = (suite.get("scenarios") or {}).get("overload_storm")
+        if isinstance(storm, dict):
+            p99 = (storm.get("phase_p99_ms") or {}).get("storm")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["overload_storm.interactive_p99"] = float(p99)
     wal = extra.get("wal_load")
     if isinstance(wal, dict):
         append_p99 = wal.get("append_p99_ms")
