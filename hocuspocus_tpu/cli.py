@@ -17,6 +17,56 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--port", "-p", type=int, default=1234, help="port to listen on")
     parser.add_argument("--host", default="0.0.0.0", help="host to bind")
+    # edge tier + cell router (docs/guides/edge-routing.md): split the
+    # million-connection front door from the merge cells. An 'edge'
+    # terminates websockets, authenticates/admits at the door and
+    # relays frames to each doc's owning cell over the pipelined RESP
+    # lane; a 'cell' is a normal serving instance that also accepts
+    # relayed edge sessions and announces its lifecycle (up/draining/
+    # down) on the relay control channel; 'monolith' (default) is the
+    # classic single-role server.
+    parser.add_argument(
+        "--role",
+        choices=("monolith", "edge", "cell"),
+        default="monolith",
+        help="process role: 'monolith' (default) terminates sockets AND "
+        "merges; 'edge' is a stateless front door relaying to cells; "
+        "'cell' merges docs and serves relayed edge sessions "
+        "(docs/guides/edge-routing.md)",
+    )
+    parser.add_argument(
+        "--cell-id",
+        help="stable cell identity on the relay bus (role=cell; default "
+        "cell-<port>) — the rendezvous-hash key docs map to, so keep it "
+        "stable across restarts",
+    )
+    parser.add_argument(
+        "--edge-id",
+        help="edge identity on the relay bus (role=edge; default a "
+        "random edge-<hex> — edges are stateless, identity is per-boot)",
+    )
+    parser.add_argument(
+        "--relay-redis-host",
+        default="127.0.0.1",
+        help="redis host backing the edge<->cell relay lane (default "
+        "127.0.0.1)",
+    )
+    parser.add_argument(
+        "--relay-redis-port", type=int, default=6379, help="relay redis port"
+    )
+    parser.add_argument(
+        "--relay-prefix",
+        default="hocuspocus-edge",
+        help="channel prefix for the relay lane + control channel",
+    )
+    parser.add_argument(
+        "--relay-queue-limit",
+        type=int,
+        default=1024,
+        help="frames a parked/re-establishing edge doc channel may "
+        "buffer before the oldest is shed (accounted, healed by the "
+        "rebind resync; default 1024)",
+    )
     parser.add_argument("--webhook", "-w", help="webhook URL to POST document changes to")
     parser.add_argument(
         "--sqlite",
@@ -395,6 +445,17 @@ async def run(args: argparse.Namespace) -> None:
         )
     if args.webhook:
         extensions.append(Webhook(url=args.webhook))
+    if args.role == "cell":
+        from .edge import CellIngressExtension
+
+        extensions.append(
+            CellIngressExtension(
+                cell_id=args.cell_id or f"cell-{args.port}",
+                host=args.relay_redis_host,
+                port=args.relay_redis_port,
+                prefix=args.relay_prefix,
+            )
+        )
     if args.tpu_merge or args.tpu_serve:
         # importing .tpu pins the backend to CPU when JAX_PLATFORMS=cpu
         # (see hocuspocus_tpu/tpu/__init__.py). The supervised extension
@@ -428,14 +489,33 @@ async def run(args: argparse.Namespace) -> None:
             )
         )
 
-    server = Server(
-        Configuration(
-            extensions=extensions,
-            quiet=False,
-            store_retries=max(args.store_retries, 0),
-            drain_timeout_secs=args.drain_timeout_secs,
-        )
+    configuration = Configuration(
+        extensions=extensions,
+        quiet=False,
+        store_retries=max(args.store_retries, 0),
+        drain_timeout_secs=args.drain_timeout_secs,
+        # the drain/RED/edge 503 paths share one Retry-After knob even
+        # with the overload controller off (three-way wire parity)
+        retry_after_s=args.overload_retry_after,
     )
+    if args.role == "edge":
+        # the stateless front door: no documents, no merge plane — just
+        # door auth/admission and the relay fabric. Doc-serving flags
+        # (--sqlite/--wal-dir/--tpu-*) are inert here by construction.
+        from .edge import EdgeGatewayExtension, EdgeServer
+
+        extensions.append(
+            EdgeGatewayExtension(
+                edge_id=args.edge_id,
+                host=args.relay_redis_host,
+                port=args.relay_redis_port,
+                prefix=args.relay_prefix,
+                relay_queue_limit=args.relay_queue_limit,
+            )
+        )
+        server = EdgeServer(configuration)
+    else:
+        server = Server(configuration)
     await server.listen(port=args.port, host=args.host)
 
     stop = asyncio.Event()

@@ -1,0 +1,308 @@
+"""Cell-side edge-session ingress.
+
+A merge cell is an ordinary server (planes, WAL, overload ladder — the
+whole stack) whose clients arrive over the relay lane instead of
+websockets. `CellIngressExtension` subscribes to the cell's relay
+channel and turns each OPEN envelope into a real session through
+`Hocuspocus.handle_connection`: the same `ClientConnection` auth
+handshake, the same per-doc `Connection`s, and — the point — the same
+`DocumentFanout`, so the PR-7 encode-once broadcast tick serves edge
+sessions as plain audience members (one merged frame, one audience
+snapshot, catch-up tiering for a slow edge, WAL delivery gates intact).
+
+Outbound frames ride a `CallbackWebSocketTransport` whose writer
+enqueues onto the pipelined RESP client — N frames in one event-loop
+tick leave as ONE write+drain, the PR-8 lane economics applied to the
+edge hop.
+
+Lifecycle on the control channel: `CELL_UP` announces (and re-announces
+on a heartbeat cadence — the router's liveness signal), the PR-9
+graceful drain fires the new `on_drain` hook which announces
+`CELL_DRAINING` BEFORE stores begin (edges remap and re-establish while
+the old cell is still flushing), and `on_destroy` announces
+`CELL_DOWN`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from ..aio import spawn_tracked
+from ..net.resp import PipelinedRedisClient, RedisSubscriber
+from ..observability.flight_recorder import get_flight_recorder
+from ..server import logger
+from ..server.hocuspocus import RequestInfo
+from ..server.transports import CallbackWebSocketTransport
+from ..server.types import Extension, Payload
+from . import relay
+from .relay import DEFAULT_PREFIX
+
+
+class _CellEdgeSession:
+    """One relay session: a synthetic transport + the real server-side
+    session pipeline, with an ordered inbound pump (frames must apply
+    in relay order or the auth/sync handshake interleaves)."""
+
+    def __init__(
+        self, ext: "CellIngressExtension", session_id: str, edge_id: str, aux: dict
+    ) -> None:
+        self.ext = ext
+        self.session_id = session_id
+        self.edge_id = edge_id
+        self._closed = False
+        self._queue: "asyncio.Queue[Optional[bytes]]" = asyncio.Queue()
+        headers = {"x-hocuspocus-edge": edge_id}
+        context: dict = {"edge": edge_id}
+        tenant = aux.get("tenant")
+        if tenant:
+            headers["x-tenant"] = str(tenant)
+            context["tenant"] = str(tenant)
+        self.transport = CallbackWebSocketTransport(
+            send_async=self._send_to_edge,
+            close_async=self._closed_by_server,
+        )
+        self.client = ext.instance.handle_connection(
+            self.transport,
+            RequestInfo(headers=headers, url="/__edge__", remote=edge_id),
+            context,
+        )
+        self._pump_task = asyncio.ensure_future(self._pump())
+
+    # -- inbound (edge -> cell) --------------------------------------------
+
+    def feed(self, payload: bytes) -> None:
+        if not self._closed:
+            self._queue.put_nowait(payload)
+
+    async def _pump(self) -> None:
+        while True:
+            payload = await self._queue.get()
+            if payload is None:
+                return
+            try:
+                await self.client.handle_message(payload)
+            except Exception as error:
+                logger.log_error(
+                    f"[edge-cell] session {self.session_id} frame failed: {error!r}"
+                )
+                self.close(1011, "internal error")
+                return
+
+    def detach(self, document_name: str) -> None:
+        """Close ONE doc channel (the edge remapped it elsewhere); the
+        rest of the session keeps flowing."""
+        connection = self.client.document_connections.get(document_name)
+        if connection is not None:
+            connection.close()
+
+    # -- outbound (cell -> edge) -------------------------------------------
+
+    async def _send_to_edge(self, data: bytes) -> None:
+        self.ext.publish_to_edge(
+            self.edge_id, relay.encode_envelope(relay.FRAME, self.session_id, "", data)
+        )
+        self.ext.counters["frames_out"] += 1
+
+    async def _closed_by_server(self, code: int, reason: str) -> None:
+        """The server side closed the session (drain 1012, overflow
+        1013, destroy): tell the edge so it can re-establish on another
+        cell instead of waiting on a dead channel."""
+        self.ext.publish_to_edge(
+            self.edge_id,
+            relay.encode_envelope(
+                relay.CLOSED, self.session_id, f"{code}:{reason}"
+            ),
+        )
+        self._finish(code, reason)
+
+    # -- teardown ----------------------------------------------------------
+
+    def close(self, code: int = 1000, reason: str = "edge closed") -> None:
+        self.transport.abort()
+        self._finish(code, reason)
+
+    def _finish(self, code: int, reason: str) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put_nowait(None)
+        self.ext.sessions.pop(self.session_id, None)
+        spawn_tracked(
+            self.ext._tasks, self.client.handle_transport_close(code, reason)
+        )
+
+
+class CellIngressExtension(Extension):
+    """Makes this server a merge cell: relay-session ingress + the
+    control-channel lifecycle (announce/heartbeat/drain/down)."""
+
+    # before ordinary extensions so the announce machinery configures
+    # early, after Metrics (1000) so telemetry is lit first
+    priority = 950
+
+    def __init__(
+        self,
+        cell_id: str,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        prefix: str = DEFAULT_PREFIX,
+        create_client: Optional[Any] = None,
+        create_subscriber: Optional[Any] = None,
+        announce_interval_s: float = 2.0,
+    ) -> None:
+        self.cell_id = cell_id
+        self.prefix = prefix
+        self.announce_interval_s = announce_interval_s
+        self.instance = None
+        self.draining = False
+        self.sessions: "dict[str, _CellEdgeSession]" = {}
+        self.counters = {
+            "sessions_opened": 0,
+            "sessions_closed": 0,
+            "frames_in": 0,
+            "frames_out": 0,
+            "detaches": 0,
+            "refused_draining": 0,
+        }
+        self._tasks: set = set()
+        self._announce_handle: Optional[asyncio.TimerHandle] = None
+        if create_client is not None:
+            self.pub = create_client()
+        else:
+            self.pub = PipelinedRedisClient(host, port)
+        if create_subscriber is not None:
+            self.sub = create_subscriber(self._on_message)
+        else:
+            self.sub = RedisSubscriber(host, port, on_message=self._on_message)
+
+    # -- wiring -------------------------------------------------------------
+
+    def publish_to_edge(self, edge_id: str, envelope: bytes) -> None:
+        nowait = getattr(self.pub, "publish_nowait", None)
+        if nowait is not None:
+            nowait(relay.edge_channel(self.prefix, edge_id), envelope)
+        else:
+            spawn_tracked(
+                self._tasks,
+                self.pub.publish(relay.edge_channel(self.prefix, edge_id), envelope),
+            )
+
+    def _announce(self, kind: int) -> None:
+        envelope = relay.encode_envelope(kind, self.cell_id)
+        channel = relay.control_channel(self.prefix)
+        nowait = getattr(self.pub, "publish_nowait", None)
+        if nowait is not None:
+            nowait(channel, envelope)
+        else:
+            spawn_tracked(self._tasks, self.pub.publish(channel, envelope))
+
+    def _schedule_announce(self) -> None:
+        if self.draining:
+            return
+        loop = asyncio.get_event_loop()
+        self._announce_handle = loop.call_later(
+            self.announce_interval_s, self._heartbeat
+        )
+
+    def _heartbeat(self) -> None:
+        self._announce_handle = None
+        if self.draining:
+            return
+        self._announce(relay.CELL_UP)
+        self._schedule_announce()
+
+    # -- hooks ---------------------------------------------------------------
+
+    async def on_configure(self, data: Payload) -> None:
+        self.instance = data.instance
+
+    async def on_listen(self, data: Payload) -> None:
+        await self.sub.subscribe(relay.cell_channel(self.prefix, self.cell_id))
+        self._announce(relay.CELL_UP)
+        self._schedule_announce()
+        get_flight_recorder().record("__edge__", "cell_up", cell=self.cell_id)
+
+    async def on_drain(self, data: Payload) -> None:
+        """PR-9 graceful drain announces departure FIRST: edges remap
+        this cell's docs and re-establish sessions elsewhere while the
+        stores below are still flushing (the handoff half of the drain
+        contract — docs/guides/edge-routing.md)."""
+        self.draining = True
+        if self._announce_handle is not None:
+            self._announce_handle.cancel()
+            self._announce_handle = None
+        self._announce(relay.CELL_DRAINING)
+        get_flight_recorder().record("__edge__", "cell_draining", cell=self.cell_id)
+        # give the announcement its flush tick before stores monopolize
+        # the loop (publish_nowait ships on the next tick)
+        await asyncio.sleep(0)
+
+    async def on_destroy(self, data: Payload) -> None:
+        if self._announce_handle is not None:
+            self._announce_handle.cancel()
+            self._announce_handle = None
+        self._announce(relay.CELL_DOWN)
+        for session in list(self.sessions.values()):
+            session.close(1001, "cell shutdown")
+        # bounded: let the CELL_DOWN/CLOSED envelopes flush before the
+        # lane closes (peers heal via re-route even if this races)
+        flush_task = getattr(self.pub, "_flush_task", None)
+        if flush_task is not None and not flush_task.done():
+            try:
+                await asyncio.wait_for(asyncio.shield(flush_task), timeout=0.5)
+            except Exception:
+                pass
+        self.pub.close()
+        self.sub.close()
+
+    def health_status(self) -> dict:
+        return {
+            "state": "draining" if self.draining else "serving",
+            "degraded": False,
+            "cell_id": self.cell_id,
+            "edge_sessions": len(self.sessions),
+        }
+
+    # -- relay dispatch ------------------------------------------------------
+
+    def _on_message(self, channel: bytes, data: bytes) -> None:
+        try:
+            kind, session_id, aux, payload = relay.decode_envelope(data)
+        except Exception:
+            return  # malformed envelope: nothing safe to act on
+        if kind == relay.OPEN:
+            if self.draining:
+                # stale route: the edge hasn't seen CELL_DRAINING yet —
+                # answer CLOSED so it re-routes instead of waiting
+                self.counters["refused_draining"] += 1
+                self.publish_to_edge(
+                    relay.decode_open_aux(aux).get("edge", ""),
+                    relay.encode_envelope(
+                        relay.CLOSED, session_id, "1012:draining"
+                    ),
+                )
+                return
+            if session_id in self.sessions:
+                return  # duplicate OPEN (edge retry): session exists
+            open_aux = relay.decode_open_aux(aux)
+            edge_id = str(open_aux.get("edge", ""))
+            if not edge_id:
+                return
+            self.counters["sessions_opened"] += 1
+            self.sessions[session_id] = _CellEdgeSession(
+                self, session_id, edge_id, open_aux
+            )
+            return
+        session = self.sessions.get(session_id)
+        if session is None:
+            return  # frames for a session that never opened / already died
+        if kind == relay.FRAME:
+            self.counters["frames_in"] += 1
+            session.feed(payload)
+        elif kind == relay.DETACH:
+            self.counters["detaches"] += 1
+            session.detach(aux)
+        elif kind == relay.CLOSE:
+            self.counters["sessions_closed"] += 1
+            session.close(1000, "edge closed")

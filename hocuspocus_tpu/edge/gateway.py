@@ -1,0 +1,817 @@
+"""The stateless websocket edge tier: terminate sockets, route docs.
+
+An edge terminates client websockets, speaks the wire protocol far
+enough to AUTHENTICATE each document channel at the door (the full
+on_connect/on_authenticate hook chain plus the PR-12 per-tenant
+admission quotas and RED-rung refusal — floods die here, cells never
+see them), and relays everything else verbatim to the doc's owning
+merge cell over the relay lane (edge/relay.py). The edge holds NO
+document state: CRDT sync is order-insensitive and state-based, so the
+only per-channel memory is two cached frames —
+
+- the client's **Auth frame** (replayed to a new cell so a handed-off
+  session re-authenticates without the client's involvement), and
+- the client's latest **SyncStep1 frame** (replayed to a new cell as
+  the resync exchange: the cell answers SyncStep2 — a superset diff,
+  idempotent — plus its own SyncStep1, which makes the client re-offer
+  everything the handoff window might have dropped).
+
+**Connection handoff.** When a cell announces drain (or dies), the
+router remaps its docs and every affected channel rebinds: DETACH from
+the old session where still reachable, OPEN/reuse a session on the new
+cell, replay Auth + SyncStep1, flush the channel's relay buffer. The
+client keeps its socket the whole time — the only client-visible
+traffic is the resync exchange. Frames still arriving from the OLD
+session (late broadcasts, the drain's 1012 close) are dropped by the
+current-session check, so a handoff can never leak a stale close or a
+duplicate Authenticated to the client.
+
+**Bounded relay queue.** A channel whose cell is unreachable (or not
+yet routed) buffers outbound frames in a bounded deque; overflow drops
+the OLDEST frame with accounting (`hocuspocus_edge_relay_overflow_total`
+plus the shared `hocuspocus_wire_send_queue_overflow_total` family) —
+a slow or dead cell can never OOM an edge, and everything dropped is
+re-offered by the rebind's resync exchange.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+from ..aio import spawn_tracked
+from ..net.resp import PipelinedRedisClient, RedisSubscriber
+from ..observability.flight_recorder import get_flight_recorder
+from ..observability.metrics import Counter, Gauge
+from ..observability.wire import get_wire_telemetry
+from ..protocol.auth import AuthMessageType
+from ..protocol.frames import parse_frame_header
+from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
+from ..protocol.sync import MESSAGE_YJS_SYNC_STEP1
+from ..crdt.encoding import Decoder
+from ..server import logger
+from ..server.overload import RED, get_overload_controller, resolve_tenant
+from ..server.types import ConnectionConfiguration, Payload
+from . import relay
+from .relay import DEFAULT_PREFIX
+from .router import CellRouter
+
+# frames a parked/re-establishing doc channel may buffer before the
+# oldest is shed (accounted; healed by the rebind resync)
+DEFAULT_RELAY_QUEUE_LIMIT = 1024
+
+
+class RelaySession:
+    """One (client socket, cell) lane multiplexing that client's doc
+    channels routed to that cell."""
+
+    __slots__ = ("gateway", "session_id", "cell_id", "owner", "docs", "closed")
+
+    def __init__(self, gateway: "EdgeGateway", session_id: str, cell_id: str, owner) -> None:
+        self.gateway = gateway
+        self.session_id = session_id
+        self.cell_id = cell_id
+        self.owner = owner
+        self.docs: "set[str]" = set()
+        self.closed = False
+
+    def send(self, frame: bytes) -> None:
+        if self.closed:
+            return
+        self.gateway.publish_to_cell(
+            self.cell_id,
+            relay.encode_envelope(relay.FRAME, self.session_id, "", frame),
+        )
+        self.gateway.counters["frames_to_cell"] += 1
+        self.gateway.frames_total.inc(direction="to_cell")
+
+
+class EdgeDocChannel:
+    """Per-(socket, document) relay state. The whole point of the edge
+    being stateless is how little lives here."""
+
+    __slots__ = (
+        "name",
+        "tenant",
+        "established",
+        "authenticated_seen",
+        "auth_frame",
+        "step1_frame",
+        "session",
+        "buffer",
+        "heal_handle",
+    )
+
+    def __init__(self, name: str, tenant: str) -> None:
+        self.name = name
+        # admission identity is PER CHANNEL (one socket can multiplex
+        # docs whose auth hooks stamp different tenants — a per-socket
+        # tenant would bill one tenant's flood to another's bucket)
+        self.tenant = tenant
+        self.established = False
+        self.authenticated_seen = False
+        self.auth_frame: Optional[bytes] = None
+        self.step1_frame: Optional[bytes] = None
+        self.session: Optional[RelaySession] = None
+        self.buffer: "deque[bytes]" = deque()
+        self.heal_handle: Optional[asyncio.TimerHandle] = None
+
+
+class EdgeClientSession:
+    """Per-socket session manager on the edge (the `ClientConnection`
+    of the edge role): door auth, admission, relay, handoff."""
+
+    def __init__(
+        self,
+        transport,
+        request,
+        hocuspocus,
+        gateway: "EdgeGateway",
+        context: Optional[dict] = None,
+    ) -> None:
+        self.transport = transport
+        self.request = request
+        self.hocuspocus = hocuspocus
+        self.gateway = gateway
+        self.default_context = dict(context or {})
+        self.socket_id = str(uuid.uuid4())
+        self.channels: "dict[str, EdgeDocChannel]" = {}
+        self.cell_sessions: "dict[str, RelaySession]" = {}
+        self.hook_payloads: "dict[str, Payload]" = {}
+        self._auth_pending: "set[str]" = set()
+        self.tenant = resolve_tenant(request=request, context=self.default_context)
+        self._closed = False
+        gateway.client_sessions.add(self)
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            wire.record_socket_opened()
+
+    # -- inbound from the client -------------------------------------------
+
+    async def handle_message(self, data: bytes) -> None:
+        try:
+            document_name, message_type, offset = parse_frame_header(data)
+        except Exception as error:
+            logger.log_error(f"[edge] invalid client frame: {error!r}")
+            self.transport.close(4401, "Unauthorized")
+            return
+        channel = self.channels.get(document_name)
+        if channel is not None and channel.established:
+            overload = get_overload_controller()
+            if overload.enabled and not overload.admit_message(channel.tenant):
+                # edge-local ingress quota: the flood dies HERE — the
+                # cell never sees the frame. Same rung-gated policy as
+                # the monolith's Connection.handle_message: 1013 at
+                # RED, below RED drop + one deferred resync heal
+                if overload.rung >= RED:
+                    self._close_channel(channel, 1013, "Try again later")
+                    return
+                self._schedule_quota_heal(channel)
+                return
+            self._relay_client_frame(channel, data, message_type, offset)
+            return
+        if channel is None:
+            channel = self.channels[document_name] = EdgeDocChannel(
+                document_name, self.tenant
+            )
+            self.hook_payloads[document_name] = Payload(
+                instance=self.hocuspocus,
+                request=self.request,
+                connection_config=ConnectionConfiguration(
+                    read_only=False, is_authenticated=False
+                ),
+                request_headers=self.request.headers,
+                request_parameters=self.request.parameters,
+                socket_id=self.socket_id,
+                context={**self.default_context},
+            )
+        if (
+            message_type == MessageType.Auth
+            and document_name not in self._auth_pending
+            and not channel.established
+        ):
+            self._auth_pending.add(document_name)
+            await self._door_auth(channel, data, offset)
+            return
+        # pre-establishment traffic (the client's Step1/awareness land
+        # right behind its Auth): buffer until the channel binds
+        self._buffer_frame(channel, data)
+
+    async def _door_auth(self, channel: EdgeDocChannel, data: bytes, offset: int) -> None:
+        """The PR-12 front door: full auth hook chain + tenant admission
+        run ON THE EDGE; only authenticated, admitted channels ever
+        touch a cell."""
+        document_name = channel.name
+        hook_payload = self.hook_payloads[document_name]
+        wire = get_wire_telemetry()
+        auth_started = time.perf_counter() if wire.enabled else None
+        try:
+            try:
+                tmp = IncomingMessage(data)
+                tmp.decoder.pos = offset
+                tmp.read_var_uint()  # auth submessage type (always Token)
+                token = tmp.read_var_string()
+            except Exception as error:
+                # malformed Auth frame: same terminal behavior as the
+                # monolith's establishment path (ClientConnection) —
+                # log + reset the socket, never tear the loop down
+                logger.log_error(f"[edge] malformed auth frame: {error!r}")
+                self.transport.close(4205, "Reset Connection")
+                return
+
+            def merge_context(context_additions: Any) -> None:
+                if isinstance(context_additions, dict):
+                    hook_payload.context = {
+                        **hook_payload.context,
+                        **context_additions,
+                    }
+
+            try:
+                await self.hocuspocus.hooks(
+                    "on_connect",
+                    Payload(
+                        **{**hook_payload.__dict__, "document_name": document_name}
+                    ),
+                    merge_context,
+                )
+                await self.hocuspocus.hooks(
+                    "on_authenticate",
+                    Payload(
+                        **{
+                            **hook_payload.__dict__,
+                            "token": token,
+                            "document_name": document_name,
+                        }
+                    ),
+                    merge_context,
+                )
+                if auth_started is not None:
+                    wire.record_auth(time.perf_counter() - auth_started, ok=True)
+            except Exception as error:
+                if auth_started is not None:
+                    wire.record_auth(time.perf_counter() - auth_started, ok=False)
+                reason = getattr(error, "reason", None) or getattr(
+                    getattr(error, "event", None), "reason", None
+                )
+                self._send_to_client(
+                    OutgoingMessage(document_name)
+                    .write_permission_denied(reason or "permission-denied")
+                    .to_bytes()
+                )
+                self._drop_channel(channel)
+                return
+            # admission AFTER the hook chain (a tenant stamped into the
+            # context by an auth hook is honored; an invalid token never
+            # drains a victim's bucket) — identical to the monolith's
+            # auth-time admission in server/client_connection.py
+            channel.tenant = resolve_tenant(
+                request=self.request, context=hook_payload.context
+            )
+            overload = get_overload_controller()
+            if overload.enabled:
+                refusal = overload.admit_connect(channel.tenant)
+                if refusal is not None:
+                    self._send_to_client(
+                        OutgoingMessage(document_name)
+                        .write_permission_denied(
+                            f"overloaded: {refusal}; "
+                            f"retry-after={overload.retry_after_s:g}s"
+                        )
+                        .to_bytes()
+                    )
+                    self._drop_channel(channel)
+                    return
+            hook_payload.connection_config.is_authenticated = True
+            channel.established = True
+            channel.auth_frame = data
+            self.gateway.counters["channels_opened"] += 1
+            self._bind_channel(channel)
+        finally:
+            self._auth_pending.discard(document_name)
+
+    def _relay_client_frame(
+        self,
+        channel: EdgeDocChannel,
+        data: bytes,
+        message_type: Optional[int] = None,
+        offset: int = 0,
+    ) -> None:
+        """Relay one established-channel frame toward the owning cell,
+        caching the client's latest SyncStep1 (the handoff resync
+        replay) on the way through. Callers that already parsed the
+        header pass (message_type, offset) — the per-frame hot path
+        must not pay the parse twice; buffered frames re-parse here."""
+        if message_type is None:
+            try:
+                _name, message_type, offset = parse_frame_header(data)
+            except Exception:
+                return
+        if message_type == MessageType.Sync:
+            try:
+                decoder = Decoder(data)
+                decoder.pos = offset
+                if decoder.read_var_uint() == MESSAGE_YJS_SYNC_STEP1:
+                    channel.step1_frame = data
+            except Exception:
+                pass
+        if channel.session is None or channel.session.closed:
+            self._buffer_frame(channel, data)
+            return
+        channel.session.send(data)
+
+    def _buffer_frame(self, channel: EdgeDocChannel, data: bytes) -> None:
+        """The bounded per-channel relay queue: a parked or
+        re-establishing channel buffers; overflow sheds the OLDEST frame
+        with accounting (newest state wins — the rebind resync re-offers
+        whatever was shed)."""
+        limit = self.gateway.relay_queue_limit
+        while limit and len(channel.buffer) >= limit:
+            channel.buffer.popleft()
+            self.gateway.counters["relay_overflows"] += 1
+            self.gateway.relay_overflow_total.inc()
+            get_wire_telemetry().record_queue_overflow()
+        channel.buffer.append(data)
+
+    # -- binding / handoff ---------------------------------------------------
+
+    def _session_for(self, cell_id: str) -> RelaySession:
+        session = self.cell_sessions.get(cell_id)
+        if session is None or session.closed:
+            session = self.gateway.open_session(self, cell_id)
+            self.cell_sessions[cell_id] = session
+        return session
+
+    def _bind_channel(
+        self, channel: EdgeDocChannel, reason: Optional[str] = None
+    ) -> bool:
+        """Bind (or re-bind) a channel to its routed cell: replay Auth,
+        replay the resync SyncStep1 on handoff, flush the buffer.
+        Returns False when no healthy cell exists (channel parks)."""
+        handoff = reason is not None
+        cell_id = self.gateway.router.route(channel.name)
+        if cell_id is None:
+            self.gateway.counters["parked_binds"] += 1
+            return False
+        session = self._session_for(cell_id)
+        channel.session = session
+        session.docs.add(channel.name)
+        if channel.auth_frame is not None:
+            session.send(channel.auth_frame)
+        if handoff and channel.step1_frame is not None:
+            # THE resync exchange: the new cell answers SyncStep2 (a
+            # superset diff — idempotent) + its own SyncStep1, which
+            # makes the client re-offer anything the handoff dropped
+            session.send(channel.step1_frame)
+        while channel.buffer:
+            self._relay_client_frame(channel, channel.buffer.popleft())
+        if handoff:
+            self.gateway.counters["handoffs"] += 1
+            self.gateway.handoffs_total.inc(reason=reason)
+            get_flight_recorder().record(
+                "__edge__",
+                "handoff",
+                doc=channel.name,
+                to_cell=cell_id,
+                reason=reason,
+            )
+        return True
+
+    def rebind_docs(self, session: RelaySession, reason: str) -> None:
+        """A relay session died (cell drain/death/session CLOSED):
+        every doc bound to it re-establishes on its re-routed cell."""
+        if self.cell_sessions.get(session.cell_id) is session:
+            self.cell_sessions.pop(session.cell_id, None)
+        for name in sorted(session.docs):
+            session.docs.discard(name)
+            channel = self.channels.get(name)
+            if channel is None or channel.session is not session:
+                continue
+            channel.session = None
+            self._bind_channel(channel, reason=reason)
+
+    def rebind_parked(self) -> None:
+        """A cell came up: parked channels (no routable cell at bind
+        time) try again; the replayed Step1 heals anything buffered or
+        shed while parked."""
+        for channel in list(self.channels.values()):
+            if channel.established and (
+                channel.session is None or channel.session.closed
+            ):
+                channel.session = None
+                self._bind_channel(channel, reason="recovered")
+
+    def detach_doc(self, channel: EdgeDocChannel) -> None:
+        """Remove one doc from its session, telling a still-reachable
+        cell to close the server-side Connection."""
+        session = channel.session
+        channel.session = None
+        if session is None or session.closed:
+            return
+        session.docs.discard(channel.name)
+        state = self.gateway.router.state_of(session.cell_id)
+        if state == "healthy":
+            self.gateway.publish_to_cell(
+                session.cell_id,
+                relay.encode_envelope(relay.DETACH, session.session_id, channel.name),
+            )
+
+    # -- inbound from cells --------------------------------------------------
+
+    def deliver_from_cell(self, session: RelaySession, payload: bytes) -> None:
+        try:
+            document_name, message_type, offset = parse_frame_header(payload)
+        except Exception:
+            return
+        channel = self.channels.get(document_name)
+        if channel is None or channel.session is not session:
+            # stale-session traffic: a late broadcast or the old cell's
+            # drain-time 1012 close for a doc that already handed off —
+            # never client-visible
+            self.gateway.counters["stale_drops"] += 1
+            self.gateway.stale_frames_total.inc()
+            return
+        if message_type == MessageType.Auth:
+            try:
+                decoder = Decoder(payload)
+                decoder.pos = offset
+                subtype = decoder.read_var_uint()
+            except Exception:
+                subtype = None
+            if subtype == AuthMessageType.Authenticated:
+                if channel.authenticated_seen:
+                    return  # handoff re-auth: the client already has one
+                channel.authenticated_seen = True
+            elif subtype == AuthMessageType.PermissionDenied:
+                # terminal from the cell (e.g. cell-side admission):
+                # forward, then forget the channel so a retry re-auths
+                self._send_to_client(payload)
+                self.detach_doc(channel)
+                self._drop_channel(channel)
+                return
+        self._send_to_client(payload)
+
+    def _send_to_client(self, data: bytes) -> None:
+        if self.transport.is_closed:
+            return
+        try:
+            self.transport.send(data)
+        except Exception:
+            return
+        self.gateway.counters["frames_to_client"] += 1
+        self.gateway.frames_total.inc(direction="to_client")
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            wire.record_egress_frame(data)
+
+    # -- quota heal ----------------------------------------------------------
+
+    def _schedule_quota_heal(self, channel: EdgeDocChannel) -> None:
+        """A dropped over-quota frame must not diverge the doc forever:
+        after the bucket's refill window, replay the client's Step1 to
+        the cell — the cell's SyncStep2 + Step1 exchange re-offers
+        everything the drops lost (state-based sync, idempotent)."""
+        if channel.heal_handle is not None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+
+        def heal() -> None:
+            channel.heal_handle = None
+            if (
+                self._closed
+                or not channel.established
+                or channel.session is None
+                or channel.session.closed
+            ):
+                return
+            if channel.step1_frame is not None:
+                channel.session.send(channel.step1_frame)
+
+        channel.heal_handle = loop.call_later(1.0, heal)
+
+    # -- teardown ------------------------------------------------------------
+
+    def _close_channel(self, channel: EdgeDocChannel, code: int, reason: str) -> None:
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            wire.record_channel_close(code)
+        self._send_to_client(
+            OutgoingMessage(channel.name).write_close_message(reason).to_bytes()
+        )
+        self.detach_doc(channel)
+        self._drop_channel(channel)
+
+    def _drop_channel(self, channel: EdgeDocChannel) -> None:
+        if channel.heal_handle is not None:
+            channel.heal_handle.cancel()
+            channel.heal_handle = None
+        self.channels.pop(channel.name, None)
+        self.hook_payloads.pop(channel.name, None)
+        session = channel.session
+        if session is not None:
+            session.docs.discard(channel.name)
+        channel.session = None
+        channel.buffer.clear()
+
+    async def handle_transport_close(self, code: int, reason: str) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        wire = get_wire_telemetry()
+        if wire.enabled:
+            wire.record_socket_closed(code)
+            wire.untrack_transport(self.transport)
+        for channel in list(self.channels.values()):
+            if channel.heal_handle is not None:
+                channel.heal_handle.cancel()
+                channel.heal_handle = None
+            channel.buffer.clear()
+        for session in list(self.cell_sessions.values()):
+            self.gateway.close_session(session)
+        self.cell_sessions.clear()
+        self.channels.clear()
+        self.hook_payloads.clear()
+        self.gateway.client_sessions.discard(self)
+
+
+class EdgeGateway:
+    """One edge process's relay fabric: the router, the RESP lane, the
+    session registry and the edge metric surface."""
+
+    def __init__(
+        self,
+        edge_id: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        prefix: str = DEFAULT_PREFIX,
+        router: Optional[CellRouter] = None,
+        create_client: Optional[Any] = None,
+        create_subscriber: Optional[Any] = None,
+        relay_queue_limit: int = DEFAULT_RELAY_QUEUE_LIMIT,
+    ) -> None:
+        self.edge_id = edge_id or f"edge-{uuid.uuid4().hex[:8]}"
+        self.prefix = prefix
+        self.router = router or CellRouter()
+        self.relay_queue_limit = relay_queue_limit
+        self.sessions: "dict[str, RelaySession]" = {}
+        self.client_sessions: "set[EdgeClientSession]" = set()
+        self._session_seq = 0
+        self._tasks: set = set()
+        self._started = False
+        self.counters = {
+            "frames_to_cell": 0,
+            "frames_to_client": 0,
+            "channels_opened": 0,
+            "handoffs": 0,
+            "stale_drops": 0,
+            "relay_overflows": 0,
+            "parked_binds": 0,
+            "remaps": 0,
+        }
+        if create_client is not None:
+            self.pub = create_client()
+        else:
+            self.pub = PipelinedRedisClient(host, port)
+        if create_subscriber is not None:
+            self.sub = create_subscriber(self._on_message)
+        else:
+            self.sub = RedisSubscriber(host, port, on_message=self._on_message)
+        # -- exposition (hocuspocus_edge_*; adopted by Metrics) ---------
+        self.sessions_gauge = Gauge(
+            "hocuspocus_edge_relay_sessions",
+            "Live edge→cell relay sessions",
+            fn=lambda: len(self.sessions),
+        )
+        self.cells_gauge = Gauge(
+            "hocuspocus_edge_cells_healthy",
+            "Merge cells the router considers healthy",
+            fn=lambda: len(self.router.healthy_cells()),
+        )
+        self.channels_gauge = Gauge(
+            "hocuspocus_edge_doc_channels",
+            "Established per-document relay channels",
+            fn=self._count_channels,
+        )
+        self.parked_gauge = Gauge(
+            "hocuspocus_edge_parked_channels",
+            "Established channels with no routable cell (buffering)",
+            fn=self._count_parked,
+        )
+        self.relay_queue_gauge = Gauge(
+            "hocuspocus_edge_relay_queue_depth",
+            "Frames buffered across parked/re-establishing channels",
+            fn=self._relay_queue_depth,
+        )
+        self.frames_total = Counter(
+            "hocuspocus_edge_relay_frames_total",
+            "Frames relayed through this edge, by direction",
+        )
+        self.handoffs_total = Counter(
+            "hocuspocus_edge_handoffs_total",
+            "Doc channels handed off between cells, by reason",
+        )
+        self.stale_frames_total = Counter(
+            "hocuspocus_edge_stale_frames_total",
+            "Frames from superseded sessions dropped by the edge",
+        )
+        self.relay_overflow_total = Counter(
+            "hocuspocus_edge_relay_overflow_total",
+            "Frames shed from bounded per-channel relay queues",
+        )
+        self.route_epoch_gauge = Gauge(
+            "hocuspocus_edge_route_epoch",
+            "Router epoch (bumps on every membership/override change)",
+            fn=lambda: self.router.epoch,
+        )
+
+    def metrics(self) -> tuple:
+        """Metric objects for MetricsRegistry.register adoption."""
+        return (
+            self.sessions_gauge,
+            self.cells_gauge,
+            self.channels_gauge,
+            self.parked_gauge,
+            self.relay_queue_gauge,
+            self.frames_total,
+            self.handoffs_total,
+            self.stale_frames_total,
+            self.relay_overflow_total,
+            self.route_epoch_gauge,
+        )
+
+    def _count_channels(self) -> int:
+        return sum(len(s.channels) for s in self.client_sessions)
+
+    def _count_parked(self) -> int:
+        return sum(
+            1
+            for s in self.client_sessions
+            for c in s.channels.values()
+            if c.established and (c.session is None or c.session.closed)
+        )
+
+    def _relay_queue_depth(self) -> int:
+        return sum(
+            len(c.buffer)
+            for s in self.client_sessions
+            for c in s.channels.values()
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        await self.sub.subscribe(relay.edge_channel(self.prefix, self.edge_id))
+        await self.sub.subscribe(relay.control_channel(self.prefix))
+        get_flight_recorder().record("__edge__", "edge_up", edge=self.edge_id)
+
+    def close(self) -> None:
+        for session in list(self.sessions.values()):
+            session.closed = True
+        self.sessions.clear()
+        self.pub.close()
+        self.sub.close()
+
+    # -- relay plumbing ------------------------------------------------------
+
+    def publish_to_cell(self, cell_id: str, envelope: bytes) -> None:
+        nowait = getattr(self.pub, "publish_nowait", None)
+        if nowait is not None:
+            nowait(relay.cell_channel(self.prefix, cell_id), envelope)
+        else:
+            spawn_tracked(
+                self._tasks,
+                self.pub.publish(relay.cell_channel(self.prefix, cell_id), envelope),
+            )
+
+    def open_session(self, owner: EdgeClientSession, cell_id: str) -> RelaySession:
+        self._session_seq += 1
+        session_id = f"{self.edge_id}:{owner.socket_id[:8]}:{self._session_seq}"
+        session = RelaySession(self, session_id, cell_id, owner)
+        self.sessions[session_id] = session
+        self.publish_to_cell(
+            cell_id,
+            relay.encode_envelope(
+                relay.OPEN,
+                session_id,
+                relay.encode_open_aux(self.edge_id, tenant=owner.tenant),
+            ),
+        )
+        return session
+
+    def close_session(self, session: RelaySession) -> None:
+        if not session.closed:
+            session.closed = True
+            self.publish_to_cell(
+                session.cell_id,
+                relay.encode_envelope(relay.CLOSE, session.session_id),
+            )
+        self.sessions.pop(session.session_id, None)
+        session.docs.clear()
+
+    # -- inbound dispatch ----------------------------------------------------
+
+    def _on_message(self, channel: bytes, data: bytes) -> None:
+        try:
+            kind, session_id, aux, payload = relay.decode_envelope(data)
+        except Exception:
+            return
+        if kind == relay.CELL_UP:
+            if self.router.add_cell(session_id):
+                get_flight_recorder().record(
+                    "__edge__", "cell_up", cell=session_id, edge=self.edge_id
+                )
+                self._rebind_parked()
+            return
+        if kind == relay.CELL_DRAINING:
+            if self.router.mark_draining(session_id):
+                get_flight_recorder().record(
+                    "__edge__", "cell_draining", cell=session_id, edge=self.edge_id
+                )
+                self._handoff_cell(session_id, "drain")
+            return
+        if kind == relay.CELL_DOWN:
+            if self.router.mark_dead(session_id):
+                get_flight_recorder().record(
+                    "__edge__", "cell_down", cell=session_id, edge=self.edge_id
+                )
+                self._handoff_cell(session_id, "down")
+            return
+        session = self.sessions.get(session_id)
+        if session is None:
+            return
+        if kind == relay.FRAME:
+            session.owner.deliver_from_cell(session, payload)
+        elif kind == relay.CLOSED:
+            # the cell closed this session (drain 1012, overflow,
+            # shutdown): remap its docs. A drain-coded close also
+            # downgrades the cell so new routes avoid it even when the
+            # control announcement was lost.
+            self.sessions.pop(session_id, None)
+            session.closed = True
+            if aux.startswith("1012") and self.router.mark_draining(session.cell_id):
+                self._handoff_cell(session.cell_id, "drain")
+            session.owner.rebind_docs(session, "closed")
+
+    def _handoff_cell(self, cell_id: str, reason: str) -> None:
+        """Remap every doc bound to `cell_id` — transparent handoff: the
+        clients keep their sockets; each channel replays Auth+Step1 on
+        its new cell."""
+        self.counters["remaps"] += 1
+        affected = [
+            session
+            for session in self.sessions.values()
+            if session.cell_id == cell_id
+        ]
+        for session in affected:
+            self.sessions.pop(session.session_id, None)
+            session.closed = True
+            session.owner.rebind_docs(session, reason)
+
+    def _rebind_parked(self) -> None:
+        for client in list(self.client_sessions):
+            client.rebind_parked()
+
+    # -- observability -------------------------------------------------------
+
+    def status(self) -> dict:
+        """The `/debug/edge` payload: routing table + live sessions +
+        per-doc bindings + counters."""
+        bindings = {}
+        for client in self.client_sessions:
+            for name, channel in client.channels.items():
+                bindings[name] = {
+                    "cell": channel.session.cell_id
+                    if channel.session is not None and not channel.session.closed
+                    else None,
+                    "established": channel.established,
+                    "buffered": len(channel.buffer),
+                }
+        return {
+            "edge_id": self.edge_id,
+            "router": self.router.table(),
+            "sessions": {
+                session_id: {"cell": session.cell_id, "docs": sorted(session.docs)}
+                for session_id, session in sorted(self.sessions.items())
+            },
+            "channels": dict(sorted(bindings.items())),
+            "client_sockets": len(self.client_sessions),
+            "counters": dict(self.counters),
+        }
+
+    def health_brief(self) -> dict:
+        healthy = len(self.router.healthy_cells())
+        return {
+            "state": "routing" if healthy else "no_cells",
+            "degraded": self._started and healthy == 0,
+            "cells_healthy": healthy,
+            "relay_sessions": len(self.sessions),
+            "parked_channels": self._count_parked(),
+        }

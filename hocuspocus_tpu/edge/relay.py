@@ -1,0 +1,114 @@
+"""Frame-relay protocol between the edge tier and merge cells.
+
+The edge↔cell hop reuses the PR-8 transport machinery wholesale: every
+relay message is ONE pub/sub publish on the pipelined RESP lane
+(`net/resp.py PipelinedRedisClient.publish_nowait` — per-tick coalesced
+into a single write+drain, flushed-or-resent on transport failure), and
+the in-process `MiniRedis` serves as the bus for tests and single-host
+topologies exactly as it does for cross-instance replication.
+
+Channel layout (all under one `prefix`, default ``hocuspocus-edge``):
+
+==========================  =================================================
+``{prefix}:cell:{cell}``    edge → cell: session OPEN/FRAME/DETACH/CLOSE
+``{prefix}:edge:{edge}``    cell → edge: session FRAME/CLOSED replies
+``{prefix}:cells``          control plane: CELL_UP (also the heartbeat),
+                            CELL_DRAINING (PR-9 drain announces departure),
+                            CELL_DOWN — the router registry rides this
+==========================  =================================================
+
+Envelope: ``[varUint kind][varString session][varString aux]
+[varUint8Array payload]``. ``session`` identifies one (client socket,
+cell) relay session; ``aux`` carries side data (OPEN: a JSON context
+blob; CLOSED: ``code:reason``; control frames: the cell id rides the
+session field). ``payload`` is a verbatim hocuspocus wire frame — the
+relay never re-encodes protocol traffic, which is what keeps the edge
+stateless: CRDT sync is order-insensitive and state-based (Shapiro et
+al.), so at-most-once relay delivery heals through the same SyncStep1
+resync exchange the replication lane uses.
+
+Ordering: one publisher connection per process and one bounded
+subscriber queue per consumer (mini_redis/_pump, real redis TCP) keep
+each channel FIFO, so a session's OPEN → auth → frames arrive in send
+order with no handshake round trip.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..crdt.encoding import Decoder, Encoder
+
+# session-plane kinds (edge -> cell, cell -> edge)
+OPEN = 0  # edge opens a relay session on a cell (aux: JSON context)
+FRAME = 1  # verbatim wire frame, either direction
+DETACH = 2  # edge detaches ONE doc channel from a session (aux: doc name)
+CLOSE = 3  # edge closes the whole session (client socket went away)
+CLOSED = 4  # cell closed the session (aux: "code:reason")
+
+# control-plane kinds (cell -> every edge, on the control channel; the
+# cell id rides the session field)
+CELL_UP = 10  # liveness announce — doubles as the heartbeat
+CELL_DRAINING = 11  # graceful drain started: remap my docs NOW
+CELL_DOWN = 12  # orderly departure (destroy)
+
+KIND_NAMES = {
+    OPEN: "open",
+    FRAME: "frame",
+    DETACH: "detach",
+    CLOSE: "close",
+    CLOSED: "closed",
+    CELL_UP: "cell_up",
+    CELL_DRAINING: "cell_draining",
+    CELL_DOWN: "cell_down",
+}
+
+DEFAULT_PREFIX = "hocuspocus-edge"
+
+
+def cell_channel(prefix: str, cell_id: str) -> str:
+    return f"{prefix}:cell:{cell_id}"
+
+
+def edge_channel(prefix: str, edge_id: str) -> str:
+    return f"{prefix}:edge:{edge_id}"
+
+
+def control_channel(prefix: str) -> str:
+    return f"{prefix}:cells"
+
+
+def encode_envelope(
+    kind: int, session: str, aux: str = "", payload: bytes = b""
+) -> bytes:
+    encoder = Encoder()
+    encoder.write_var_uint(kind)
+    encoder.write_var_string(session)
+    encoder.write_var_string(aux)
+    encoder.write_var_uint8_array(payload)
+    return encoder.to_bytes()
+
+
+def decode_envelope(data: bytes) -> "tuple[int, str, str, bytes]":
+    decoder = Decoder(data)
+    kind = decoder.read_var_uint()
+    session = decoder.read_var_string()
+    aux = decoder.read_var_string()
+    payload = decoder.read_var_uint8_array()
+    return kind, session, aux, payload
+
+
+def encode_open_aux(edge_id: str, tenant: Optional[str] = None) -> str:
+    aux = {"edge": edge_id}
+    if tenant:
+        aux["tenant"] = tenant
+    return json.dumps(aux, sort_keys=True, separators=(",", ":"))
+
+
+def decode_open_aux(aux: str) -> dict:
+    try:
+        data = json.loads(aux) if aux else {}
+    except Exception:
+        data = {}
+    return data if isinstance(data, dict) else {}

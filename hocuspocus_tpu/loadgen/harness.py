@@ -52,6 +52,8 @@ class ServedLoadHarness:
         self,
         num_docs: int = 1024,
         instances: int = 1,
+        edges: int = 0,
+        cells: int = 0,
         sampled: int = 32,
         edits: int = 200,
         shards: int = 4,
@@ -69,6 +71,13 @@ class ServedLoadHarness:
     ) -> None:
         self.num_docs = num_docs
         self.instances = instances
+        # edge topology (docs/guides/edge-routing.md): edges > 0 boots
+        # `edges` stateless EdgeServers + `cells` merge-cell servers
+        # over one mini_redis relay bus; self.servers then holds the
+        # EDGE servers (providers terminate there) and self.extensions
+        # the cells' plane extensions (merge capacity lives there)
+        self.edges = int(edges)
+        self.cells = int(cells) if edges else 0
         self.sampled = min(sampled, num_docs)
         self.edits = edits
         self.shards = shards
@@ -105,6 +114,9 @@ class ServedLoadHarness:
 
         self.servers: list[Server] = []
         self.extensions: list[Any] = []
+        self.cell_servers: list[Server] = []
+        self.cell_ingresses: list[Any] = []
+        self.edge_gateways: list[Any] = []
         self.sockets: list[InProcessProviderSocket] = []
         self.writers: list[HocuspocusProvider] = []
         self.readers: list[HocuspocusProvider] = []
@@ -120,9 +132,108 @@ class ServedLoadHarness:
 
     # -- topology ----------------------------------------------------------
 
+    def _plane_extension(self) -> "tuple[Any, list]":
+        """One serve-mode plane extension + its planes, per the layout."""
+        if self.shards > 1:
+            ext = ShardedTpuMergeExtension(
+                shards=self.shards,
+                num_docs=self.shard_rows,
+                capacity=self.capacity,
+                flush_interval_ms=self.flush_interval_ms,
+                serve=True,
+            )
+            return ext, [s.plane for s in ext.shards]
+        ext = TpuMergeExtension(
+            num_docs=self.shard_rows,
+            capacity=self.capacity,
+            flush_interval_ms=self.flush_interval_ms,
+            serve=True,
+        )
+        return ext, [ext.plane]
+
+    async def _start_edge_topology(self) -> None:
+        """The split front door: `cells` merge cells + `edges` stateless
+        edge servers over one mini_redis relay bus. self.servers = the
+        EDGE servers (writers land on edge 0, readers on edge 1 — the
+        timed path crosses edge->cell->edge), self.extensions = the
+        cells' plane extensions (merge capacity)."""
+        from ..edge import CellIngressExtension, EdgeGatewayExtension, EdgeServer
+        from ..net.mini_redis import MiniRedis
+
+        self._mini_redis = await MiniRedis().start()
+        host, port = "127.0.0.1", self._mini_redis.port
+        for i in range(max(self.cells, 1)):
+            plane_ext, planes = self._plane_extension()
+            ingress = CellIngressExtension(
+                cell_id=self.cell_identifier(i),
+                host=host,
+                port=port,
+                announce_interval_s=0.25,
+            )
+            extensions: list[Any] = [ingress]
+            if self.overload is not None:
+                from ..server.overload import OverloadExtension
+
+                extensions.append(OverloadExtension(**self.overload))
+            if self.with_metrics:
+                from ..observability import Metrics
+
+                metrics = Metrics()
+                self.metrics.append(metrics)
+                extensions.append(metrics)
+            extensions.append(plane_ext)
+            server = Server(Configuration(quiet=True, extensions=extensions))
+            await server.listen(port=0)
+            for plane in planes:
+                plane.warmup_compiles()
+            self.cell_servers.append(server)
+            self.cell_ingresses.append(ingress)
+            self.extensions.append(plane_ext)
+        for i in range(self.edges):
+            gateway_ext = EdgeGatewayExtension(
+                edge_id=f"loadgen-edge-{i}", host=host, port=port
+            )
+            server = EdgeServer(
+                Configuration(quiet=True, extensions=[gateway_ext])
+            )
+            await server.listen(port=0)
+            self.servers.append(server)
+            self.edge_gateways.append(gateway_ext.gateway)
+        # population sync storms must not race discovery: every edge
+        # sees every cell before providers connect
+        deadline = time.perf_counter() + 10.0
+        want = len(self.cell_servers)
+        for gateway in self.edge_gateways:
+            while len(gateway.router.healthy_cells()) < want:
+                if time.perf_counter() > deadline:
+                    raise TimeoutError(
+                        f"edge {gateway.edge_id} saw "
+                        f"{gateway.router.healthy_cells()} of {want} cells"
+                    )
+                await asyncio.sleep(0.02)
+
+    async def drain_cell(self, index: int) -> dict:
+        """Gracefully drain merge cell `index` (the scenario `drain`
+        op): the cell announces departure, edges remap its docs and
+        re-establish sessions on the survivors — no client-visible
+        disconnect beyond the resync exchange."""
+        server = self.cell_servers[index]
+        return await server.drain(timeout_secs=10.0)
+
+    def cell_identifier(self, index: int) -> str:
+        return f"loadgen-cell-{index}"
+
+    def plane_health(self) -> "list[dict]":
+        """Plane counters per merge-capacity holder (instances in the
+        replicated topology, cells in the edge topology)."""
+        return [dict(self._counters(i)) for i in range(len(self.extensions))]
+
     async def _start_servers(self) -> None:
         import os
 
+        if self.edges > 0:
+            await self._start_edge_topology()
+            return
         redis_cfg = None
         if self.instances > 1:
             host = os.environ.get("REDIS_HOST")
@@ -134,23 +245,7 @@ class ServedLoadHarness:
                 self._mini_redis = await MiniRedis().start()
                 redis_cfg = ("127.0.0.1", self._mini_redis.port)
         for i in range(self.instances):
-            if self.shards > 1:
-                ext = ShardedTpuMergeExtension(
-                    shards=self.shards,
-                    num_docs=self.shard_rows,
-                    capacity=self.capacity,
-                    flush_interval_ms=self.flush_interval_ms,
-                    serve=True,
-                )
-                planes = [s.plane for s in ext.shards]
-            else:
-                ext = TpuMergeExtension(
-                    num_docs=self.shard_rows,
-                    capacity=self.capacity,
-                    flush_interval_ms=self.flush_interval_ms,
-                    serve=True,
-                )
-                planes = [ext.plane]
+            ext, planes = self._plane_extension()
             extensions: list[Any] = []
             if redis_cfg is not None:
                 from ..extensions import Redis
@@ -218,7 +313,9 @@ class ServedLoadHarness:
         self._bg_len = [0] * self.num_docs
 
     async def _connect_readers(self) -> None:
-        server = self.servers[1 if self.instances > 1 else 0]
+        # second instance (replicated) or second edge (edge topology):
+        # the timed path crosses the fan-out either way
+        server = self.servers[1 if len(self.servers) > 1 else 0]
         socket = InProcessProviderSocket(server)
         self.sockets.append(socket)
         for d in range(self.sampled):
@@ -379,6 +476,8 @@ class ServedLoadHarness:
         # let the destroy-close tasks run before the servers go away
         await asyncio.sleep(0)
         for server in self.servers:
+            await server.destroy()
+        for server in self.cell_servers:
             await server.destroy()
         if self._mini_redis is not None:
             await self._mini_redis.stop()

@@ -78,6 +78,8 @@ class ScenarioRunner:
         self.harness = ServedLoadHarness(
             num_docs=pop["num_docs"],
             instances=pop["instances"],
+            edges=pop.get("edges", 0),
+            cells=pop.get("cells", 0),
             sampled=pop["sampled"],
             shards=pop["shards"],
             shard_rows=pop.get("shard_rows"),
@@ -187,7 +189,8 @@ class ScenarioRunner:
         return time.perf_counter() - t0
 
     def _join_server(self):
-        return self.harness.servers[1 if self.harness.instances > 1 else 0]
+        servers = self.harness.servers
+        return servers[1 if len(servers) > 1 else 0]
 
     async def _op_join(self, doc: int) -> "Optional[float]":
         socket = InProcessProviderSocket(self._join_server())
@@ -252,6 +255,22 @@ class ScenarioRunner:
         get_overload_controller().inject_pressure(float(value))
         return 0.0
 
+    async def _op_drain(self, value: int) -> "Optional[float]":
+        """Gracefully drain merge cell `value` mid-run (edge topology):
+        the handoff contract — remap + transparent re-establishment —
+        is what the rest of the phase then measures."""
+        if not self.harness.cell_servers:
+            return 0.0
+        outcome = await self.harness.drain_cell(value % len(self.harness.cell_servers))
+        get_flight_recorder().record(
+            "__loadgen__",
+            "cell_drained",
+            cell=value,
+            stored=outcome.get("stored"),
+            duration_s=outcome.get("duration_s"),
+        )
+        return 0.0
+
     async def _execute(self, op) -> None:
         """Run one op; measured kinds feed the phase histogram and the
         success counters. A timeout is a bad event, never an abort."""
@@ -288,6 +307,9 @@ class ScenarioRunner:
             measured = False
         elif op.kind == "overload":
             latency = self._op_overload(op.value)
+            measured = False
+        elif op.kind == "drain":
+            latency = await self._op_drain(op.value)
             measured = False
         ok = latency is not None
         if measured:
@@ -381,23 +403,45 @@ class ScenarioRunner:
         )
 
     async def _check_convergence(self, timeout_s: float = 8.0) -> dict:
-        """Partition-heal acceptance: every sampled doc's server-side
-        state must converge BYTE-IDENTICALLY across the two instances
-        (encode_state_as_update orders structs deterministically, so
-        equal logical state means equal bytes). Waits out the trailing
-        anti-entropy exchange; a doc still diverged at the deadline is
-        reported and latches the verdict."""
+        """Zero-silent-loss acceptance. Replicated topology: every
+        sampled doc's server-side state must converge BYTE-IDENTICALLY
+        across the two instances (encode_state_as_update orders structs
+        deterministically, so equal logical state means equal bytes).
+        Edge topology: the kill-9-style assertion runs against the
+        SURVIVING REFERENCE CLIENTS — writer and reader docs (which
+        hold every acknowledged update, connected through DIFFERENT
+        edges) must converge byte-identically even across a mid-run
+        cell drain. Waits out the trailing resync/anti-entropy
+        exchange; a doc still diverged at the deadline is reported and
+        latches the verdict."""
         from ..crdt import encode_state_as_update
 
         harness = self.harness
-        docs_a = harness.servers[0].hocuspocus.documents
-        docs_b = harness.servers[1].hocuspocus.documents
+        if harness.edges > 0:
+            pairs = [
+                (f"load-{d}", harness.writers[d].document, harness.readers[d].document)
+                for d in range(harness.sampled)
+            ]
+
+            def states(name):
+                for label, doc_a, doc_b in pairs:
+                    if label == name:
+                        return doc_a, doc_b
+                return None, None
+
+        else:
+            docs_a = harness.servers[0].hocuspocus.documents
+            docs_b = harness.servers[1].hocuspocus.documents
+
+            def states(name):
+                return docs_a.get(name), docs_b.get(name)
+
         names = [f"load-{d}" for d in range(harness.sampled)]
         pending = set(names)
         t0 = time.perf_counter()
         while pending and time.perf_counter() - t0 < timeout_s:
             for name in list(pending):
-                doc_a, doc_b = docs_a.get(name), docs_b.get(name)
+                doc_a, doc_b = states(name)
                 if doc_a is None or doc_b is None:
                     continue
                 try:
@@ -430,6 +474,17 @@ class ScenarioRunner:
         mini = self.harness.mini_redis
         if mini is not None:
             evidence["mini_redis"] = dict(mini.counters)
+        if self.harness.edge_gateways:
+            # handoff evidence: relay/handoff/stale-drop counters + the
+            # router's final view, per edge — "the drain handed off
+            # transparently" is checkable from the artifact alone
+            evidence["edge"] = {
+                gateway.edge_id: {
+                    "counters": dict(gateway.counters),
+                    "router": gateway.router.table(),
+                }
+                for gateway in self.harness.edge_gateways
+            }
         publish = {}
         for i, server in enumerate(self.harness.servers):
             for ext in getattr(server.hocuspocus, "_extensions", []):
@@ -553,7 +608,9 @@ class ScenarioRunner:
                 get_overload_controller().stop()
 
             convergence = None
-            if self._verify_convergence and harness.instances > 1:
+            if self._verify_convergence and (
+                harness.instances > 1 or harness.edges > 0
+            ):
                 convergence = await self._check_convergence()
                 if not convergence["converged"]:
                     # zero-silent-loss acceptance: divergence after the
@@ -621,10 +678,7 @@ class ScenarioRunner:
                         key: int(value - wire_run_before.get(key, 0))
                         for key, value in get_wire_telemetry().totals().items()
                     },
-                    "plane_health": [
-                        dict(harness._counters(i))
-                        for i in range(harness.instances)
-                    ],
+                    "plane_health": harness.plane_health(),
                 },
             }
             if convergence is not None:

@@ -36,6 +36,9 @@ Op kinds (the whole DSL — small on purpose):
 ``overload`` inject ``value`` rungs of synthetic pressure into the
             overload ladder (server/overload.py; 1=brownout1 … 3=red,
             0 clears) — drives shed/admission behavior deterministically
+``drain``   gracefully drain merge cell index ``value`` mid-run (edge
+            topologies only: the cell announces departure, the router
+            remaps its docs, edges re-establish sessions transparently)
 ==========  ============================================================
 
 Everything here is stdlib-only and import-light: compiling and hashing
@@ -53,7 +56,16 @@ from typing import Callable, Optional, Sequence
 
 SCHEDULE_VERSION = 1
 
-OP_KINDS = ("edit", "join", "leave", "reconnect", "lag", "partition", "overload")
+OP_KINDS = (
+    "edit",
+    "join",
+    "leave",
+    "reconnect",
+    "lag",
+    "partition",
+    "overload",
+    "drain",
+)
 
 
 @dataclass(frozen=True)
@@ -124,6 +136,12 @@ class Scenario:
     num_docs: int = 32
     sampled: int = 8
     instances: int = 1
+    # edge topology (docs/guides/edge-routing.md): when edges > 0 the
+    # runner boots `edges` stateless edge servers + `cells` merge cells
+    # over one relay bus instead of `instances` replicated servers;
+    # writers connect to edge 0, readers to edge 1 (cross-edge path)
+    edges: int = 0
+    cells: int = 0
     shards: int = 1
     capacity: int = 512
     shard_rows: Optional[int] = None
@@ -140,6 +158,8 @@ class Scenario:
             "num_docs": self.num_docs,
             "sampled": self.sampled,
             "instances": self.instances,
+            "edges": self.edges,
+            "cells": self.cells,
             "shards": self.shards,
             "capacity": self.capacity,
             "shard_rows": self.shard_rows,

@@ -21,6 +21,10 @@ workloads, Eg-walker arXiv:2409.14252 realistic-concurrency merges):
   recovery to GREEN
 - ``partition_heal``   — one-way mini_redis partition, accounted drops,
   anti-entropy heal to byte-identical convergence
+- ``edge_fanout``      — split front door: edge-terminated join storm +
+  cross-edge fan-out over two merge cells
+- ``edge_handoff``     — mid-run cell drain: transparent handoff, zero
+  acked-update loss, byte-identical convergence
 """
 
 from __future__ import annotations
@@ -157,6 +161,16 @@ def _overload_gen(rung: int, at_ms: int = 0) -> Callable:
 
     def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
         return [OpEvent(at_ms, phase.name, "overload", value=rung)]
+
+    return gen
+
+
+def _drain_gen(cell: int, at_ms: int = 0) -> Callable:
+    """Gracefully drain merge cell `cell` (edge topologies): the cell
+    announces departure, the router remaps, edges re-establish."""
+
+    def gen(rng: random.Random, scenario: Scenario, phase: PhaseSpec):
+        return [OpEvent(at_ms, phase.name, "drain", value=cell)]
 
     return gen
 
@@ -519,6 +533,102 @@ def partition_heal(
     )
 
 
+def edge_fanout(
+    num_docs: int = 10,
+    phase_ms: int = 1200,
+    joins: int = 4,
+) -> Scenario:
+    """The split front door under load (docs/guides/edge-routing.md):
+    writers on edge 0, readers on edge 1, two merge cells behind the
+    relay lane — every measured edit crosses edge→cell→edge, and a join
+    storm lands THROUGH the edge tier mid-run (door auth + relay
+    session establishment under pressure). The fanout phase's p99 is
+    the `edge_fanout.interactive_p99` gate stage in
+    tools/bench_gate.py: the edge hop must stay a constant tax, not a
+    new tail."""
+    return Scenario(
+        name="edge_fanout",
+        description="edge-terminated join storm + cross-edge fan-out "
+        "over two merge cells",
+        num_docs=num_docs,
+        sampled=min(5, num_docs),
+        edges=2,
+        cells=2,
+        shards=1,
+        capacity=512,
+        docs_per_socket=num_docs,
+        params={"joins": joins},
+        phases=[
+            PhaseSpec("steady", phase_ms, _edit_gen(20.0), slo_e2e_ms=1000.0),
+            PhaseSpec(
+                "fanout",
+                phase_ms,
+                _compose(_edit_gen(30.0), _join_storm_gen(joins)),
+                slo_e2e_ms=1000.0,
+                slo_objective=0.90,
+            ),
+            PhaseSpec(
+                "cool",
+                phase_ms,
+                _compose(_edit_gen(15.0), _leave_gen(joins)),
+                slo_e2e_ms=1000.0,
+            ),
+        ],
+    )
+
+
+def edge_handoff(
+    num_docs: int = 8,
+    phase_ms: int = 1500,
+) -> Scenario:
+    """Mid-run cell drain with transparent handoff
+    (docs/guides/edge-routing.md): steady cross-edge traffic, then cell
+    0 gracefully drains — it announces departure, the router remaps its
+    docs and every affected session re-establishes on cell 1 via the
+    replayed Auth + SyncStep1 resync, with NO client-visible
+    disconnect. The handoff phase's edits measure the re-establishment
+    tax; ``verify_convergence`` latches the zero-acknowledged-update-
+    loss assertion (writer vs reader client docs byte-identical, the
+    surviving-reference-client check) into the SLO verdict."""
+    return Scenario(
+        name="edge_handoff",
+        description="mid-run cell drain: transparent handoff, zero "
+        "acked-update loss, byte-identical convergence",
+        num_docs=num_docs,
+        sampled=min(4, num_docs),
+        edges=2,
+        cells=2,
+        shards=1,
+        capacity=512,
+        docs_per_socket=num_docs,
+        params={"verify_convergence": True},
+        phases=[
+            PhaseSpec("steady", phase_ms, _edit_gen(16.0), slo_e2e_ms=1000.0),
+            PhaseSpec(
+                "handoff",
+                phase_ms,
+                _compose(
+                    _drain_gen(0),
+                    # the drain runs mid-phase edits: sessions hand off
+                    # UNDER traffic, and the measured latencies include
+                    # the resync exchange
+                    _edit_gen(16.0),
+                ),
+                slo_e2e_ms=5000.0,
+                slo_objective=0.80,
+                error_objective=0.80,
+            ),
+            PhaseSpec(
+                "settled",
+                phase_ms,
+                _edit_gen(12.0),
+                slo_e2e_ms=1000.0,
+                slo_objective=0.90,
+            ),
+        ],
+    )
+
+
 SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "smoke": smoke,
     "diurnal": diurnal,
@@ -529,12 +639,22 @@ SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "storm": storm,
     "overload_storm": overload_storm,
     "partition_heal": partition_heal,
+    "edge_fanout": edge_fanout,
+    "edge_handoff": edge_handoff,
 }
 
 # the default suite bench.py / bench_capture run: fast enough for every
-# round, covers the single-instance, cross-instance, overload-shed and
-# partition-heal paths
-BENCH_SUITE = ("smoke", "replication_lag", "overload_storm", "partition_heal")
+# round, covers the single-instance, cross-instance, overload-shed,
+# partition-heal and edge-tier (split front door + cell-drain handoff)
+# paths
+BENCH_SUITE = (
+    "smoke",
+    "replication_lag",
+    "overload_storm",
+    "partition_heal",
+    "edge_fanout",
+    "edge_handoff",
+)
 
 
 def get_scenario(name: str, **overrides) -> Scenario:

@@ -70,11 +70,22 @@ class InProcessProviderSocket(ProviderSocketBase):
             send_async=self._deliver_to_client,
             close_async=self._closed_by_server,
         )
-        self._client_connection = core.handle_connection(
-            self._transport,
-            request or RequestInfo(),
-            dict(context or {}),
-        )
+        # honor the server's session factory when given a Server: the
+        # edge role (edge/server.py) terminates sessions in a relaying
+        # EdgeClientSession, not a document-owning ClientConnection —
+        # in-process load generation must exercise the same path the
+        # websocket host serves
+        session_factory = getattr(hocuspocus, "_create_session", None)
+        if session_factory is not None:
+            self._client_connection = session_factory(
+                self._transport, request or RequestInfo(), dict(context or {})
+            )
+        else:
+            self._client_connection = core.handle_connection(
+                self._transport,
+                request or RequestInfo(),
+                dict(context or {}),
+            )
         self._pump_task = asyncio.ensure_future(self._pump())
         # the "connect moment": scheduled, not inline, so providers
         # constructed right after this socket still observe the

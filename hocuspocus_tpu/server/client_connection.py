@@ -259,9 +259,16 @@ class ClientConnection:
                 # Refusal answers permission-denied (the same protocol
                 # behavior in-process embedders and websocket clients
                 # see) and un-establishes the channel so a retry can
-                # re-attempt once pressure eases.
+                # re-attempt once pressure eases. Edge-relayed sessions
+                # (context stamped by the cell ingress, edge/cell.py)
+                # were admitted AT THE DOOR — charging again would
+                # double-bill every tenant once per tier.
+                context = hook_payload.context
+                relayed_from_edge = isinstance(context, dict) and context.get(
+                    "edge"
+                )
                 overload = get_overload_controller()
-                if overload.enabled:
+                if overload.enabled and not relayed_from_edge:
                     tenant = resolve_tenant(
                         request=self.request, context=hook_payload.context
                     )

@@ -57,8 +57,14 @@ class Connection:
         self.catchup = CatchupTier(self)
         # admission identity (server/overload.py): resolved once — the
         # auth hook chain has already merged its context additions by
-        # the time a Connection exists
+        # the time a Connection exists. Edge-relayed sessions (context
+        # stamped by the cell ingress) already paid ingress admission
+        # at the door — charging per frame again would double-bill
+        # every tenant once per tier.
         self.tenant = resolve_tenant(request=request, context=context)
+        self.relayed_from_edge = isinstance(context, dict) and bool(
+            context.get("edge")
+        )
         self._quota_heal_handle: Optional[object] = None
         self.document.add_connection(self)
         self.send_current_awareness()
@@ -147,7 +153,11 @@ class Connection:
 
     async def handle_message(self, data: bytes) -> None:
         overload = get_overload_controller()
-        if overload.enabled and not overload.admit_message(self.tenant):
+        if (
+            overload.enabled
+            and not self.relayed_from_edge
+            and not overload.admit_message(self.tenant)
+        ):
             # ingress over quota: counted always; enforcement is
             # rung-gated — at RED the channel closes 1013 (Try Again
             # Later) so a runaway client stops feeding the event loop

@@ -432,6 +432,12 @@ class Hocuspocus:
         if timeout_secs is None:
             timeout_secs = self.configuration.drain_timeout_secs
         started = time.perf_counter()
+        # announce departure FIRST (best-effort): a merge cell's edge
+        # ingress publishes CELL_DRAINING here so the edge tier remaps
+        # this cell's docs and re-establishes sessions elsewhere while
+        # the stores below are still flushing (docs/guides/
+        # edge-routing.md); a monolith simply has no on_drain hooks
+        await self._safe_hooks("on_drain", Payload(instance=self))
         outcome: dict = {
             "docs": len(self.documents),
             "stored": 0,

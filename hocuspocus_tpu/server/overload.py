@@ -373,7 +373,11 @@ class OverloadController:
         self._last_backpressure_total = total
         if dt <= 0:
             return 0.0
-        return max(delta, 0.0) / dt
+        # floor the window: an out-of-band sample (inject_pressure fires
+        # one immediately) right after a sampler tick must not divide a
+        # single crossing by a near-zero dt and spuriously read as a
+        # crossing storm
+        return max(delta, 0.0) / max(dt, self.sample_interval_s / 2)
 
     def _lane_depth(self) -> float:
         total = 0

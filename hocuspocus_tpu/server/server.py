@@ -164,6 +164,15 @@ class Server:
 
     # -- request handling --------------------------------------------------
 
+    def _create_session(self, transport, request_info, context):
+        """Session factory seam: the monolith/cell roles terminate in a
+        document-owning ClientConnection; the edge role
+        (edge/server.py EdgeServer) overrides this to create a relaying
+        EdgeClientSession. Anything returned must expose
+        `handle_message(bytes)` and `handle_transport_close(code,
+        reason)`."""
+        return self.hocuspocus.handle_connection(transport, request_info, context)
+
     async def _handle_request(self, request: web.Request):
         if (
             request.headers.get("Upgrade", "").lower() == "websocket"
@@ -182,6 +191,16 @@ class Server:
             return payload["response"]
         return web.Response(text="Welcome to hocuspocus-tpu!")
 
+    def _retry_after_s(self) -> float:
+        """Retry-After seconds for 503 refusals. One knob serves every
+        refusal path (drain, RED, edge): the overload controller's
+        configured value when the control plane is on, else the server
+        configuration's — never a hard-coded constant."""
+        overload = get_overload_controller()
+        if overload.enabled:
+            return overload.retry_after_s
+        return self.configuration.retry_after_s
+
     async def _handle_websocket(self, request: web.Request):
         overload = get_overload_controller()
         if self._draining:
@@ -192,7 +211,7 @@ class Server:
             # RED-state admission below — identical wire behavior.
             overload.count_drain_rejection()
             return service_unavailable_response(
-                "draining", overload.retry_after_s
+                "draining", self._retry_after_s()
             )
         if overload.enabled:
             # overload control plane (docs/guides/overload.md): RED
@@ -206,7 +225,7 @@ class Server:
             refusal = overload.admit_upgrade(tenant)
             if refusal is not None:
                 return service_unavailable_response(
-                    refusal, overload.retry_after_s
+                    refusal, self._retry_after_s()
                 )
         request_info = RequestInfo(
             headers=dict(request.headers),
@@ -233,7 +252,7 @@ class Server:
         await ws.prepare(request)
         transport = AiohttpWebSocketTransport(ws)
         self._transports.add(transport)
-        client_connection = self.hocuspocus.handle_connection(transport, request_info, context)
+        client_connection = self._create_session(transport, request_info, context)
         close_code = 1000
         close_reason = ""
         try:

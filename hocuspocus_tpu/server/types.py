@@ -41,6 +41,7 @@ HOOK_NAMES = (
     "after_store_document",
     "on_awareness_update",
     "on_request",
+    "on_drain",
     "before_unload_document",
     "after_unload_document",
     "on_disconnect",
@@ -126,6 +127,10 @@ class Configuration:
     # bound; docs still storing at the deadline are quarantined (their
     # WAL has the data), never silently dropped.
     drain_timeout_secs: float = 20.0
+    # Retry-After seconds on 503 refusals when the overload control
+    # plane is off (with it on, the controller's retry_after_s wins);
+    # the drain, RED and edge rejection paths all share this knob.
+    retry_after_s: float = 1.0
     ydoc_options: dict = field(default_factory=lambda: {"gc": True})
     stateless_payload_limit: int = 1024 * 1024 * 100
     extensions: list[Extension] = field(default_factory=list)
@@ -148,6 +153,7 @@ class Configuration:
     after_store_document: Optional[HookHandler] = None
     on_awareness_update: Optional[HookHandler] = None
     on_request: Optional[HookHandler] = None
+    on_drain: Optional[HookHandler] = None
     before_unload_document: Optional[HookHandler] = None
     after_unload_document: Optional[HookHandler] = None
     on_disconnect: Optional[HookHandler] = None

@@ -1,0 +1,80 @@
+"""CLI role smoke (ISSUE 13): a REAL split process tree — one cell and
+one edge subprocess over an in-test MiniRedis — serving a websocket
+provider end to end through `--role edge` / `--role cell`."""
+
+import asyncio
+import contextlib
+import os
+import signal
+import socket
+import sys
+
+from hocuspocus_tpu.net.mini_redis import MiniRedis
+from hocuspocus_tpu.provider import HocuspocusProvider
+from tests.utils import wait_for
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+@contextlib.asynccontextmanager
+async def _launch_role(port: int, *extra_args: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    process = await asyncio.create_subprocess_exec(
+        sys.executable,
+        "-m",
+        "hocuspocus_tpu.cli",
+        "--port",
+        str(port),
+        "--host",
+        "127.0.0.1",
+        *extra_args,
+        cwd=_REPO_ROOT,
+        env=env,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT,
+    )
+    try:
+        yield process
+    finally:
+        if process.returncode is None:
+            process.send_signal(signal.SIGTERM)
+            try:
+                await asyncio.wait_for(process.wait(), 10)
+            except asyncio.TimeoutError:
+                process.kill()
+
+
+async def test_cli_edge_and_cell_roles_serve_a_provider():
+    redis = await MiniRedis().start()
+    cell_port, edge_port = _free_port(), _free_port()
+    relay = ("--relay-redis-host", "127.0.0.1", "--relay-redis-port", str(redis.port))
+    provider = None
+    try:
+        async with _launch_role(
+            cell_port, "--role", "cell", "--cell-id", "cli-cell", *relay
+        ):
+            async with _launch_role(edge_port, "--role", "edge", *relay):
+                provider = HocuspocusProvider(
+                    name="cli-edge-doc", url=f"ws://127.0.0.1:{edge_port}"
+                )
+                await wait_for(lambda: provider.synced, timeout=40)
+                provider.document.get_text("t").insert(0, "via edge cli")
+                await wait_for(
+                    lambda: not provider.has_unsynced_changes, timeout=15
+                )
+    finally:
+        if provider is not None:
+            provider.destroy()
+        await asyncio.sleep(0)
+        await redis.stop()

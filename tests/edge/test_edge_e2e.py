@@ -1,0 +1,361 @@
+"""Edge tier end-to-end (ISSUE 13): two-edge/two-cell convergence fuzz,
+transparent cell-drain handoff (zero acknowledged-update loss, no
+client-visible disconnect), stale-route healing, the bounded relay
+queue, door admission, and the drain/RED/edge 503 three-way parity."""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from hocuspocus_tpu.crdt import encode_state_as_update
+from hocuspocus_tpu.edge import (
+    CellIngressExtension,
+    EdgeGatewayExtension,
+    EdgeServer,
+    relay,
+)
+from hocuspocus_tpu.net.mini_redis import MiniRedis
+from hocuspocus_tpu.observability.wire import get_wire_telemetry
+from hocuspocus_tpu.provider import HocuspocusProvider
+from hocuspocus_tpu.provider.inprocess import InProcessProviderSocket
+from hocuspocus_tpu.server import Configuration, Server
+from hocuspocus_tpu.server.overload import OverloadExtension, get_overload_controller
+
+from tests.utils import wait_for, wait_synced
+
+
+@pytest.fixture(autouse=True)
+def _reset_controller():
+    controller = get_overload_controller()
+    controller.reset()
+    yield
+    controller.reset()
+
+
+class Topology:
+    """MiniRedis relay bus + N cells + M edges, torn down in order."""
+
+    def __init__(self) -> None:
+        self.redis = None
+        self.cells = []  # (Server, CellIngressExtension)
+        self.edges = []  # (EdgeServer, EdgeGatewayExtension)
+        self.sockets = []
+        self.providers = []
+
+    async def start(self, cells=2, edges=2, edge_extensions=None, **edge_kwargs):
+        self.redis = await MiniRedis().start()
+        host, port = "127.0.0.1", self.redis.port
+        for i in range(cells):
+            ext = CellIngressExtension(
+                cell_id=f"cell-{i}", host=host, port=port, announce_interval_s=0.2
+            )
+            server = Server(Configuration(quiet=True, extensions=[ext]))
+            await server.listen(port=0)
+            self.cells.append((server, ext))
+        for i in range(edges):
+            gx = EdgeGatewayExtension(
+                edge_id=f"edge-{i}", host=host, port=port, **edge_kwargs
+            )
+            extensions = [gx] + list(edge_extensions or [])
+            server = EdgeServer(Configuration(quiet=True, extensions=extensions))
+            await server.listen(port=0)
+            self.edges.append((server, gx))
+        for _, gx in self.edges:
+            await wait_for(
+                lambda g=gx: len(g.gateway.router.healthy_cells()) == cells
+            )
+        return self
+
+    def provider(self, edge_index, name, socket=None):
+        if socket is None:
+            socket = InProcessProviderSocket(self.edges[edge_index][0])
+            self.sockets.append(socket)
+        provider = HocuspocusProvider(name=name, websocket_provider=socket)
+        provider.attach()
+        self.providers.append(provider)
+        return provider
+
+    def cell_owning(self, name):
+        for server, ext in self.cells:
+            if name in server.hocuspocus.documents:
+                return server, ext
+        return None, None
+
+    async def close(self):
+        for provider in self.providers:
+            provider.destroy()
+        for socket in self.sockets:
+            socket.destroy()
+        await asyncio.sleep(0)
+        for server, _ in self.edges + self.cells:
+            await server.destroy()
+        if self.redis is not None:
+            await self.redis.stop()
+
+
+def test_envelope_roundtrip():
+    frame = b"\x01\x02payload\xff"
+    data = relay.encode_envelope(relay.FRAME, "edge-0:ab:1", "aux data", frame)
+    assert relay.decode_envelope(data) == (
+        relay.FRAME,
+        "edge-0:ab:1",
+        "aux data",
+        frame,
+    )
+    aux = relay.encode_open_aux("edge-7", tenant="acme")
+    assert relay.decode_open_aux(aux) == {"edge": "edge-7", "tenant": "acme"}
+    assert relay.decode_open_aux("not json") == {}
+
+
+async def test_cross_edge_convergence_fuzz():
+    """The acceptance topology: clients connected to DIFFERENT edges
+    editing the same docs converge byte-identically; edges hold zero
+    document state."""
+    topo = await Topology().start(cells=2, edges=2)
+    try:
+        writers = [topo.provider(0, f"doc-{i}") for i in range(4)]
+        readers = [topo.provider(1, f"doc-{i}") for i in range(4)]
+        await wait_synced(*(writers + readers))
+        for round_no in range(3):
+            for i, writer in enumerate(writers):
+                text = writer.document.get_text("body")
+                text.insert(len(text), f"w{round_no}:{i} ")
+            for i, reader in enumerate(readers):
+                text = reader.document.get_text("body")
+                text.insert(0, f"r{round_no}:{i} ")
+            await asyncio.sleep(0.05)
+        for i in range(4):
+            w_doc, r_doc = writers[i].document, readers[i].document
+            await wait_for(
+                lambda a=w_doc, b=r_doc: encode_state_as_update(a)
+                == encode_state_as_update(b)
+            )
+        # the split is real: edges terminate sockets but own no docs,
+        # cells own the docs but no client sockets
+        for server, _ in topo.edges:
+            assert not server.hocuspocus.documents
+        owned = set()
+        for server, _ in topo.cells:
+            owned.update(server.hocuspocus.documents)
+        assert owned == {f"doc-{i}" for i in range(4)}
+        # fan-out served edges as audiences via the normal pipeline
+        status = topo.edges[0][1].gateway.status()
+        assert status["channels"]["doc-0"]["established"]
+    finally:
+        await topo.close()
+
+
+async def test_cell_drain_hands_off_without_client_visible_disconnect():
+    """Mid-run drain: the owning cell announces departure, the router
+    remaps, edges re-establish via the Auth+SyncStep1 replay — no
+    provider sees a close, nothing acknowledged is lost (the surviving
+    reference client check), and post-drain edits converge
+    byte-identically on the surviving cell."""
+    topo = await Topology().start(cells=2, edges=2)
+    try:
+        writer = topo.provider(0, "doc-hot")
+        reader = topo.provider(1, "doc-hot")
+        await wait_synced(writer, reader)
+        writer.document.get_text("body").insert(0, "acked-before-drain ")
+        await wait_for(
+            lambda: "acked-before-drain" in str(reader.document.get_text("body"))
+        )
+        closes = []
+        for provider in (writer, reader):
+            provider.on("close", lambda *a, **k: closes.append("close"))
+            provider.on(
+                "authentication_failed", lambda *a, **k: closes.append("denied")
+            )
+        owner, owner_ext = topo.cell_owning("doc-hot")
+        assert owner is not None
+        await owner.drain(timeout_secs=5)
+        # both directions survive the handoff
+        writer.document.get_text("body").insert(0, "post-drain-w ")
+        await wait_for(
+            lambda: "post-drain-w" in str(reader.document.get_text("body")),
+            timeout=15,
+        )
+        reader.document.get_text("body").insert(0, "post-drain-r ")
+        await wait_for(
+            lambda: "post-drain-r" in str(writer.document.get_text("body")),
+            timeout=15,
+        )
+        await wait_for(
+            lambda: encode_state_as_update(writer.document)
+            == encode_state_as_update(reader.document)
+        )
+        # zero acknowledged-update loss: everything the reference client
+        # observed before the drain is still in the converged state
+        assert "acked-before-drain" in str(reader.document.get_text("body"))
+        assert not closes, f"client-visible disconnect during handoff: {closes}"
+        survivor, _ = topo.cell_owning("doc-hot")
+        assert survivor is not None and survivor is not owner
+        gateway = topo.edges[0][1].gateway
+        assert gateway.counters["handoffs"] >= 1
+        assert gateway.router.state_of(owner_ext.cell_id) == "draining"
+    finally:
+        await topo.close()
+
+
+async def test_stale_route_refused_by_cell_and_healed():
+    """A cell that started draining before the edge heard about it
+    refuses the OPEN with CLOSED(1012): the edge downgrades the route
+    and re-establishes on a healthy cell — the resync exchange, not a
+    hung session."""
+    topo = await Topology().start(cells=2, edges=1)
+    try:
+        gateway = topo.edges[0][1].gateway
+        # find a doc owned by cell-0, then silently start its drain
+        # (set the flag directly: the announcement never goes out)
+        name = next(
+            f"stale-{i}"
+            for i in range(64)
+            if gateway.router.route(f"stale-{i}") == "cell-0"
+        )
+        topo.cells[0][1].draining = True
+        provider = topo.provider(0, name)
+        await wait_synced(provider, timeout=20)
+        # healed onto the healthy cell, and the router learned the truth
+        assert name in topo.cells[1][0].hocuspocus.documents
+        await wait_for(
+            lambda: gateway.router.state_of("cell-0") in ("draining", "dead")
+        )
+    finally:
+        await topo.close()
+
+
+async def test_relay_queue_bounded_with_overflow_accounting():
+    """Satellite: a parked channel (no routable cell) buffers at most
+    `relay_queue_limit` frames; overflow sheds the oldest with
+    accounting in the edge counter AND the shared
+    hocuspocus_wire_send_queue_* family — then a cell arriving heals
+    everything through the replayed resync."""
+    wire = get_wire_telemetry()
+    wire.enable()
+    overflow_before = wire.send_queue_overflows.value()
+    topo = Topology()
+    topo.redis = await MiniRedis().start()
+    host, port = "127.0.0.1", topo.redis.port
+    gx = EdgeGatewayExtension(
+        edge_id="edge-0", host=host, port=port, relay_queue_limit=8
+    )
+    server = EdgeServer(Configuration(quiet=True, extensions=[gx]))
+    await server.listen(port=0)
+    topo.edges.append((server, gx))
+    try:
+        provider = topo.provider(0, "parked-doc")
+        await wait_for(lambda: gx.gateway.counters["parked_binds"] >= 1)
+        text = provider.document.get_text("body")
+        for i in range(40):  # far past the 8-frame bound
+            text.insert(len(text), f"chunk-{i} ")
+        await asyncio.sleep(0.05)
+        assert gx.gateway.counters["relay_overflows"] > 0
+        assert gx.gateway.relay_overflow_total.value() == gx.gateway.counters[
+            "relay_overflows"
+        ]
+        assert wire.send_queue_overflows.value() > overflow_before
+        channel = next(iter(gx.gateway.client_sessions)).channels["parked-doc"]
+        assert len(channel.buffer) <= 8
+        # a cell comes up: the parked channel rebinds, the replayed
+        # Auth+Step1 resync re-offers everything the shed frames held
+        ext = CellIngressExtension(
+            cell_id="late-cell", host=host, port=port, announce_interval_s=0.2
+        )
+        cell = Server(Configuration(quiet=True, extensions=[ext]))
+        await cell.listen(port=0)
+        topo.cells.append((cell, ext))
+        await wait_synced(provider, timeout=20)
+        await wait_for(
+            lambda: "parked-doc" in cell.hocuspocus.documents
+            and "chunk-39" in str(
+                cell.hocuspocus.documents["parked-doc"].get_text("body")
+            )
+            and "chunk-0" in str(
+                cell.hocuspocus.documents["parked-doc"].get_text("body")
+            ),
+            timeout=15,
+        )
+        assert gx.gateway.handoffs_total.value(reason="recovered") >= 1
+    finally:
+        await topo.close()
+
+
+async def test_door_admission_refuses_at_edge_without_touching_cells():
+    """PR-12 quotas enforced AT THE DOOR: a tenant over its connect
+    quota is refused with permission-denied by the EDGE — the cell
+    never sees a session for the refused channel."""
+    topo = await Topology().start(
+        cells=1,
+        edges=1,
+        edge_extensions=[OverloadExtension(connect_rate=0.001, connect_burst=1)],
+    )
+    try:
+        first = topo.provider(0, "quota-doc-0")
+        await wait_synced(first, timeout=20)
+        denied = asyncio.Event()
+        second = topo.provider(0, "quota-doc-1", socket=topo.sockets[0])
+        second.on("authentication_failed", lambda *a, **k: denied.set())
+        await asyncio.wait_for(denied.wait(), timeout=10)
+        assert not second.synced
+        gateway = topo.edges[0][1].gateway
+        assert gateway.counters["channels_opened"] == 1
+        # the refused channel never reached the cell
+        assert "quota-doc-1" not in topo.cells[0][0].hocuspocus.documents
+        controller = get_overload_controller()
+        assert (
+            controller.rejected_total.value(scope="connect", reason="tenant_quota")
+            >= 1
+        )
+    finally:
+        await topo.close()
+
+
+async def _upgrade_503(server) -> "tuple[int, str, str]":
+    async with aiohttp.ClientSession() as session:
+        try:
+            await session.ws_connect(f"{server.http_url}/")
+        except aiohttp.WSServerHandshakeError as error:
+            body = ""
+            return error.status, error.headers.get("Retry-After", ""), body
+    raise AssertionError("upgrade unexpectedly accepted")
+
+
+async def test_drain_red_and_edge_503_three_way_parity():
+    """Satellite: the drain path, RED-state admission and the EDGE role
+    all build their refusals from service_unavailable_response with the
+    CONFIGURABLE Retry-After — identical wire behavior, no hard-coded
+    constant."""
+    topo = await Topology().start(cells=1, edges=1)
+    try:
+        edge_server = topo.edges[0][0]
+        edge_server.configuration.retry_after_s = 7
+        cell_server = topo.cells[0][0]
+        cell_server.configuration.retry_after_s = 7
+
+        # edge drain 503 (overload controller OFF: the Configuration
+        # knob must drive the header)
+        edge_server._draining = True
+        edge_status, edge_retry, _ = await _upgrade_503(edge_server)
+        edge_server._draining = False
+
+        # cell/monolith drain 503
+        cell_server._draining = True
+        drain_status, drain_retry, _ = await _upgrade_503(cell_server)
+        cell_server._draining = False
+
+        # RED-state 503 (controller ON: its retry_after_s drives it)
+        controller = get_overload_controller()
+        controller.configure(retry_after_s=7.0).enable()
+        controller.inject_pressure(3)
+        red_status, red_retry, _ = await _upgrade_503(edge_server)
+        controller.inject_pressure(0)
+
+        assert (
+            (edge_status, edge_retry)
+            == (drain_status, drain_retry)
+            == (red_status, red_retry)
+            == (503, "7")
+        )
+    finally:
+        await topo.close()

@@ -1,0 +1,58 @@
+"""Edge-tier scenarios (ISSUE 13 satellite): the split topology runs
+through the real loadgen harness in tier-1 — edge smoke every round."""
+
+import pytest
+
+from hocuspocus_tpu.loadgen import get_scenario, run_scenario
+from hocuspocus_tpu.loadgen.scenarios import BENCH_SUITE
+from hocuspocus_tpu.server.overload import get_overload_controller
+
+
+@pytest.fixture(autouse=True)
+def _reset_controller():
+    get_overload_controller().reset()
+    yield
+    get_overload_controller().reset()
+
+
+def test_edge_scenarios_registered_and_deterministic():
+    for name in ("edge_fanout", "edge_handoff"):
+        assert name in BENCH_SUITE
+        first = get_scenario(name).compile(11)
+        again = get_scenario(name).compile(11)
+        assert first.schedule_hash == again.schedule_hash
+        assert first.population["edges"] == 2
+        assert first.population["cells"] == 2
+    handoff = get_scenario("edge_handoff").compile(0)
+    drains = [op for op in handoff.ops if op.kind == "drain"]
+    assert len(drains) == 1 and drains[0].phase == "handoff"
+    assert handoff.population["params"]["verify_convergence"] is True
+
+
+async def test_edge_handoff_scenario_smoke():
+    """The acceptance loop in miniature: edge-terminated traffic, a
+    mid-run cell drain, zero acked-update loss latched into the SLO
+    verdict via the convergence check, handoff evidence in the
+    artifact."""
+    scenario = get_scenario("edge_handoff", num_docs=4, phase_ms=600)
+    result = await run_scenario(scenario, seed=5, time_scale=2.0)
+    assert result["verdict"] == "pass", result["slo"]["breached_targets"]
+    convergence = result["extra"]["convergence"]
+    assert convergence["converged"], convergence
+    edges = result["extra"]["edge"]
+    assert sum(e["counters"]["handoffs"] for e in edges.values()) >= 1
+    # the drain left exactly one cell healthy in every edge's router
+    for evidence in edges.values():
+        states = [c["state"] for c in evidence["router"]["cells"].values()]
+        assert states.count("healthy") == 1
+        assert "draining" in states
+
+
+@pytest.mark.slow
+async def test_edge_fanout_scenario_full():
+    scenario = get_scenario("edge_fanout")
+    result = await run_scenario(scenario, seed=5, time_scale=2.0)
+    assert result["verdict"] == "pass", result["slo"]["breached_targets"]
+    phases = {phase["name"]: phase for phase in result["phases"]}
+    assert phases["fanout"]["measured_ops"] > 0
+    assert phases["fanout"]["latency_p99_ms"] is not None

@@ -1,0 +1,133 @@
+"""Cell router correctness (ISSUE 13 satellite): rendezvous-hash
+stability under cell add/remove (the minimal-movement property),
+override-table precedence, and health-state routing."""
+
+import time
+
+import pytest
+
+from hocuspocus_tpu.edge.router import CellRouter, DEAD, DRAINING, HEALTHY
+
+
+DOCS = [f"doc-{i}" for i in range(400)]
+
+
+def _placements(router):
+    return {doc: router.route(doc) for doc in DOCS}
+
+
+def test_route_is_deterministic_and_covers_all_cells():
+    router = CellRouter()
+    for i in range(4):
+        router.add_cell(f"cell-{i}")
+    first = _placements(router)
+    assert first == _placements(router)
+    # 400 docs over 4 cells: every cell takes a share (blake2b spreads)
+    used = set(first.values())
+    assert used == {f"cell-{i}" for i in range(4)}
+    # two independent routers agree (no per-instance state in the hash)
+    other = CellRouter()
+    for i in range(4):
+        other.add_cell(f"cell-{i}")
+    assert _placements(other) == first
+
+
+def test_minimal_movement_on_cell_removal():
+    """Removing a cell moves ONLY the docs that lived on it."""
+    router = CellRouter()
+    for i in range(4):
+        router.add_cell(f"cell-{i}")
+    before = _placements(router)
+    router.remove_cell("cell-2")
+    after = _placements(router)
+    for doc in DOCS:
+        if before[doc] == "cell-2":
+            assert after[doc] != "cell-2"
+        else:
+            assert after[doc] == before[doc], f"{doc} moved needlessly"
+
+
+def test_minimal_movement_on_cell_add():
+    """Adding a cell moves docs ONLY onto the new cell (~1/N of them)."""
+    router = CellRouter()
+    for i in range(4):
+        router.add_cell(f"cell-{i}")
+    before = _placements(router)
+    router.add_cell("cell-4")
+    after = _placements(router)
+    moved = [doc for doc in DOCS if after[doc] != before[doc]]
+    assert moved, "a fifth cell must take some share"
+    assert all(after[doc] == "cell-4" for doc in moved)
+    # roughly 1/5 of the population (generous bounds — it's a hash)
+    assert len(DOCS) / 20 < len(moved) < len(DOCS) / 2
+
+
+def test_draining_and_dead_cells_are_excluded_then_heal():
+    router = CellRouter()
+    router.add_cell("cell-a")
+    router.add_cell("cell-b")
+    before = _placements(router)
+    target = next(doc for doc in DOCS if before[doc] == "cell-a")
+    assert router.mark_draining("cell-a")
+    assert router.route(target) == "cell-b"
+    # re-announce heals draining back to healthy (restart case)
+    assert router.add_cell("cell-a")
+    assert router.route(target) == "cell-a"
+    router.mark_dead("cell-a")
+    assert router.route(target) == "cell-b"
+    router.mark_dead("cell-b")
+    assert router.route(target) is None  # no healthy cell: callers park
+
+
+def test_override_precedence_and_stale_override_fallthrough():
+    router = CellRouter()
+    router.add_cell("cell-a")
+    router.add_cell("cell-b")
+    doc = "pinned-mega-doc"
+    organic = router.route(doc)
+    pinned = "cell-b" if organic == "cell-a" else "cell-a"
+    router.set_override(doc, pinned)
+    assert router.route(doc) == pinned
+    # an override naming a draining cell must fall through to
+    # rendezvous, not black-hole the doc (stale-route healing)
+    router.mark_draining(pinned)
+    assert router.route(doc) == organic
+    # an override naming an UNKNOWN cell falls through too
+    router.set_override(doc, "cell-withdrawn")
+    assert router.route(doc) == organic
+    router.clear_override(doc)
+    assert router.route(doc) == organic
+
+
+def test_epoch_bumps_on_every_change_only():
+    router = CellRouter()
+    e0 = router.epoch
+    assert router.add_cell("cell-a") and router.epoch == e0 + 1
+    # heartbeat re-announce of a healthy cell: no epoch churn
+    assert not router.add_cell("cell-a") and router.epoch == e0 + 1
+    assert router.mark_draining("cell-a") and router.epoch == e0 + 2
+    assert not router.mark_draining("cell-a") and router.epoch == e0 + 2
+    router.set_override("doc", "cell-a")
+    assert router.epoch == e0 + 3
+
+
+def test_expire_stale_marks_dead_after_heartbeat_timeout():
+    router = CellRouter(heartbeat_timeout_s=0.01)
+    router.add_cell("cell-a")
+    assert router.expire_stale() == []
+    time.sleep(0.03)
+    assert router.expire_stale() == ["cell-a"]
+    assert router.state_of("cell-a") == DEAD
+    # and CELL_UP heals it
+    assert router.add_cell("cell-a")
+    assert router.state_of("cell-a") == HEALTHY
+
+
+def test_table_reports_states_and_overrides():
+    router = CellRouter(overrides={"doc-x": "cell-a"})
+    router.add_cell("cell-a")
+    router.mark_draining("cell-a")
+    table = router.table()
+    assert table["cells"]["cell-a"]["state"] == DRAINING
+    assert table["overrides"] == {"doc-x": "cell-a"}
+    assert table["epoch"] == router.epoch

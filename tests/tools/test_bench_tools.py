@@ -228,3 +228,30 @@ def test_gate_extracts_overload_storm_interactive_p99():
         payload, current, tolerance=0.25, floor_ms=0.25
     )
     assert any("overload_storm.interactive_p99" in r for r in regressions)
+
+
+def test_gate_extracts_edge_fanout_interactive_p99():
+    """The edge_fanout fanout-phase p99 (cross-edge interactive latency
+    through the relay lane) is a gated stage — the split front door
+    must stay a constant tax across rounds."""
+    payload = _artifact()
+    payload["extra"]["scenario_suite"] = {
+        "verdict": "pass",
+        "scenarios": {
+            "edge_fanout": {
+                "verdict": "pass",
+                "breached": [],
+                "phase_p99_ms": {"steady": 3.0, "fanout": 8.0, "cool": 3.0},
+            }
+        },
+    }
+    stages = bench_gate.stage_p99s(payload)
+    assert stages["edge_fanout.interactive_p99"] == 8.0
+    current = json.loads(json.dumps(payload))
+    current["extra"]["scenario_suite"]["scenarios"]["edge_fanout"][
+        "phase_p99_ms"
+    ]["fanout"] = 80.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert any("edge_fanout.interactive_p99" in r for r in regressions)

@@ -134,6 +134,15 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
             p99 = (storm.get("phase_p99_ms") or {}).get("storm")
             if isinstance(p99, (int, float)) and not isinstance(p99, bool):
                 stages["overload_storm.interactive_p99"] = float(p99)
+        # edge-tier interactive latency: the edge_fanout scenario's
+        # fanout-phase p99 is measured writer->edge->cell->edge->reader
+        # under a door-admitted join storm — a regression here means
+        # the split front door stopped being a constant tax
+        edge = (suite.get("scenarios") or {}).get("edge_fanout")
+        if isinstance(edge, dict):
+            p99 = (edge.get("phase_p99_ms") or {}).get("fanout")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["edge_fanout.interactive_p99"] = float(p99)
     wal = extra.get("wal_load")
     if isinstance(wal, dict):
         append_p99 = wal.get("append_p99_ms")
