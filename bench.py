@@ -63,10 +63,20 @@ def _label(platforms: "str | None") -> str:
 # label per run; skipped repeats are recorded in failed_attempts as
 # `probe-<label>:skipped-cached-dead` without re-paying the timeout.
 _probe_cache: "dict[str, str | None]" = {}
+# device count seen by each env label's probe (the PROBE line already
+# prints it; multichip captures need it in the manifest so a round is
+# attributable to its chip count)
+_probe_devices: "dict[str, int]" = {}
 
 
 def _probe_cached(platforms: "str | None") -> bool:
     return _label(platforms) in _probe_cache
+
+
+def probe_device_count(platforms: "str | None" = None) -> "int | None":
+    """Device count observed by the cached probe for this env label
+    (None when the env was never probed or the probe died)."""
+    return _probe_devices.get(_label(platforms))
 
 
 def _probe(platforms: "str | None") -> "str | None":
@@ -94,7 +104,13 @@ def _probe(platforms: "str | None") -> "str | None":
         return None
     for line in proc.stdout.splitlines():
         if line.startswith("PROBE "):
-            backend = line.split()[1]
+            parts = line.split()
+            backend = parts[1]
+            if len(parts) > 2:
+                try:
+                    _probe_devices[label] = int(parts[2])
+                except ValueError:
+                    pass
             _log(f"probe JAX_PLATFORMS={label}: backend={backend}")
             _probe_cache[label] = backend
             return backend

@@ -163,6 +163,43 @@ def build_parser() -> argparse.ArgumentParser:
         "large doc populations; --tpu-docs is the per-shard width. "
         "Default 1 (single plane)",
     )
+    # multi-device merge cells (docs/guides/multi-device.md): one full
+    # merge cell — arena, device lane, governor, warm grid, residency
+    # clock — per chip, with rendezvous doc placement and load-aware
+    # rebalancing over the evict-snapshot→hydrate migration rail.
+    parser.add_argument(
+        "--tpu-devices",
+        type=int,
+        default=1,
+        help="per-device merge cells: 0 = one cell per visible chip, "
+        "N > 1 = exactly N cells (wrapping the device roster), 1 = the "
+        "classic single-plane layout (default). --tpu-docs/--tpu-capacity "
+        "are PER-CELL sizes; mutually exclusive with --tpu-shards "
+        "(docs/guides/multi-device.md)",
+    )
+    parser.add_argument(
+        "--tpu-rebalance-interval",
+        type=float,
+        default=5.0,
+        help="seconds between load-aware placement sweeps on the cell "
+        "plane (0 disables rebalancing — placement stays pure "
+        "rendezvous); default 5",
+    )
+    parser.add_argument(
+        "--tpu-rebalance-ratio",
+        type=float,
+        default=2.0,
+        help="a cell hotter than this multiple of the mean (dispatched "
+        "work, lane depth, HBM) sheds docs to its coldest peer via the "
+        "evict-snapshot->hydrate migration rail (default 2.0)",
+    )
+    parser.add_argument(
+        "--tpu-migrate-batch",
+        type=int,
+        default=8,
+        help="docs migrated per rebalance sweep — bounds migration "
+        "churn under a skewed storm (default 8)",
+    )
     parser.add_argument(
         "--tpu-arena",
         choices=("unit", "rle"),
@@ -466,9 +503,27 @@ async def run(args: argparse.Namespace) -> None:
         # comes up (docs/guides/tpu-supervisor.md).
         from .tpu import SupervisedTpuMergeExtension
 
+        if args.tpu_devices != 1 and args.tpu_shards > 1:
+            print(
+                "--tpu-devices and --tpu-shards are mutually exclusive "
+                "(per-chip cells subsume doc-sharding across chips)",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        cell_kwargs = (
+            {
+                "devices": args.tpu_devices,
+                "rebalance_interval_s": args.tpu_rebalance_interval,
+                "rebalance_ratio": args.tpu_rebalance_ratio,
+                "migrate_batch": args.tpu_migrate_batch,
+            }
+            if args.tpu_devices != 1
+            else {}
+        )
         extensions.append(
             SupervisedTpuMergeExtension(
                 shards=args.tpu_shards,
+                **cell_kwargs,
                 init_timeout=args.tpu_init_timeout,
                 watchdog_interval=args.tpu_watchdog_interval,
                 breaker_threshold=args.tpu_breaker_threshold,

@@ -553,10 +553,31 @@ class EdgeGateway:
         create_client: Optional[Any] = None,
         create_subscriber: Optional[Any] = None,
         relay_queue_limit: int = DEFAULT_RELAY_QUEUE_LIMIT,
+        heartbeat_timeout_s: Optional[float] = None,
+        heartbeat_sweep_s: Optional[float] = None,
     ) -> None:
         self.edge_id = edge_id or f"edge-{uuid.uuid4().hex[:8]}"
         self.prefix = prefix
-        self.router = router or CellRouter()
+        if router is None:
+            router = (
+                CellRouter()
+                if heartbeat_timeout_s is None
+                else CellRouter(heartbeat_timeout_s=heartbeat_timeout_s)
+            )
+        self.router = router
+        # heartbeat-expiry sweep: the timer that actually DRIVES
+        # `CellRouter.expire_stale` — a cell that dies without a
+        # CELL_DOWN (kill -9, network partition) flips to dead when its
+        # CELL_UP heartbeats go quiet past the router timeout, and its
+        # docs hand off exactly like an announced death. Half the
+        # timeout by default: a cell expires at most 1.5x the timeout
+        # after its last heartbeat.
+        self.heartbeat_sweep_s = (
+            heartbeat_sweep_s
+            if heartbeat_sweep_s is not None
+            else max(self.router.heartbeat_timeout_s / 2.0, 0.05)
+        )
+        self._sweep_handle: "Optional[asyncio.TimerHandle]" = None
         self.relay_queue_limit = relay_queue_limit
         self.sessions: "dict[str, RelaySession]" = {}
         self.client_sessions: "set[EdgeClientSession]" = set()
@@ -572,6 +593,7 @@ class EdgeGateway:
             "relay_overflows": 0,
             "parked_binds": 0,
             "remaps": 0,
+            "heartbeat_expiries": 0,
         }
         if create_client is not None:
             self.pub = create_client()
@@ -671,8 +693,55 @@ class EdgeGateway:
         await self.sub.subscribe(relay.edge_channel(self.prefix, self.edge_id))
         await self.sub.subscribe(relay.control_channel(self.prefix))
         get_flight_recorder().record("__edge__", "edge_up", edge=self.edge_id)
+        self._schedule_heartbeat_sweep()
+
+    def _schedule_heartbeat_sweep(self) -> None:
+        if self.heartbeat_sweep_s <= 0 or self._sweep_handle is not None:
+            return
+        try:
+            loop = asyncio.get_event_loop()
+        except RuntimeError:
+            return
+        self._sweep_handle = loop.call_later(
+            self.heartbeat_sweep_s, self._heartbeat_sweep
+        )
+
+    def _heartbeat_sweep(self) -> None:
+        """Expiry-driven handoff: cells whose heartbeats went quiet past
+        the router timeout flip to dead and their docs remap — the same
+        transparent Auth+Step1-replay rebind an announced CELL_DOWN
+        takes, so a kill -9'd cell strands its sessions for at most one
+        timeout + sweep interval."""
+        self._sweep_handle = None
+        try:
+            # per-cell isolation: expire_stale reports each dead cell
+            # exactly ONCE, so a handoff failure for cell A must not
+            # strand cell B's sessions for good
+            for cell_id in self.router.expire_stale():
+                self.counters["heartbeat_expiries"] += 1
+                get_flight_recorder().record(
+                    "__edge__",
+                    "cell_expired",
+                    cell=cell_id,
+                    edge=self.edge_id,
+                    timeout_s=self.router.heartbeat_timeout_s,
+                )
+                try:
+                    self._handoff_cell(cell_id, "expired")
+                except Exception as error:
+                    logger.log_error(
+                        f"[edge] expiry handoff for {cell_id!r} failed "
+                        f"({error!r}); sessions heal on the next rebind"
+                    )
+        finally:
+            if self._started:
+                self._schedule_heartbeat_sweep()
 
     def close(self) -> None:
+        self._started = False
+        if self._sweep_handle is not None:
+            self._sweep_handle.cancel()
+            self._sweep_handle = None
         for session in list(self.sessions.values()):
             session.closed = True
         self.sessions.clear()

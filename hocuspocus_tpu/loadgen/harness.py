@@ -57,6 +57,8 @@ class ServedLoadHarness:
         sampled: int = 32,
         edits: int = 200,
         shards: int = 4,
+        devices: int = 0,
+        multi_device: "Optional[dict]" = None,
         shard_rows: Optional[int] = None,
         capacity: int = 1024,
         flush_interval_ms: float = 2.0,
@@ -81,7 +83,14 @@ class ServedLoadHarness:
         self.sampled = min(sampled, num_docs)
         self.edits = edits
         self.shards = shards
-        self.shard_rows = shard_rows or max(int(num_docs / max(shards, 1) * 1.25), 64)
+        # multi-device cell plane: devices > 1 serves each instance from
+        # per-chip merge cells (tpu/cells.py) instead of same-chip
+        # shards; multi_device carries rebalancer tuning (interval,
+        # ratio, batch) straight into the extension
+        self.devices = int(devices)
+        self.multi_device = dict(multi_device or {})
+        partitions = self.devices if self.devices > 1 else max(shards, 1)
+        self.shard_rows = shard_rows or max(int(num_docs / partitions * 1.25), 64)
         self.capacity = capacity
         self.flush_interval_ms = flush_interval_ms
         self.docs_per_socket = docs_per_socket
@@ -134,6 +143,18 @@ class ServedLoadHarness:
 
     def _plane_extension(self) -> "tuple[Any, list]":
         """One serve-mode plane extension + its planes, per the layout."""
+        if self.devices > 1:
+            from ..tpu import MultiDeviceMergeExtension
+
+            ext = MultiDeviceMergeExtension(
+                devices=self.devices,
+                num_docs=self.shard_rows,
+                capacity=self.capacity,
+                flush_interval_ms=self.flush_interval_ms,
+                serve=True,
+                **self.multi_device,
+            )
+            return ext, [cell.plane for cell in ext.cells]
         if self.shards > 1:
             ext = ShardedTpuMergeExtension(
                 shards=self.shards,

@@ -143,6 +143,10 @@ class Scenario:
     edges: int = 0
     cells: int = 0
     shards: int = 1
+    # multi-device cell plane (docs/guides/multi-device.md): devices > 1
+    # serves each instance from per-chip merge cells with load-aware
+    # placement; params["multi_device"] tunes the rebalancer
+    devices: int = 0
     capacity: int = 512
     shard_rows: Optional[int] = None
     docs_per_socket: int = 64
@@ -161,6 +165,7 @@ class Scenario:
             "edges": self.edges,
             "cells": self.cells,
             "shards": self.shards,
+            "devices": self.devices,
             "capacity": self.capacity,
             "shard_rows": self.shard_rows,
             "docs_per_socket": self.docs_per_socket,

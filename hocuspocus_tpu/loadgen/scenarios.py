@@ -25,6 +25,9 @@ workloads, Eg-walker arXiv:2409.14252 realistic-concurrency merges):
   cross-edge fan-out over two merge cells
 - ``edge_handoff``     — mid-run cell drain: transparent handoff, zero
   acked-update loss, byte-identical convergence
+- ``multi_device_storm`` — hot-doc skew on the per-chip cell plane: one
+  mega-doc plus a small-doc population forces load-aware rebalancing
+  mid-run (docs migrate between device cells with zero acked loss)
 """
 
 from __future__ import annotations
@@ -533,6 +536,69 @@ def partition_heal(
     )
 
 
+def multi_device_storm(
+    num_docs: int = 24,
+    phase_ms: int = 1500,
+    devices: int = 4,
+) -> Scenario:
+    """Hot-doc skew on the multi-device cell plane
+    (docs/guides/multi-device.md): a small-doc population plus one
+    mega-doc whose outsized inserts pile dispatched work onto its
+    owning chip. The storm phase's skew must force the rebalancer to
+    migrate docs OFF the hot cell mid-run (evict-snapshot→hydrate, zero
+    acked-update loss — ``verify_convergence`` latches divergence into
+    the verdict via the cross-instance check), and the small docs'
+    interactive p99 holds while the mega-doc churns — the
+    `multi_device_storm.interactive_p99` gate stage in
+    tools/bench_gate.py. Per-device doc counts, utilization spread,
+    placement hash and migration accounting land in
+    ``extra.multi_device`` so the next on-chip capture can verify the
+    226 ms → <50 ms trajectory chip by chip."""
+    return Scenario(
+        name="multi_device_storm",
+        description="hot-doc skew forcing load-aware rebalancing across "
+        "per-device merge cells",
+        num_docs=num_docs,
+        sampled=min(6, num_docs),
+        instances=2,
+        shards=1,
+        devices=devices,
+        capacity=8192,
+        mega_doc=True,
+        docs_per_socket=num_docs,
+        params={
+            "verify_convergence": True,
+            "multi_device": {
+                # CI-scale rebalancer: sweep fast, trip on small skews,
+                # so a three-phase run demonstrably migrates mid-storm
+                "rebalance_interval_s": 0.25,
+                "rebalance_ratio": 1.5,
+                "rebalance_min_units": 64.0,
+                "migrate_batch": 4,
+            },
+        },
+        phases=[
+            PhaseSpec("steady", phase_ms, _edit_gen(24.0, mega_every=12), slo_e2e_ms=1000.0),
+            PhaseSpec(
+                "storm",
+                phase_ms,
+                # every 3rd op is a mega insert into doc 0: its cell's
+                # dispatched-work counter races ahead of its peers and
+                # the rebalancer must spread the small docs away
+                _edit_gen(36.0, mega_every=3, mega_lo=256, mega_hi=512),
+                slo_e2e_ms=1000.0,
+                slo_objective=0.90,
+            ),
+            PhaseSpec(
+                "rebalanced",
+                phase_ms,
+                _edit_gen(24.0, mega_every=12),
+                slo_e2e_ms=1000.0,
+            ),
+        ],
+    )
+
+
 def edge_fanout(
     num_docs: int = 10,
     phase_ms: int = 1200,
@@ -639,19 +705,21 @@ SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "storm": storm,
     "overload_storm": overload_storm,
     "partition_heal": partition_heal,
+    "multi_device_storm": multi_device_storm,
     "edge_fanout": edge_fanout,
     "edge_handoff": edge_handoff,
 }
 
 # the default suite bench.py / bench_capture run: fast enough for every
 # round, covers the single-instance, cross-instance, overload-shed,
-# partition-heal and edge-tier (split front door + cell-drain handoff)
-# paths
+# partition-heal, multi-device-rebalance and edge-tier (split front
+# door + cell-drain handoff) paths
 BENCH_SUITE = (
     "smoke",
     "replication_lag",
     "overload_storm",
     "partition_heal",
+    "multi_device_storm",
     "edge_fanout",
     "edge_handoff",
 )

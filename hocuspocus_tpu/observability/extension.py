@@ -53,6 +53,7 @@ class Metrics(Extension):
         self.debug_endpoints = debug_endpoints
         self._instance = None
         self._plane_owner = None  # extension owning plane(s), for /debug/docs
+        self._cell_owner = None  # multi-device cell plane (labelled gauges)
         self._slow_span_cb = None
         self._slo_task: Optional[asyncio.Task] = None
 
@@ -371,6 +372,18 @@ class Metrics(Extension):
         shards = getattr(owner, "shards", None)
         if shards:
             self._plane_owner = owner
+            # multi-device cell plane (tpu/cells.py): adopt its labelled
+            # per-device gauges (docs/rows/lane-depth/HBM/work per chip,
+            # migration counters, placement epoch) alongside the summed
+            # shard-style aggregates below; the series refresh at scrape
+            # time (on_request) from a live load snapshot
+            if callable(getattr(owner, "cell_metrics", None)):
+                self._cell_owner = owner
+                for metric in owner.cell_metrics():
+                    try:
+                        reg.register(metric)
+                    except ValueError:
+                        pass  # already adopted (shared registry, repeat bind)
             for shard in shards:
                 self._bind_trace_book(shard.plane)
             for key in shards[0].plane.counters:
@@ -665,6 +678,11 @@ class Metrics(Extension):
             # keep the burn-rate gauges and build-info labels fresh
             self.slo.maybe_sample()
             self._set_build_info()
+            if self._cell_owner is not None:
+                try:
+                    self._cell_owner.refresh_cell_metrics()
+                except Exception:
+                    pass  # a mid-teardown cell must not fail the scrape
             body = self.registry.expose()
             if self.expose_tracer:
                 import json

@@ -23,6 +23,8 @@ _LAZY = {
     "MergePlane": ("merge_plane", "MergePlane"),
     "TpuMergeExtension": ("merge_plane", "TpuMergeExtension"),
     "ShardedTpuMergeExtension": ("sharded_extension", "ShardedTpuMergeExtension"),
+    "MultiDeviceMergeExtension": ("cells", "MultiDeviceMergeExtension"),
+    "DevicePlacement": ("cells", "DevicePlacement"),
     "PlaneSupervisor": ("supervisor", "PlaneSupervisor"),
     "ResidencyManager": ("residency", "ResidencyManager"),
     "SupervisedTpuMergeExtension": ("supervisor", "SupervisedTpuMergeExtension"),

@@ -147,6 +147,7 @@ class MergePlane:
         max_slots_per_flush: int = 16,
         mesh=None,
         arena: str = "unit",
+        device=None,
     ) -> None:
         """arena: "unit" (one arena slot per UTF-16 unit; capacity =
         units) or "rle" (one entry per run of consecutively-typed
@@ -155,10 +156,20 @@ class MergePlane:
         long-lived busy docs survive churn that exhausts the unit
         arena — the device-side replacement for yjs GC semantics
         (reference `packages/server/src/types.ts:152-155` yDocOptions.gc).
+
+        device: pin the whole arena (and every upload) to ONE jax
+        device — the multi-device cell plane (tpu/cells.py) builds one
+        plane per chip this way. The arena state is committed to the
+        device, so every jitted step runs there; uploads device_put
+        straight to it (never touching the default device). Mutually
+        exclusive with mesh= (a mesh IS a device layout).
         """
         if arena not in ("unit", "rle"):
             raise ValueError(f"unknown arena {arena!r}")
+        if device is not None and mesh is not None:
+            raise ValueError("pass device= or mesh=, not both")
         self.arena = arena
+        self.device = device
         self.num_docs = num_docs
         self.capacity = capacity
         self.max_slots_per_flush = max_slots_per_flush
@@ -222,6 +233,13 @@ class MergePlane:
             )
         else:
             self.state = self._make_empty(num_docs, capacity)
+            if device is not None:
+                # COMMIT the arena to its chip: jit follows committed
+                # input placement, so every step (flush, canary, warm,
+                # compact) runs on this device with no resharding
+                import jax
+
+                self.state = jax.device_put(self.state, device)
         self.docs: dict[str, PlaneDoc] = {}
         self.free: list[int] = list(range(num_docs - 1, -1, -1))
         self.slot_owner: dict[int, str] = {}  # slot -> doc name
@@ -1003,7 +1021,11 @@ class MergePlane:
             from .scheduler import note_warmed, shared_warm_filter
 
             shapes, covered = shared_warm_filter(
-                self.arena, self.num_docs, self.capacity, shapes
+                self.arena,
+                self.num_docs,
+                self.capacity,
+                shapes,
+                device=self._warm_device_key(),
             )
             for k, b in covered:
                 if b >= self.num_docs:
@@ -1034,12 +1056,26 @@ class MergePlane:
                 self._note_dispatch("warmup")
                 dispatched = True
                 if share:
-                    note_warmed(self.arena, self.num_docs, self.capacity, (k, b))
+                    note_warmed(
+                        self.arena,
+                        self.num_docs,
+                        self.capacity,
+                        (k, b),
+                        device=self._warm_device_key(),
+                    )
         if full_grid:
             # the whole grid is compiled: any later fresh compile means
             # the flush shapes drifted off the warmed buckets
             self.compile_watch.mark_warmed()
         return dispatched
+
+    def _warm_device_key(self) -> str:
+        """The shared-warm-registry discriminator for a pinned plane:
+        XLA caches executables per device placement, so identically-
+        shaped planes on DIFFERENT chips never share a warm pass."""
+        if self.device is None:
+            return ""
+        return str(getattr(self.device, "id", self.device))
 
     def canary_probe(self) -> float:
         """One tiny no-op integrate + data-dependent readback: the plane
@@ -1525,6 +1561,15 @@ class MergePlane:
                     for field, sharding in zip(fields, self._op_shardings)
                 )
             )
+        if self.device is not None:
+            # straight to the pinned chip: an uncommitted jnp.asarray
+            # would land on the default device and pay a device-to-
+            # device hop per field per flush
+            import jax
+
+            return OpBatch(
+                *(jax.device_put(field, self.device) for field in fields)
+            )
         import jax.numpy as jnp
 
         return OpBatch(*(jnp.asarray(field) for field in fields))
@@ -1546,6 +1591,15 @@ class MergePlane:
                 )
             )
             return ops, jax.device_put(slots, self._slots_sharding)
+        if self.device is not None:
+            import jax
+
+            return (
+                OpBatch(
+                    *(jax.device_put(field, self.device) for field in fields)
+                ),
+                jax.device_put(slots, self.device),
+            )
         import jax.numpy as jnp
 
         return OpBatch(*(jnp.asarray(field) for field in fields)), jnp.asarray(
@@ -1792,6 +1846,7 @@ class TpuMergeExtension(Extension):
         plane: Optional[MergePlane] = None,
         serve: bool = False,
         mesh=None,
+        device=None,
         broadcast_interval_ms: float = 2.0,
         arena: str = "unit",
         native_lane: bool = True,
@@ -1823,13 +1878,18 @@ class TpuMergeExtension(Extension):
         sparse arrivals. lane_promote_ms — lane starvation guard: a
         waiter older than this is promoted to the interactive class.
         """
-        if plane is not None and mesh is not None:
+        if plane is not None and (mesh is not None or device is not None):
             raise ValueError(
-                "pass mesh= to the MergePlane you construct, not alongside plane= "
-                "(an explicit plane keeps its own device layout)"
+                "pass mesh=/device= to the MergePlane you construct, not "
+                "alongside plane= (an explicit plane keeps its own device "
+                "layout)"
             )
         self.plane = plane or MergePlane(
-            num_docs=num_docs, capacity=capacity, mesh=mesh, arena=arena
+            num_docs=num_docs,
+            capacity=capacity,
+            mesh=mesh,
+            arena=arena,
+            device=device,
         )
         from .scheduler import BatchGovernor, get_device_lane
 

@@ -413,6 +413,28 @@ class ResidencyManager:
             self._publish_stats(last_eviction_ms=eviction_ms)
         return True
 
+    async def evict_for_migration(self, name: str, document) -> Optional[bytes]:
+        """Cross-cell migration, source side (tpu/cells.py): run the
+        ordinary eviction — snapshot through the serving path, decline
+        while anything is un-broadcast, release the rows — then POP the
+        local evicted record and hand its snapshot to the caller. The
+        doc no longer lives on this cell in any form: the target cell
+        adopts the snapshot (`adopt_snapshot`) and hydrates through its
+        own admission queue. Returns None when the eviction declined
+        (dirty window, already gone) — the caller retries next tick."""
+        if not await self.evict(name, document):
+            return None
+        record = self._evicted_pop(name)
+        return None if record is None else record.snapshot
+
+    def adopt_snapshot(self, name: str, snapshot: bytes) -> None:
+        """Cross-cell migration, target side: seed the evicted-record
+        cache with the source cell's snapshot so the hydration drain
+        warm-loads it exactly like a local eviction's re-entry (the
+        live-document tail replay on top keeps the round trip
+        lossless)."""
+        self._evicted_add(name, snapshot)
+
     def _snapshot(self, name: str, document) -> Optional[bytes]:
         """Encoded full state for the eviction record. The plane's own
         serving path first (healthy + covers the CPU doc, so the bytes

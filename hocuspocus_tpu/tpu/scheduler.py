@@ -465,21 +465,29 @@ class DeviceLane:
         }
 
 
-_default_lane: Optional[DeviceLane] = None
+_default_lanes: "dict[int, DeviceLane]" = {}
 
 
-def get_device_lane() -> DeviceLane:
-    """The process-global arbiter (one device per process)."""
-    global _default_lane
-    if _default_lane is None:
-        _default_lane = DeviceLane()
-    return _default_lane
+def get_device_lane(device_index: int = 0) -> DeviceLane:
+    """The process-global arbiter for one chip.
+
+    One `DeviceLane` per DEVICE, not per process: a single-chip
+    deployment calls this with no argument (index 0, the historical
+    behavior), while the multi-device cell plane (tpu/cells.py) passes
+    each cell's device index — eight chips are eight independent
+    dispatch queues, and serializing them through one arbiter would
+    throw away exactly the parallelism the cells exist to buy. Clients
+    of the SAME chip (shards, residency, canaries) must still share
+    that chip's lane."""
+    lane = _default_lanes.get(device_index)
+    if lane is None:
+        lane = _default_lanes[device_index] = DeviceLane()
+    return lane
 
 
 def reset_device_lane() -> None:
-    """Drop the global lane (tests): the next get builds a fresh one."""
-    global _default_lane
-    _default_lane = None
+    """Drop the global lanes (tests): the next get builds fresh ones."""
+    _default_lanes.clear()
 
 
 # -- arrival-aware batching governor -----------------------------------------
@@ -676,12 +684,22 @@ def _backend_name() -> str:
         return "unknown"
 
 
-def warm_key(arena: str, num_docs: int, capacity: int, shape) -> tuple:
-    return (_backend_name(), arena, num_docs, capacity, tuple(shape))
+def warm_key(
+    arena: str, num_docs: int, capacity: int, shape, device: str = ""
+) -> tuple:
+    """`device` is the pinned-device discriminator (tpu/cells.py): XLA
+    caches executables per device placement, so a shape warmed on chip
+    0 is NOT a cache hit for an identically-shaped plane pinned to chip
+    3 — per-device cells must each run their own warm pass."""
+    return (_backend_name(), device, arena, num_docs, capacity, tuple(shape))
 
 
 def shared_warm_filter(
-    arena: str, num_docs: int, capacity: int, shapes: "list[tuple]"
+    arena: str,
+    num_docs: int,
+    capacity: int,
+    shapes: "list[tuple]",
+    device: str = "",
 ) -> "tuple[list[tuple], list[tuple]]":
     """Split `shapes` into (to_compile, covered) against the registry.
     The caller compiles the first list and marks its CompileTracker
@@ -689,7 +707,7 @@ def shared_warm_filter(
     to_compile: "list[tuple]" = []
     covered: "list[tuple]" = []
     for shape in shapes:
-        key = warm_key(arena, num_docs, capacity, shape)
+        key = warm_key(arena, num_docs, capacity, shape, device)
         if key in _warmed_keys:
             covered.append(shape)
         else:
@@ -697,8 +715,10 @@ def shared_warm_filter(
     return to_compile, covered
 
 
-def note_warmed(arena: str, num_docs: int, capacity: int, shape) -> None:
-    _warmed_keys.add(warm_key(arena, num_docs, capacity, shape))
+def note_warmed(
+    arena: str, num_docs: int, capacity: int, shape, device: str = ""
+) -> None:
+    _warmed_keys.add(warm_key(arena, num_docs, capacity, shape, device))
 
 
 def reset_warm_registry() -> None:

@@ -16,6 +16,13 @@ shard flushes on its own schedule.
 This composes with everything the single-plane extension does (native
 text lane, RLE arena, serving, recycling): the shard is a full
 TpuMergeExtension; the router only dispatches hooks by name hash.
+
+SCOPE: all N shards share ONE chip (and one `DeviceLane`) — this
+router bounds arena-sweep width, not device count. For true data
+parallelism across chips — one arena + lane + governor per device,
+with load-aware placement and cross-cell migration — use the
+multi-device cell plane (tpu/cells.py, `--tpu-devices`,
+docs/guides/multi-device.md).
 """
 
 from __future__ import annotations

@@ -19,6 +19,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .kernels import DocState, OpBatch, integrate_op_slots, make_empty_state
 
 
+def enumerate_devices(count: int = 0) -> list:
+    """The device roster for the per-chip cell plane (tpu/cells.py).
+
+    count <= 0 means "every local device" (the MULTICHIP capture's 8
+    chips); an explicit count larger than the physical roster wraps
+    (cell i pins to device i % n) so CI hosts with one forced-host CPU
+    device can still exercise an 8-cell plane, and a count smaller than
+    the roster uses the first `count` chips."""
+    devices = jax.local_devices()
+    if count <= 0:
+        return list(devices)
+    return [devices[i % len(devices)] for i in range(count)]
+
+
 def make_mesh(devices: Optional[list] = None, doc_axis: Optional[int] = None) -> Mesh:
     """1D or 2D mesh over (doc, unit). Defaults to all devices on doc."""
     devices = devices if devices is not None else jax.devices()

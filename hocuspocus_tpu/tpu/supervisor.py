@@ -145,6 +145,22 @@ class CircuitBreaker:
         return self.state == BREAKER_HALF_OPEN
 
 
+def _runtime_lanes(runtime) -> list:
+    """Every device lane a runtime owns: the multi-device cell plane
+    exposes `lanes()` (one arbiter per chip); single-chip runtimes
+    expose `lane`."""
+    if runtime is None:
+        return []
+    lanes_fn = getattr(runtime, "lanes", None)
+    if callable(lanes_fn):
+        try:
+            return [lane for lane in lanes_fn() if lane is not None]
+        except Exception:
+            return []
+    lane = getattr(runtime, "lane", None)
+    return [lane] if lane is not None else []
+
+
 # -- the supervisor ----------------------------------------------------------
 
 
@@ -211,6 +227,13 @@ class PlaneSupervisor:
         # A probe still QUEUED behind the device lane's warm-grid
         # holder is a busy lane, not a sick device — see _canary.
         self._canary_admission: Optional[dict] = None
+        # per-device breaker scope (tpu/cells.py): when the runtime
+        # exposes `cells`, the watchdog probes each cell through ITS
+        # lane and keeps one breaker per cell — a sick chip degrades
+        # its cell, not the plane. Lazily sized at first probe.
+        self.cell_breakers: "list[CircuitBreaker]" = []
+        self.cell_states: "list[str]" = []
+        self._cell_probes: "dict[int, tuple]" = {}  # index -> (future, admission)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -262,8 +285,7 @@ class PlaneSupervisor:
             return
         # never leave a (possibly process-global) lane parked behind:
         # the next deployment in this process must admit freely
-        lane = getattr(runtime, "lane", None)
-        if lane is not None:
+        for lane in _runtime_lanes(runtime):
             lane.resume()
         if self.state == STATE_READY:
             try:
@@ -353,10 +375,9 @@ class PlaneSupervisor:
         runtime, instance = self.runtime, self._instance
         if runtime is None:
             return
-        lane = getattr(runtime, "lane", None)
-        if lane is not None:
-            # un-park the device lane BEFORE serving resumes: the first
-            # re-onboard flushes need admissions to flow again
+        for lane in _runtime_lanes(runtime):
+            # un-park the device lane(s) BEFORE serving resumes: the
+            # first re-onboard flushes need admissions to flow again
             lane.resume()
         for serving in runtime.servings():
             serving.paused = False
@@ -391,6 +412,15 @@ class PlaneSupervisor:
             await asyncio.sleep(self.watchdog_interval)
             if self._stopped:
                 return
+            runtime = self.runtime
+            if runtime is not None and getattr(runtime, "cells", None):
+                # multi-device runtime: per-cell probes + breakers as
+                # long as any cell is attached (READY covers "some
+                # cells healthy"; DEGRADED covers "all cells open" —
+                # half-open recovery still needs probes flowing)
+                if self.state in (STATE_READY, STATE_DEGRADED):
+                    await self._watchdog_cells(runtime)
+                continue
             if self.state == STATE_READY:
                 ok, _latency = await self._canary()
                 if ok:
@@ -515,6 +545,166 @@ class PlaneSupervisor:
                 pass
         return True, latency
 
+    # -- per-cell watchdog (multi-device cell plane, tpu/cells.py) -----------
+
+    def _ensure_cell_scope(self, runtime) -> None:
+        cells = runtime.cells
+        while len(self.cell_breakers) < len(cells):
+            self.cell_breakers.append(CircuitBreaker(self.breaker.threshold))
+            self.cell_states.append(STATE_READY)
+
+    async def _watchdog_cells(self, runtime) -> None:
+        """One watchdog tick over every device cell: ready cells run a
+        plain canary feeding their own breaker (a trip degrades THAT
+        cell — its docs drain to CPU, its lane parks, placement routes
+        around it); degraded cells run half-open recovery probes and
+        re-attach on success. The GLOBAL state reflects the fleet:
+        READY while any cell serves, DEGRADED when every chip is out."""
+        self._ensure_cell_scope(runtime)
+        for index, cell in enumerate(runtime.cells):
+            if self._stopped:
+                return
+            breaker = self.cell_breakers[index]
+            if self.cell_states[index] == STATE_READY:
+                ok, _latency = await self._canary_cell(index, cell)
+                if ok:
+                    breaker.record_success()
+                elif ok is False and breaker.record_failure():
+                    self._trip_cell(runtime, index)
+            elif breaker.state in (BREAKER_OPEN, BREAKER_HALF_OPEN):
+                breaker.try_half_open()
+                ok, _latency = await self._canary_cell(index, cell)
+                if ok:
+                    breaker.record_success()
+                    await self._restore_cell(runtime, index)
+                elif ok is False:
+                    breaker.record_failure()
+        ready = [state == STATE_READY for state in self.cell_states]
+        if any(ready) and self.state != STATE_READY:
+            self._set_state(STATE_READY)
+        elif not any(ready) and self.state == STATE_READY:
+            self._set_state(STATE_DEGRADED)
+
+    async def _canary_cell(self, index: int, cell) -> "tuple[Optional[bool], Optional[float]]":
+        """One deadline-bounded canary for ONE cell's plane, admitted
+        through that cell's own lane. The same single-outstanding-probe
+        discipline as the global canary, tracked per cell: a wedged
+        chip accumulates one blocked probe, and every tick it stays
+        unfinished is a deadline overrun for that cell alone."""
+        self.counters["canary_probes"] += 1
+        outstanding = self._cell_probes.get(index)
+        if outstanding is not None and not outstanding[0].done():
+            if self._cell_lane_busy_with_warmup(cell, outstanding[1]):
+                self.counters["canary_busy_skips"] += 1
+                return None, None
+            self.counters["canary_failures"] += 1
+            return False, None
+
+        loop = asyncio.get_event_loop()
+        admission = {"granted": cell.lane is None}
+
+        async def probe() -> float:
+            ticket = None
+            if cell.lane is not None:
+                from .scheduler import CLASS_CANARY
+
+                ticket = await cell.lane.admit(
+                    CLASS_CANARY, site="canary", ignore_pause=True
+                )
+            admission["granted"] = True
+            started = time.perf_counter()
+            try:
+                async with cell.plane.flush_lock:
+                    await loop.run_in_executor(None, cell.plane.canary_probe)
+            finally:
+                if ticket is not None:
+                    ticket.release()
+            return time.perf_counter() - started
+
+        future = asyncio.ensure_future(probe())
+        future.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None
+        )
+        self._cell_probes[index] = (future, admission)
+        tracer = get_tracer()
+        try:
+            latency = await asyncio.wait_for(
+                asyncio.shield(future), self.canary_deadline
+            )
+        except asyncio.TimeoutError:
+            if self._cell_lane_busy_with_warmup(cell, admission):
+                self.counters["canary_busy_skips"] += 1
+                return None, None
+            self.counters["canary_failures"] += 1
+            tracer.event(
+                "supervisor.canary_overrun",
+                deadline_s=self.canary_deadline,
+                cell=index,
+            )
+            return False, None
+        except Exception as error:
+            self.counters["canary_failures"] += 1
+            tracer.event(
+                "supervisor.canary_error", error=repr(error), cell=index
+            )
+            return False, None
+        self.last_canary_latency = latency
+        for fn in list(self.on_canary):
+            try:
+                fn(latency)
+            except Exception:
+                pass
+        return True, latency
+
+    def _cell_lane_busy_with_warmup(self, cell, admission: dict) -> bool:
+        """Per-cell twin of _lane_busy_with_warmup: a probe still queued
+        behind the cell lane's bounded warm-grid holder is a busy chip,
+        not a sick one."""
+        if admission.get("granted") or cell.lane is None:
+            return False
+        info = cell.lane.holder_info()
+        if info is None or info[0] != "warmup":
+            return False
+        budget = max(4.0 * self.canary_deadline, 1.0)
+        return info[2] < budget
+
+    def _trip_cell(self, runtime, index: int) -> None:
+        """One cell's breaker opened: degrade that cell only. The
+        runtime pauses the cell's serving, parks its lane, drops it out
+        of placement and drains its docs to the CPU path — the other
+        chips keep serving untouched."""
+        self.counters["degrades"] += 1
+        self.cell_states[index] = STATE_DEGRADED
+        _logger_mod.log_error(
+            f"plane watchdog: cell {index} breaker OPEN; draining its "
+            "documents to the CPU path (other cells unaffected)"
+        )
+        get_flight_recorder().record(
+            "__plane__", "cell_breaker_open", cell=index
+        )
+        try:
+            runtime.degrade_cell(index)
+        except Exception:
+            _logger_mod.log_error(
+                f"cell {index} degrade sweep failed (docs heal via sync)"
+            )
+
+    async def _restore_cell(self, runtime, index: int) -> None:
+        self.counters["attaches"] += 1
+        self.cell_states[index] = STATE_READY
+        _logger_mod.logger.info(
+            f"plane cell {index} recovered; hot re-attaching its documents"
+        )
+        get_flight_recorder().record(
+            "__plane__", "cell_breaker_close", cell=index
+        )
+        try:
+            await runtime.restore_cell(index, self._instance)
+        except Exception:
+            _logger_mod.log_error(
+                f"cell {index} restore failed; docs stay on the CPU path"
+            )
+
     def _lane_busy_with_warmup(self) -> bool:
         """True when the outstanding probe is still queued for the
         device lane AND the lane's active holder is a warm-grid
@@ -557,12 +747,11 @@ class PlaneSupervisor:
         for serving in runtime.servings():
             serving.paused = True
             serving.abort_pending()
-        # park the device lane: queued flush/hydration/compaction
+        # park the device lane(s): queued flush/hydration/compaction
         # admissions defer (their tasks reschedule instead of stacking
         # onto a wedged device); only pause-exempt canary probes pass,
         # so half-open recovery can still reach the chip
-        lane = getattr(runtime, "lane", None)
-        if lane is not None:
+        for lane in _runtime_lanes(runtime):
             lane.pause()
         try:
             runtime.degrade_all()
@@ -597,7 +786,16 @@ class PlaneSupervisor:
 
     def snapshot(self) -> dict:
         """JSON-able health summary (healthz payload / get_health)."""
+        cells = None
+        if self.cell_states:
+            cells = [
+                {"cell": i, "state": state, "breaker": breaker.state}
+                for i, (state, breaker) in enumerate(
+                    zip(self.cell_states, self.cell_breakers)
+                )
+            ]
         return {
+            **({"cells": cells} if cells is not None else {}),
             "state": self.state,
             "serving_from_plane": self.state == STATE_READY,
             "degraded": self.state != STATE_READY,
@@ -644,6 +842,7 @@ class SupervisedTpuMergeExtension(Extension):
         self,
         *,
         shards: int = 1,
+        devices: int = 1,
         init_timeout: float = 30.0,
         watchdog_interval: float = 5.0,
         breaker_threshold: int = 3,
@@ -652,11 +851,26 @@ class SupervisedTpuMergeExtension(Extension):
         runtime_factory: Optional[Callable[[], Any]] = None,
         **plane_kwargs: Any,
     ) -> None:
+        """devices != 1 builds the multi-device cell plane (tpu/cells.py):
+        one arena+lane+governor per chip with load-aware placement
+        (0 = one cell per visible device). Mutually exclusive with
+        shards > 1 — cells subsume doc-sharding across chips."""
         if runtime_factory is None:
+            if devices != 1 and shards > 1:
+                raise ValueError(
+                    "pass either devices (per-chip cells) or shards "
+                    "(single-chip doc partitions), not both"
+                )
 
             def runtime_factory() -> Any:
                 # imported HERE, in the worker thread: kernel/JAX import
                 # and device discovery all happen under the init budget
+                if devices != 1:
+                    from .cells import MultiDeviceMergeExtension
+
+                    return MultiDeviceMergeExtension(
+                        devices=devices, **plane_kwargs
+                    )
                 if shards > 1:
                     from .sharded_extension import ShardedTpuMergeExtension
 

@@ -198,6 +198,65 @@ async def test_cell_drain_hands_off_without_client_visible_disconnect():
         await topo.close()
 
 
+async def test_heartbeat_expiry_drives_router_and_handoff():
+    """ISSUE-14 satellite: the edge's heartbeat sweep actually DRIVES
+    `CellRouter.expire_stale` — a cell that dies WITHOUT announcing
+    (kill -9: its CELL_DOWN never goes out) flips to dead when its
+    heartbeats go quiet past the timeout, and its docs hand off to the
+    survivor with the usual Auth+Step1 replay, no client-visible
+    disconnect, zero acked-update loss."""
+    topo = await Topology().start(
+        cells=2, edges=1, heartbeat_timeout_s=0.6, heartbeat_sweep_s=0.1
+    )
+    try:
+        writer = topo.provider(0, "doc-exp")
+        reader = topo.provider(0, "doc-exp")
+        await wait_synced(writer, reader)
+        writer.document.get_text("body").insert(0, "acked-before-death ")
+        await wait_for(
+            lambda: "acked-before-death" in str(reader.document.get_text("body"))
+        )
+        closes = []
+        for provider in (writer, reader):
+            provider.on("close", lambda *a, **k: closes.append("close"))
+        owner, owner_ext = topo.cell_owning("doc-exp")
+        assert owner is not None
+        gateway = topo.edges[0][1].gateway
+        # the silent death (kill -9 at the relay level): heartbeats,
+        # the destroy-time CELL_DOWN AND the dying sessions' CLOSED/
+        # close frames all vanish — the edge can only learn via the
+        # expiry sweep
+        owner_ext._announce = lambda kind: None
+        owner_ext.publish_to_edge = lambda edge_id, envelope: None
+        if owner_ext._announce_handle is not None:
+            owner_ext._announce_handle.cancel()
+            owner_ext._announce_handle = None
+        await owner.destroy()
+        topo.cells = [entry for entry in topo.cells if entry[0] is not owner]
+        await wait_for(
+            lambda: gateway.router.state_of(owner_ext.cell_id) == "dead",
+            timeout=10,
+        )
+        assert gateway.counters["heartbeat_expiries"] >= 1
+        # traffic flows again through the survivor after the handoff
+        writer.document.get_text("body").insert(0, "post-expiry ")
+        await wait_for(
+            lambda: "post-expiry" in str(reader.document.get_text("body")),
+            timeout=15,
+        )
+        await wait_for(
+            lambda: encode_state_as_update(writer.document)
+            == encode_state_as_update(reader.document)
+        )
+        assert "acked-before-death" in str(reader.document.get_text("body"))
+        assert not closes, f"client-visible disconnect on expiry: {closes}"
+        assert gateway.handoffs_total.value(reason="expired") >= 1
+        survivor, _ = topo.cell_owning("doc-exp")
+        assert survivor is not None and survivor is not owner
+    finally:
+        await topo.close()
+
+
 async def test_stale_route_refused_by_cell_and_healed():
     """A cell that started draining before the edge heard about it
     refuses the OPEN with CLOSED(1012): the edge downgrades the route

@@ -230,6 +230,36 @@ def test_gate_extracts_overload_storm_interactive_p99():
     assert any("overload_storm.interactive_p99" in r for r in regressions)
 
 
+def test_gate_extracts_multi_device_storm_interactive_p99():
+    """The multi_device_storm storm-phase p99 (small-doc interactive
+    latency while one mega-doc skews a chip hot and the rebalancer
+    migrates docs off it) is a gated stage — hot-doc skew must not
+    bleed back into the interactive path across rounds."""
+    payload = _artifact()
+    payload["extra"]["scenario_suite"] = {
+        "verdict": "pass",
+        "scenarios": {
+            "multi_device_storm": {
+                "verdict": "pass",
+                "breached": [],
+                "phase_p99_ms": {
+                    "steady": 4.0, "storm": 9.0, "rebalanced": 4.0,
+                },
+            }
+        },
+    }
+    stages = bench_gate.stage_p99s(payload)
+    assert stages["multi_device_storm.interactive_p99"] == 9.0
+    current = json.loads(json.dumps(payload))
+    current["extra"]["scenario_suite"]["scenarios"]["multi_device_storm"][
+        "phase_p99_ms"
+    ]["storm"] = 90.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert any("multi_device_storm.interactive_p99" in r for r in regressions)
+
+
 def test_gate_extracts_edge_fanout_interactive_p99():
     """The edge_fanout fanout-phase p99 (cross-edge interactive latency
     through the relay lane) is a gated stage — the split front door

@@ -1,0 +1,607 @@
+"""Multi-device merge cells (tpu/cells.py): one arena + lane + governor
+per chip, rendezvous doc placement, load-aware rebalancing over the
+evict-snapshot→hydrate migration rail, and per-cell breaker scope.
+
+Runs on the conftest's 8-device forced-host CPU mesh, so placement,
+migration and per-device lane accounting are exercised with REAL
+distinct jax devices. The acceptance invariants pinned here:
+
+- docs spread across all devices (no device owns >2x the mean after
+  rebalance under hot-doc skew);
+- doc migration loses zero acknowledged updates under concurrent edits
+  and never disconnects a client;
+- per-device lane dispatch accounting shows zero bypass across the
+  whole serving pipeline;
+- the multi-device plane's served state is byte-identical to the
+  single-device plane's under a fuzzed mixed workload;
+- a sick chip degrades its cell only (supervisor per-device breakers).
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.tpu.cells import (
+    DevicePlacement,
+    MultiDeviceMergeExtension,
+    plan_migrations,
+)
+from hocuspocus_tpu.tpu.merge_plane import TpuMergeExtension
+from hocuspocus_tpu.tpu.scheduler import DeviceLane
+from tests.tpu.test_scheduler import _scripted_workload
+from tests.utils import (
+    new_hocuspocus,
+    new_provider,
+    retryable_assertion,
+    wait_synced,
+)
+
+
+def _assert(cond, detail=None):
+    assert cond, detail
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lanes():
+    """Per-device lanes are process-global (`get_device_lane(i)`):
+    without a reset, one test's teardown dispatches pollute the next
+    test's lane accounting."""
+    from hocuspocus_tpu.tpu.scheduler import reset_device_lane
+
+    reset_device_lane()
+    yield
+    reset_device_lane()
+
+
+def _cells_ext(devices=4, **kwargs) -> MultiDeviceMergeExtension:
+    kwargs.setdefault("num_docs", 16)
+    kwargs.setdefault("capacity", 2048)
+    kwargs.setdefault("flush_interval_ms", 1)
+    kwargs.setdefault("rebalance_interval_s", 0)  # tests tick manually
+    return MultiDeviceMergeExtension(devices=devices, **kwargs)
+
+
+# -- placement ----------------------------------------------------------------
+
+
+def test_placement_spreads_docs_and_moves_minimally():
+    placement = DevicePlacement(8)
+    names = [f"doc-{i}" for i in range(400)]
+    owners = {name: placement.place(name) for name in names}
+    counts = [0] * 8
+    for owner in owners.values():
+        counts[owner] += 1
+    mean = len(names) / 8
+    assert max(counts) < 2 * mean, counts
+    assert min(counts) > 0, counts
+    # minimal movement: marking one cell down moves ONLY its docs
+    placement.mark_down(3)
+    moved = {n for n in names if placement.place(n) != owners[n]}
+    assert moved == {n for n, o in owners.items() if o == 3}
+    assert all(placement.place(n) != 3 for n in moved)
+    placement.mark_up(3)
+    assert all(placement.place(n) == owners[n] for n in names)
+    # override precedence: wins while healthy, falls through when down
+    placement.set_override("doc-0", 5)
+    assert placement.place("doc-0") == 5
+    placement.mark_down(5)
+    assert placement.place("doc-0") == owners["doc-0"]
+    placement.mark_up(5)
+    before = placement.placement_hash()
+    placement.clear_override("doc-0")
+    assert placement.placement_hash() != before  # hash tracks the map
+
+
+def _projected(cell_work, moves, doc_work):
+    work = [float(w) for w in cell_work]
+    for name, src, dst in moves:
+        weight = doc_work[src][name]
+        work[src] -= weight
+        work[dst] += weight
+    return work
+
+
+def test_plan_migrations_moves_small_docs_not_an_unimprovable_mega():
+    # cell 0 hot: one mega doc + small docs; peers carry real load, so
+    # relocating the mega could not improve anything — the small docs
+    # stacked under it move instead
+    doc_work = [
+        {"mega": 5000.0, "s1": 60.0, "s2": 50.0, "s3": 40.0},
+        {"a": 300.0},
+        {"b": 250.0},
+        {"c": 280.0},
+    ]
+    cell_work = [sum(w.values()) for w in doc_work]
+    moves = plan_migrations(
+        cell_work, doc_work, healthy={0, 1, 2, 3}, ratio=1.5,
+        min_excess=10.0, batch=8,
+    )
+    assert moves, "hot cell must shed"
+    moved_docs = {name for name, _src, _dst in moves}
+    assert "mega" not in moved_docs, moves
+    assert moved_docs <= {"s1", "s2", "s3"}
+    assert all(src == 0 for _n, src, _d in moves)
+    # every plan strictly improves the skew
+    projected = _projected(cell_work, moves, doc_work)
+    assert max(projected) <= max(cell_work)
+
+
+def test_plan_migrations_spreads_stacked_hot_docs():
+    # two hot docs STACKED on one chip: at least one moves to a cold
+    # chip — "hot docs spread across chips instead of stacking"
+    doc_work = [{"hot-a": 1000.0, "hot-b": 900.0}, {"x": 10.0}, {"y": 5.0}]
+    cell_work = [1900.0, 10.0, 5.0]
+    moves = plan_migrations(
+        cell_work, doc_work, healthy={0, 1, 2}, ratio=1.2,
+        min_excess=10.0, batch=4,
+    )
+    moved = {name: dst for name, _src, dst in moves}
+    assert "hot-a" in moved or "hot-b" in moved, moves
+    projected = _projected(cell_work, moves, doc_work)
+    # the stacked pair ends up split: no chip carries both hot docs
+    assert max(projected) < max(cell_work)
+    assert max(projected) <= 1100.0, projected
+
+
+def test_rebalance_plan_sheds_rows_when_occupancy_is_the_hot_signal():
+    """Finding from review: occupancy/HBM pressure must drive
+    migrations even when dispatched WORK is balanced — the plan flips
+    to rows attribution (freeing rows is what those signals need)."""
+    ext = _cells_ext(devices=4, num_docs=16)
+    try:
+        stats = []
+        for i in range(4):
+            hot = i == 0
+            stats.append(
+                {
+                    "cell": i,
+                    "device": str(i),
+                    "healthy": True,
+                    "docs": 8 if hot else 2,
+                    "rows_in_use": 14 if hot else 2,
+                    "occupancy": 0.875 if hot else 0.125,
+                    "pending_ops": 0,
+                    "lane_queue_depth": 0,
+                    # work BALANCED: the old work-only plan returns []
+                    "work_units": 100.0,
+                    "hbm_bytes": 1000,
+                    "doc_work": {f"d{i}-{j}": 12.5 for j in range(8 if hot else 2)},
+                    "doc_rows": {
+                        f"d{i}-{j}": (2.0 if hot else 1.0)
+                        for j in range(8 if hot else 2)
+                    },
+                }
+            )
+        moves = ext.rebalance_plan(stats)
+        assert moves, "occupancy-hot cell must shed by rows"
+        assert all(src == 0 for _n, src, _d in moves)
+    finally:
+        ext.cancel_timers()
+
+
+async def test_rebalance_timer_never_rearms_after_teardown():
+    """Finding from review: an in-flight tick's reschedule must respect
+    cancel_timers/on_destroy — no immortal timer over destroyed cells."""
+    ext = _cells_ext(devices=2, num_docs=8, rebalance_interval_s=0.01)
+    ext._schedule_rebalance()
+    assert ext._rebalance_handle is not None
+    ext.cancel_timers()
+    assert ext._rebalance_handle is None
+    # a late reschedule (what the tick's finally does) is now inert
+    ext._schedule_rebalance()
+    assert ext._rebalance_handle is None
+
+
+# -- device pinning -----------------------------------------------------------
+
+
+def test_cells_pin_arenas_to_distinct_devices():
+    assert len(jax.devices()) == 8  # conftest's forced-host mesh
+    ext = _cells_ext(devices=8, num_docs=8, capacity=256)
+    try:
+        lanes = {id(cell.lane) for cell in ext.cells}
+        assert len(lanes) == 8, "one arbiter per chip"
+        for i, cell in enumerate(ext.cells):
+            assert cell.plane.device is ext.devices[i]
+            assert cell.plane.state.id_client.devices() == {ext.devices[i]}
+        # a flush keeps the state on its chip
+        cell = ext.cells[5]
+        source = Doc()
+        source.get_text("t").insert(0, "pinned")
+        cell.plane.register("pin-doc")
+        cell.plane.enqueue_update("pin-doc", encode_state_as_update(source))
+        cell.plane.flush(None)
+        assert cell.plane.state.id_client.devices() == {ext.devices[5]}
+        assert cell.plane.text("pin-doc") == "pinned"
+    finally:
+        ext.cancel_timers()
+
+
+# -- multi vs single differential ---------------------------------------------
+
+
+async def _run_workload_cells(extension, names, updates):
+    from hocuspocus_tpu.server.types import Payload
+    from tests.tpu.test_scheduler import _ServedDoc
+
+    docs = {}
+    for name in names:
+        doc = _ServedDoc(name)
+        docs[name] = doc
+        await extension.after_load_document(
+            Payload(instance=None, document_name=name, document=doc)
+        )
+    for i, (name, update) in enumerate(updates):
+        doc = docs[name]
+        apply_update(doc, update)
+        cell = extension.cell_for(name)
+        captured = cell.try_capture(doc, update, origin=None)
+        assert captured, f"update {i} fell off the plane"
+        if i % 7 == 0:
+            await asyncio.sleep(0.002)
+    for cell in extension.cells:
+        await cell._flush_now(max_batches=None, final=True)
+        cell._broadcast_served(cross_instance=False)
+    return docs
+
+
+async def test_multi_device_state_matches_single_device_plane():
+    """Byte-identical convergence fuzz: the same scripted mixed workload
+    through an 8-cell multi-device plane and a single-device plane
+    serves identical bytes per doc — placement and per-device kernels
+    change WHERE work runs, never what state results."""
+    names, updates, sources = _scripted_workload(seed=11, docs=6, edits=80)
+    multi = _cells_ext(devices=8, num_docs=8, capacity=2048, native_lane=False)
+    single = TpuMergeExtension(
+        serve=True,
+        num_docs=16,
+        capacity=2048,
+        flush_interval_ms=1,
+        lane=DeviceLane(),
+        native_lane=False,
+    )
+    try:
+        docs_multi = await _run_workload_cells(multi, names, updates)
+        from tests.tpu.test_scheduler import _run_workload
+
+        docs_single = await _run_workload(single, names, updates)
+        for name in names:
+            want = sources[name].get_text("t").to_string()
+            assert multi.cell_for(name).plane.text(name) == want
+            assert single.plane.text(name) == want
+            served_multi = multi.cell_for(name).serving.encode_state_as_update(
+                name, docs_multi[name]
+            )
+            served_single = single.serving.encode_state_as_update(
+                name, docs_single[name]
+            )
+            assert served_multi is not None
+            assert served_multi == served_single
+        # the workload actually spread over multiple chips
+        populated = [
+            cell for cell in multi.cells if len(cell.plane.docs) > 0
+        ]
+        assert len(populated) > 1, "placement stacked every doc on one chip"
+    finally:
+        multi.cancel_timers()
+        single.cancel_timers()
+
+
+# -- migration under live traffic ---------------------------------------------
+
+
+async def test_migration_under_concurrent_edits_loses_nothing():
+    """The zero-acked-update-loss acceptance: migrate a doc between
+    cells WHILE its writer edits; every acknowledged update survives,
+    the client never disconnects, and the doc ends up served by the
+    target cell."""
+    ext = _cells_ext(devices=4)
+    server = await new_hocuspocus(extensions=[ext])
+    a = new_provider(server, name="mig-doc")
+    b = new_provider(server, name="mig-doc")
+    try:
+        await wait_synced(a, b)
+        src = ext.cell_index_for("mig-doc")
+        a.document.get_text("t").insert(0, "before;")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string() == "before;"
+            )
+        )
+        dst = (src + 1) % len(ext.cells)
+
+        async def edits():
+            for i in range(20):
+                a.document.get_text("t").insert(0, f"e{i};")
+                await asyncio.sleep(0.002)
+
+        edit_task = asyncio.ensure_future(edits())
+        moved = False
+        for _ in range(100):
+            if await ext.migrate_doc("mig-doc", src, dst):
+                moved = True
+                break
+            await asyncio.sleep(0.01)
+        await edit_task
+        assert moved, ext.migration_stats
+        assert ext.migration_stats["docs_migrated"] == 1
+        assert ext.placement.overrides["mig-doc"] == dst
+        await retryable_assertion(
+            lambda: _assert("mig-doc" in ext.cells[dst]._docs), timeout=10
+        )
+        assert "mig-doc" not in ext.cells[src]._docs
+        a.document.get_text("t").insert(0, "after;")
+        await retryable_assertion(
+            lambda: _assert(
+                b.document.get_text("t").to_string()
+                == a.document.get_text("t").to_string()
+                and "after;" in b.document.get_text("t").to_string()
+            ),
+            timeout=10,
+        )
+        text = b.document.get_text("t").to_string()
+        assert "before;" in text
+        for i in range(20):
+            assert f"e{i};" in text, f"acked update e{i} lost in migration"
+        # no client saw a disconnect
+        assert a.synced and b.synced
+    finally:
+        a.destroy()
+        b.destroy()
+        await server.destroy()
+
+
+async def test_rebalance_spreads_hot_cell_and_no_lane_bypass():
+    """Hot-doc skew through a live server: pile dispatched work onto
+    one cell's docs, tick the rebalancer, and the population spreads —
+    no device owns >2x the mean — with every device dispatch accounted
+    in-lane (zero bypass across all per-device lanes)."""
+    ext = _cells_ext(
+        devices=4,
+        num_docs=24,
+        capacity=8192,
+        rebalance_ratio=1.5,
+        rebalance_min_units=64.0,
+        migrate_batch=8,
+    )
+    server = await new_hocuspocus(extensions=[ext])
+    providers = []
+    try:
+        names = [f"spread-{i}" for i in range(16)]
+        for name in names:
+            provider = new_provider(server, name=name)
+            providers.append(provider)
+        await wait_synced(*providers)
+        by_cell: "dict[int, list[str]]" = {}
+        for name in names:
+            by_cell.setdefault(ext.cell_index_for(name), []).append(name)
+        hot = max(by_cell, key=lambda i: len(by_cell[i]))
+        assert len(by_cell[hot]) >= 2, by_cell
+        # make the hot cell's docs genuinely hot: big inserts -> big
+        # dispatched-unit tallies on that chip
+        for name in by_cell[hot]:
+            index = names.index(name)
+            providers[index].document.get_text("t").insert(0, "z" * 600)
+        await retryable_assertion(
+            lambda: _assert(
+                sum(
+                    ext.cells[hot].plane.dispatched_units[s]
+                    for d in ext.cells[hot].plane.docs.values()
+                    for s in d.seqs.values()
+                )
+                > 0
+            ),
+            timeout=10,
+        )
+        migrated = 0
+        for _ in range(30):
+            await ext._rebalance_tick()
+            migrated = ext.migration_stats["docs_migrated"]
+            stats = [s for s in ext.cell_stats() if s["healthy"]]
+            docs = [s["docs"] for s in stats]
+            mean = sum(docs) / len(docs)
+            if migrated > 0 and max(docs) <= 2 * mean:
+                break
+            await asyncio.sleep(0.05)
+        assert migrated > 0, ext.migration_stats
+        # let the hydration drains land, then check the spread
+        await asyncio.sleep(0.2)
+        await retryable_assertion(
+            lambda: _assert(ext.served_docs() == len(names)), timeout=10
+        )
+        spread = ext.utilization_spread()
+        assert spread["docs_max_over_mean"] is not None
+        assert spread["docs_max_over_mean"] <= 2.0, spread
+        # zero bypass on EVERY per-device lane, across load-time presync
+        # flushes, captures, warm grids, eviction and hydration
+        for i, cell in enumerate(ext.cells):
+            assert cell.lane.counters["dispatches_bypass"] == 0, (
+                i,
+                cell.lane.snapshot(),
+            )
+            assert cell.lane.counters["dispatches_in_lane"] > 0, (
+                i,
+                "cell never dispatched — placement skipped a device?",
+            )
+    finally:
+        for provider in providers:
+            provider.destroy()
+        await server.destroy()
+
+
+# -- per-cell breaker scope ----------------------------------------------------
+
+
+async def test_supervisor_degrades_one_sick_cell_not_the_plane():
+    """One chip wedges: ITS cell degrades (lane parked, placement
+    routes around it, docs drain to CPU) while the other cells keep
+    serving; a passing recovery probe restores it and re-onboards its
+    docs."""
+    from hocuspocus_tpu.tpu.supervisor import STATE_READY, PlaneSupervisor
+
+    ext = _cells_ext(devices=2, num_docs=16)
+    supervisor = PlaneSupervisor(
+        lambda: ext, watchdog_interval=60.0, breaker_threshold=2,
+        canary_deadline=0.5,
+    )
+    server = await new_hocuspocus(extensions=[ext])
+    providers = []
+    try:
+        names = [f"breaker-{i}" for i in range(8)]
+        for name in names:
+            provider = new_provider(server, name=name)
+            providers.append(provider)
+        await wait_synced(*providers)
+        supervisor.runtime = ext
+        supervisor._instance = server.hocuspocus
+        supervisor.state = STATE_READY
+        sick = 0
+        healthy = 1
+        sick_docs = [n for n in names if ext.cell_index_for(n) == sick]
+        well_docs = [n for n in names if ext.cell_index_for(n) == healthy]
+        assert sick_docs and well_docs, "placement stacked one cell"
+
+        def broken_probe():
+            raise RuntimeError("chip wedged")
+
+        original = ext.cells[sick].plane.canary_probe
+        ext.cells[sick].plane.canary_probe = broken_probe
+        for _ in range(3):
+            await supervisor._watchdog_cells(ext)
+            await asyncio.sleep(0.05)
+        assert supervisor.cell_breakers[sick].state == "open"
+        assert supervisor.cell_states[sick] != STATE_READY
+        assert ext.cells[sick].lane.paused
+        assert sick not in ext.placement.healthy
+        # the sick cell's docs fell back to CPU; the healthy cell's did not
+        for name in sick_docs:
+            assert name not in ext.cells[sick]._docs
+        for name in well_docs:
+            assert name in ext.cells[healthy]._docs
+        assert not ext.cells[healthy].lane.paused
+        # global state: the plane still serves
+        assert supervisor.state == STATE_READY
+        # a CPU-path edit still works while degraded
+        index = names.index(sick_docs[0])
+        providers[index].document.get_text("t").insert(0, "degraded-ok")
+        # recovery: probe passes -> cell restored + docs re-onboarded
+        ext.cells[sick].plane.canary_probe = original
+        for _ in range(3):
+            await supervisor._watchdog_cells(ext)
+            await asyncio.sleep(0.05)
+        assert supervisor.cell_breakers[sick].state == "closed"
+        assert supervisor.cell_states[sick] == STATE_READY
+        assert not ext.cells[sick].lane.paused
+        assert sick in ext.placement.healthy
+        await retryable_assertion(
+            lambda: _assert(
+                all(ext.is_served(name) for name in sick_docs),
+                [ (n, ext.is_served(n)) for n in sick_docs],
+            ),
+            timeout=10,
+        )
+    finally:
+        for provider in providers:
+            provider.destroy()
+        await server.destroy()
+
+
+# -- observability + CLI -------------------------------------------------------
+
+
+async def test_debug_scheduler_and_per_device_metrics():
+    import json
+
+    import aiohttp
+
+    from hocuspocus_tpu.observability import Metrics
+
+    ext = _cells_ext(devices=4, num_docs=8, capacity=512)
+    server = await new_hocuspocus(extensions=[Metrics(), ext])
+    a = new_provider(server, name="cells-debug-doc")
+    try:
+        await wait_synced(a)
+        a.document.get_text("t").insert(0, "observed")
+        owner = ext.cell_for("cells-debug-doc")
+        await retryable_assertion(
+            lambda: _assert(owner.lane.counters["admissions"] > 0)
+        )
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"{server.http_url}/debug/scheduler"
+            ) as response:
+                assert response.status == 200
+                body = json.loads(await response.text())
+            async with session.get(f"{server.http_url}/metrics") as response:
+                metrics_text = await response.text()
+        assert len(body["devices"]) == 4
+        section = body["devices"][ext.cell_index_for("cells-debug-doc")]
+        assert section["lane"]["classes"]["interactive"]["admissions"] > 0
+        assert section["docs"] == 1
+        assert body["placement"]["hash"]
+        assert "migrations" in body and "rebalance" in body
+        # per-device labelled gauges + the summed plane aggregates
+        assert 'hocuspocus_tpu_cell_docs{cell="' in metrics_text
+        assert "hocuspocus_tpu_cell_hbm_bytes" in metrics_text
+        assert "hocuspocus_tpu_cell_lane_queue_depth" in metrics_text
+        assert "hocuspocus_tpu_cell_placement_epoch" in metrics_text
+        assert "hocuspocus_tpu_plane_broadcasts" in metrics_text
+    finally:
+        a.destroy()
+        await server.destroy()
+
+
+def test_cli_exposes_multi_device_flags():
+    from hocuspocus_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        [
+            "--tpu-serve",
+            "--tpu-devices",
+            "8",
+            "--tpu-rebalance-interval",
+            "2.5",
+            "--tpu-rebalance-ratio",
+            "1.75",
+            "--tpu-migrate-batch",
+            "4",
+        ]
+    )
+    assert args.tpu_devices == 8
+    assert args.tpu_rebalance_interval == 2.5
+    assert args.tpu_rebalance_ratio == 1.75
+    assert args.tpu_migrate_batch == 4
+
+
+def test_supervised_factory_builds_cell_plane():
+    from hocuspocus_tpu.tpu.supervisor import SupervisedTpuMergeExtension
+
+    supervised = SupervisedTpuMergeExtension(
+        devices=2, serve=True, num_docs=8, capacity=256,
+        rebalance_interval_s=0,
+    )
+    runtime = supervised.supervisor.factory()
+    try:
+        assert isinstance(runtime, MultiDeviceMergeExtension)
+        assert len(runtime.cells) == 2
+    finally:
+        runtime.cancel_timers()
+    with pytest.raises(ValueError):
+        SupervisedTpuMergeExtension(devices=2, shards=4)
+
+
+def test_multi_device_storm_scenario_compiles_deterministically():
+    from hocuspocus_tpu.loadgen import get_scenario
+    from hocuspocus_tpu.loadgen.scenarios import BENCH_SUITE
+
+    assert "multi_device_storm" in BENCH_SUITE
+    scenario = get_scenario("multi_device_storm")
+    a = scenario.compile(3)
+    b = scenario.compile(3)
+    assert a.schedule_hash == b.schedule_hash
+    assert a.population["devices"] == 4
+    assert scenario.params["multi_device"]["rebalance_interval_s"] > 0
+    phases = [spec["name"] for spec in a.phases]
+    assert phases == ["steady", "storm", "rebalanced"]

@@ -73,17 +73,23 @@ def probe_backend() -> dict:
 
     started = time.perf_counter()
     if os.environ.get("JAX_PLATFORMS") == "cpu":
-        backend = "cpu"
+        # the CPU probe is cheap and still yields the device count
+        # (forced-host meshes report their virtual chip count)
+        backend = bench._probe(None) or "cpu"
+        device_count = bench.probe_device_count(None)
     else:
         backend = bench._probe(None)
+        device_count = bench.probe_device_count(None)
         if backend is None:
             # the retry the JAX init error itself suggests — still just
             # one extra probe, cached for the rest of the process
             backend = bench._probe("")
+            device_count = bench.probe_device_count("")
     return {
         "backend": backend,
         "alive": backend not in (None, "cpu"),
         "probe_s": round(time.perf_counter() - started, 1),
+        "device_count": device_count,
     }
 
 
@@ -141,12 +147,39 @@ def run_scenarios(
             }
             verdict = "fail"
             continue
-        suite["scenarios"][name] = {
+        entry = {
             "verdict": result.get("verdict"),
             "schedule_hash": result.get("schedule_hash"),
             "breached": (result.get("slo") or {}).get("breached_targets", []),
+            # per-phase p99s land here so tools/bench_gate.py's suite
+            # stages (overload_storm/edge_fanout/multi_device_storm
+            # .interactive_p99) gate capture-produced rounds too
+            "phase_p99_ms": {
+                phase["name"]: phase.get("latency_p99_ms")
+                for phase in result.get("phases") or []
+                if isinstance(phase, dict) and "name" in phase
+            },
             "artifact": os.path.relpath(artifact_path, _REPO_DIR),
         }
+        multi = (result.get("extra") or {}).get("multi_device")
+        if multi:
+            # multichip attribution: per-device doc/work spread,
+            # migration accounting and the placement-map hash — two
+            # rounds with equal hashes routed docs identically
+            entry["multi_device"] = {
+                instance: {
+                    "devices": info.get("devices"),
+                    "placement_hash": info.get("placement_hash"),
+                    "docs_per_device": (info.get("utilization") or {}).get(
+                        "docs_per_device"
+                    ),
+                    "docs_migrated": (info.get("migrations") or {}).get(
+                        "docs_migrated"
+                    ),
+                }
+                for instance, info in multi.items()
+            }
+        suite["scenarios"][name] = entry
         _log(f"scenario {name}: {result.get('verdict')}")
         if result.get("verdict") != "pass":
             verdict = "fail"
@@ -254,12 +287,22 @@ def main(argv: "list[str] | None" = None) -> int:
     stale = bool(
         headline is not None and (headline.get("extra") or {}).get("stale_capture")
     )
+    multi_device = {
+        name: entry["multi_device"]
+        for name, entry in suite["scenarios"].items()
+        if isinstance(entry, dict) and entry.get("multi_device")
+    }
     manifest = {
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "git_rev": _git_rev(),
         "backend": (headline or {}).get("extra", {}).get("backend")
         or probe["backend"],
         "probe": probe,
+        # per-device attribution: the probe's visible chip count plus
+        # each multi-device scenario's placement hash + per-device doc
+        # spread — multichip captures are comparable round over round
+        "device_count": probe.get("device_count"),
+        "multi_device": multi_device or None,
         "stale_capture": stale,
         "fresh": bool(headline is not None and not stale),
         "scenario_suite": suite,

@@ -134,6 +134,16 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
             p99 = (storm.get("phase_p99_ms") or {}).get("storm")
             if isinstance(p99, (int, float)) and not isinstance(p99, bool):
                 stages["overload_storm.interactive_p99"] = float(p99)
+        # multi-device interactive latency: the multi_device_storm
+        # scenario's storm-phase p99 is measured while one mega-doc
+        # skews a chip hot and the rebalancer migrates docs off it —
+        # a regression here means hot-doc skew started bleeding into
+        # the small-doc interactive path again
+        storm_md = (suite.get("scenarios") or {}).get("multi_device_storm")
+        if isinstance(storm_md, dict):
+            p99 = (storm_md.get("phase_p99_ms") or {}).get("storm")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["multi_device_storm.interactive_p99"] = float(p99)
         # edge-tier interactive latency: the edge_fanout scenario's
         # fanout-phase p99 is measured writer->edge->cell->edge->reader
         # under a door-admitted join storm — a regression here means
