@@ -839,6 +839,12 @@ def _measure_scenario_suite() -> dict:
                 "ops_measured": result["extra"]["ops_measured"],
                 "ops_failed": result["extra"]["ops_failed"],
             }
+            fleet = result["extra"].get("fleet")
+            if fleet is not None:
+                # fleet plane evidence (edge topologies): digest counts,
+                # stale peers and the cross-tier e2e quantiles the
+                # bench gate's edge_fanout.cross_tier_e2e_p99 stage reads
+                suite["scenarios"][name]["fleet"] = fleet
             if result["verdict"] != "pass":
                 verdict = "fail"
         except Exception as error:
@@ -1111,10 +1117,9 @@ def _measure_wire_load() -> dict:
     hist = harness.metrics[0].update_e2e if harness.metrics else None
 
     def quantile_ms(stage: str, q: float):
-        if hist is None:
-            return None
-        value = hist.quantile(q, stage=stage)
-        return None if value is None else round(value * 1000, 3)
+        if hist is None or not hist.series_count(stage=stage):
+            return None  # distinguish "no data" from the 0.0 sentinel
+        return round(hist.quantile(q, stage=stage) * 1000, 3)
 
     msgs_in = after["messages_in"] - before["messages_in"]
     return {

@@ -421,6 +421,15 @@ def build_parser() -> argparse.ArgumentParser:
         "allowed fraction of inbound messages that may fail (default "
         "0.001 = 99.9%% succeed)",
     )
+    parser.add_argument(
+        "--slo-fleet-e2e-ms",
+        type=float,
+        default=250.0,
+        help="fleet cross-tier latency objective: 99%% of traced "
+        "edge->cell->edge updates must complete within this many ms "
+        "(default 250; fed by the hocuspocus_fleet_e2e_seconds "
+        "histogram — docs/guides/observability.md fleet view)",
+    )
     return parser
 
 
@@ -444,6 +453,7 @@ async def run(args: argparse.Namespace) -> None:
             Metrics(
                 slo_e2e_p99_ms=args.slo_e2e_ms,
                 slo_error_rate=args.slo_error_rate,
+                slo_fleet_e2e_ms=args.slo_fleet_e2e_ms,
             )
         )
     if args.overload == "on":

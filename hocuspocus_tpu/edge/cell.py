@@ -28,9 +28,14 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Optional
 
+import json
+import time
+
 from ..aio import spawn_tracked
 from ..net.resp import PipelinedRedisClient, RedisSubscriber
+from ..observability.fleet import build_digest, get_fleet_view
 from ..observability.flight_recorder import get_flight_recorder
+from ..observability.tracing import get_tracer
 from ..server import logger
 from ..server.hocuspocus import RequestInfo
 from ..server.transports import CallbackWebSocketTransport
@@ -51,7 +56,9 @@ class _CellEdgeSession:
         self.session_id = session_id
         self.edge_id = edge_id
         self._closed = False
-        self._queue: "asyncio.Queue[Optional[bytes]]" = asyncio.Queue()
+        # (payload, fleet trace context or None) — the context must ride
+        # the queue so the pump can scope it to exactly its frame
+        self._queue: "asyncio.Queue[Optional[tuple]]" = asyncio.Queue()
         headers = {"x-hocuspocus-edge": edge_id}
         context: dict = {"edge": edge_id}
         tenant = aux.get("tenant")
@@ -71,17 +78,27 @@ class _CellEdgeSession:
 
     # -- inbound (edge -> cell) --------------------------------------------
 
-    def feed(self, payload: bytes) -> None:
+    def feed(self, payload: bytes, trace_ctx: Optional[dict] = None) -> None:
         if not self._closed:
-            self._queue.put_nowait(payload)
+            self._queue.put_nowait((payload, trace_ctx))
 
     async def _pump(self) -> None:
+        tracer = get_tracer()
         while True:
-            payload = await self._queue.get()
-            if payload is None:
+            item = await self._queue.get()
+            if item is None:
                 return
+            payload, trace_ctx = item
             try:
-                await self.client.handle_message(payload)
+                if trace_ctx is not None:
+                    # cross-tier trace context (edge-sampled): visible
+                    # to UpdateTraceBook.stamp for exactly this dispatch
+                    tracer.fleet_context = trace_ctx
+                try:
+                    await self.client.handle_message(payload)
+                finally:
+                    if trace_ctx is not None:
+                        tracer.fleet_context = None
             except Exception as error:
                 logger.log_error(
                     f"[edge-cell] session {self.session_id} frame failed: {error!r}"
@@ -100,7 +117,8 @@ class _CellEdgeSession:
 
     async def _send_to_edge(self, data: bytes) -> None:
         self.ext.publish_to_edge(
-            self.edge_id, relay.encode_envelope(relay.FRAME, self.session_id, "", data)
+            self.edge_id,
+            relay.encode_envelope(relay.FRAME, self.session_id, "", data),
         )
         self.ext.counters["frames_out"] += 1
 
@@ -164,9 +182,15 @@ class CellIngressExtension(Extension):
             "frames_out": 0,
             "detaches": 0,
             "refused_draining": 0,
+            "trace_returns_sent": 0,
         }
         self._tasks: set = set()
         self._announce_handle: Optional[asyncio.TimerHandle] = None
+        # cross-tier trace-return drain: deposits may land from the
+        # flush executor thread, so the wake-up crosses via
+        # call_soon_threadsafe onto the loop captured at listen time
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._trace_flush_scheduled = False
         if create_client is not None:
             self.pub = create_client()
         else:
@@ -178,24 +202,23 @@ class CellIngressExtension(Extension):
 
     # -- wiring -------------------------------------------------------------
 
-    def publish_to_edge(self, edge_id: str, envelope: bytes) -> None:
-        nowait = getattr(self.pub, "publish_nowait", None)
-        if nowait is not None:
-            nowait(relay.edge_channel(self.prefix, edge_id), envelope)
-        else:
-            spawn_tracked(
-                self._tasks,
-                self.pub.publish(relay.edge_channel(self.prefix, edge_id), envelope),
-            )
-
-    def _announce(self, kind: int) -> None:
-        envelope = relay.encode_envelope(kind, self.cell_id)
-        channel = relay.control_channel(self.prefix)
+    def _publish(self, channel: str, envelope: bytes) -> None:
+        """Publish one envelope, preferring the pipelined enqueue-only
+        path (per-tick coalesced lane) over a spawned await."""
         nowait = getattr(self.pub, "publish_nowait", None)
         if nowait is not None:
             nowait(channel, envelope)
         else:
             spawn_tracked(self._tasks, self.pub.publish(channel, envelope))
+
+    def publish_to_edge(self, edge_id: str, envelope: bytes) -> None:
+        self._publish(relay.edge_channel(self.prefix, edge_id), envelope)
+
+    def _announce(self, kind: int) -> None:
+        self._publish(
+            relay.control_channel(self.prefix),
+            relay.encode_envelope(kind, self.cell_id),
+        )
 
     def _schedule_announce(self) -> None:
         if self.draining:
@@ -210,18 +233,120 @@ class CellIngressExtension(Extension):
         if self.draining:
             return
         self._announce(relay.CELL_UP)
+        self._publish_digest()
         self._schedule_announce()
+
+    def _publish_digest(self) -> None:
+        """Telemetry federation (docs/guides/observability.md fleet
+        view): one compact digest per heartbeat on the control channel,
+        ingested locally too so this cell's own /debug/fleet includes
+        itself. Gated on the fleet view being lit (by Metrics) — like
+        every other collector, zero cost until observability is on."""
+        view = get_fleet_view()
+        if not view.enabled:
+            return
+        try:
+            digest = build_digest(
+                role="cell",
+                node_id=self.cell_id,
+                instance=self.instance,
+                interval_s=self.announce_interval_s,
+                extra={
+                    "cell": {
+                        "cell_id": self.cell_id,
+                        "draining": self.draining,
+                        "edge_sessions": len(self.sessions),
+                    }
+                },
+            )
+        except Exception:
+            return  # a digest must never fail the heartbeat
+        view.ingest(digest)
+        self._publish(
+            relay.control_channel(self.prefix),
+            relay.encode_envelope(
+                relay.DIGEST,
+                self.cell_id,
+                "",
+                json.dumps(digest, separators=(",", ":")).encode(),
+            ),
+        )
 
     # -- hooks ---------------------------------------------------------------
 
     async def on_configure(self, data: Payload) -> None:
         self.instance = data.instance
+        # fleet identity: debug payload headers + cross-tier span lanes
+        get_fleet_view().set_identity("cell", self.cell_id)
+        # pin THIS cell's id onto its planes' trace books: the
+        # process-global identity is last-writer, so in a multi-cell
+        # process the deposit-site fallback would attribute every
+        # trace to whichever role configured last (the edge picks its
+        # clock-offset estimator by this id). Supervised planes whose
+        # runtime attaches later fall back to the process identity.
+        extensions = getattr(data.instance, "_extensions", None) or getattr(
+            data.instance.configuration, "extensions", []
+        )
+        for ext in extensions:
+            planes = []
+            plane = getattr(ext, "plane", None)
+            if plane is not None:
+                planes.append(plane)
+            for shard in getattr(ext, "shards", None) or ():
+                planes.append(shard.plane)
+            for plane in planes:
+                book = getattr(plane, "update_traces", None)
+                if book is not None:
+                    book.node_id = self.cell_id
 
     async def on_listen(self, data: Payload) -> None:
         await self.sub.subscribe(relay.cell_channel(self.prefix, self.cell_id))
+        # the control channel too: peer digests (and peer lifecycle)
+        # feed this cell's own FleetView, so /debug/fleet answers the
+        # same on every role
+        await self.sub.subscribe(relay.control_channel(self.prefix))
+        # cross-tier trace returns: the trace book deposits a return
+        # context when a traced relayed update closes; this cell ships
+        # them back to the stamping edge as TRACE_RET envelopes
+        self._loop = asyncio.get_running_loop()
+        get_fleet_view().trace_returns.add_waker(self._wake_trace_flush)
         self._announce(relay.CELL_UP)
+        self._publish_digest()
         self._schedule_announce()
         get_flight_recorder().record("__edge__", "cell_up", cell=self.cell_id)
+
+    # -- cross-tier trace returns -------------------------------------------
+
+    def _wake_trace_flush(self) -> None:
+        """Outbox deposit seam — may fire on the flush executor thread,
+        so the actual drain hops onto the event loop. The scheduled
+        flag is a benign race: worst case two wakes drain once."""
+        if self._trace_flush_scheduled or self._loop is None:
+            return
+        self._trace_flush_scheduled = True
+        try:
+            self._loop.call_soon_threadsafe(self._flush_trace_returns)
+        except RuntimeError:
+            self._trace_flush_scheduled = False  # loop already closed
+
+    def _flush_trace_returns(self) -> None:
+        self._trace_flush_scheduled = False
+        by_edge: "dict[str, list]" = {}
+        for _doc, contexts in get_fleet_view().trace_returns.take_all().items():
+            for context in contexts:
+                edge_id = str(context.get("e", ""))
+                if edge_id:
+                    by_edge.setdefault(edge_id, []).append(context)
+        for edge_id, contexts in by_edge.items():
+            self.publish_to_edge(
+                edge_id,
+                relay.encode_envelope(
+                    relay.TRACE_RET,
+                    self.cell_id,
+                    relay.encode_trace_aux({"r": contexts}),
+                ),
+            )
+            self.counters["trace_returns_sent"] += len(contexts)
 
     async def on_drain(self, data: Payload) -> None:
         """PR-9 graceful drain announces departure FIRST: edges remap
@@ -242,6 +367,7 @@ class CellIngressExtension(Extension):
         if self._announce_handle is not None:
             self._announce_handle.cancel()
             self._announce_handle = None
+        get_fleet_view().trace_returns.remove_waker(self._wake_trace_flush)
         self._announce(relay.CELL_DOWN)
         for session in list(self.sessions.values()):
             session.close(1001, "cell shutdown")
@@ -271,6 +397,40 @@ class CellIngressExtension(Extension):
             kind, session_id, aux, payload = relay.decode_envelope(data)
         except Exception:
             return  # malformed envelope: nothing safe to act on
+        if kind == relay.PING:
+            # clock-offset probe (cross-tier tracing): echo the edge's
+            # stamp plus our own clock, immediately — any queueing here
+            # inflates the RTT and widens the edge's offset bound
+            try:
+                t_sent = float(json.loads(aux).get("t"))
+            except Exception:
+                return
+            self.publish_to_edge(
+                session_id,  # the pinging edge's id rides the session field
+                relay.encode_envelope(
+                    relay.PONG,
+                    self.cell_id,
+                    json.dumps(
+                        {"t": t_sent, "tc": time.perf_counter()},
+                        separators=(",", ":"),
+                    ),
+                ),
+            )
+            return
+        if kind == relay.DIGEST:
+            # a peer's telemetry digest off the control channel
+            view = get_fleet_view()
+            if view.enabled and session_id != self.cell_id:
+                try:
+                    view.ingest(json.loads(payload))
+                except Exception:
+                    pass
+            return
+        if kind == relay.CELL_DOWN and session_id != self.cell_id:
+            get_fleet_view().mark_down(session_id)
+            return
+        if kind in (relay.CELL_UP, relay.CELL_DRAINING):
+            return  # peer lifecycle: the router (on edges) owns this
         if kind == relay.OPEN:
             if self.draining:
                 # stale route: the edge hasn't seen CELL_DRAINING yet —
@@ -299,7 +459,11 @@ class CellIngressExtension(Extension):
             return  # frames for a session that never opened / already died
         if kind == relay.FRAME:
             self.counters["frames_in"] += 1
-            session.feed(payload)
+            # optional versioned trace-context aux (edge-sampled update):
+            # absent/foreign aux decodes to None and the frame relays
+            # exactly as before — old envelopes keep parsing
+            trace_ctx = relay.decode_trace_aux(aux) if aux else None
+            session.feed(payload, trace_ctx)
         elif kind == relay.DETACH:
             self.counters["detaches"] += 1
             session.detach(aux)

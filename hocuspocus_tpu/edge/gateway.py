@@ -37,6 +37,7 @@ re-offered by the rebind's resync exchange.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 import uuid
 from collections import deque
@@ -44,13 +45,19 @@ from typing import Any, Optional
 
 from ..aio import spawn_tracked
 from ..net.resp import PipelinedRedisClient, RedisSubscriber
+from ..observability.fleet import build_digest, get_fleet_view
 from ..observability.flight_recorder import get_flight_recorder
 from ..observability.metrics import Counter, Gauge
+from ..observability.tracing import get_tracer
 from ..observability.wire import get_wire_telemetry
 from ..protocol.auth import AuthMessageType
 from ..protocol.frames import parse_frame_header
 from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
-from ..protocol.sync import MESSAGE_YJS_SYNC_STEP1
+from ..protocol.sync import (
+    MESSAGE_YJS_SYNC_STEP1,
+    MESSAGE_YJS_SYNC_STEP2,
+    MESSAGE_YJS_UPDATE,
+)
 from ..crdt.encoding import Decoder
 from ..server import logger
 from ..server.overload import RED, get_overload_controller, resolve_tenant
@@ -78,12 +85,12 @@ class RelaySession:
         self.docs: "set[str]" = set()
         self.closed = False
 
-    def send(self, frame: bytes) -> None:
+    def send(self, frame: bytes, aux: str = "") -> None:
         if self.closed:
             return
         self.gateway.publish_to_cell(
             self.cell_id,
-            relay.encode_envelope(relay.FRAME, self.session_id, "", frame),
+            relay.encode_envelope(relay.FRAME, self.session_id, aux, frame),
         )
         self.gateway.counters["frames_to_cell"] += 1
         self.gateway.frames_total.inc(direction="to_cell")
@@ -152,6 +159,10 @@ class EdgeClientSession:
     # -- inbound from the client -------------------------------------------
 
     async def handle_message(self, data: bytes) -> None:
+        # edge ingress stamp (cross-tier tracing): taken at the frame
+        # receive so a sampled update's trace opens where the monolith's
+        # would — one attribute read when tracing is off
+        t_receive = time.perf_counter() if get_tracer().enabled else None
         try:
             document_name, message_type, offset = parse_frame_header(data)
         except Exception as error:
@@ -171,7 +182,9 @@ class EdgeClientSession:
                     return
                 self._schedule_quota_heal(channel)
                 return
-            self._relay_client_frame(channel, data, message_type, offset)
+            self._relay_client_frame(
+                channel, data, message_type, offset, t_receive=t_receive
+            )
             return
         if channel is None:
             channel = self.channels[document_name] = EdgeDocChannel(
@@ -298,29 +311,45 @@ class EdgeClientSession:
         data: bytes,
         message_type: Optional[int] = None,
         offset: int = 0,
+        t_receive: Optional[float] = None,
     ) -> None:
         """Relay one established-channel frame toward the owning cell,
         caching the client's latest SyncStep1 (the handoff resync
         replay) on the way through. Callers that already parsed the
         header pass (message_type, offset) — the per-frame hot path
-        must not pay the parse twice; buffered frames re-parse here."""
+        must not pay the parse twice; buffered frames re-parse here.
+
+        With tracing on, a sampled update/SyncStep2 frame arriving
+        straight off the socket (`t_receive` set — buffered replays
+        have no honest receive stamp and are never traced) is stamped
+        with a cross-tier trace context in the envelope aux: the cell
+        adopts the id, and the broadcast frame coming back closes the
+        edge→cell→edge chain (docs/guides/edge-routing.md)."""
         if message_type is None:
             try:
                 _name, message_type, offset = parse_frame_header(data)
             except Exception:
                 return
+        sync_type = None
         if message_type == MessageType.Sync:
             try:
                 decoder = Decoder(data)
                 decoder.pos = offset
-                if decoder.read_var_uint() == MESSAGE_YJS_SYNC_STEP1:
-                    channel.step1_frame = data
+                sync_type = decoder.read_var_uint()
             except Exception:
-                pass
+                sync_type = None
+            if sync_type == MESSAGE_YJS_SYNC_STEP1:
+                channel.step1_frame = data
         if channel.session is None or channel.session.closed:
             self._buffer_frame(channel, data)
             return
-        channel.session.send(data)
+        aux = ""
+        if t_receive is not None and sync_type in (
+            MESSAGE_YJS_SYNC_STEP2,
+            MESSAGE_YJS_UPDATE,
+        ):
+            aux = self.gateway.stamp_trace(channel.name, t_receive)
+        channel.session.send(data, aux)
 
     def _buffer_frame(self, channel: EdgeDocChannel, data: bytes) -> None:
         """The bounded per-channel relay queue: a parked or
@@ -555,6 +584,7 @@ class EdgeGateway:
         relay_queue_limit: int = DEFAULT_RELAY_QUEUE_LIMIT,
         heartbeat_timeout_s: Optional[float] = None,
         heartbeat_sweep_s: Optional[float] = None,
+        digest_interval_s: float = 2.0,
     ) -> None:
         self.edge_id = edge_id or f"edge-{uuid.uuid4().hex[:8]}"
         self.prefix = prefix
@@ -578,6 +608,11 @@ class EdgeGateway:
             else max(self.router.heartbeat_timeout_s / 2.0, 0.05)
         )
         self._sweep_handle: "Optional[asyncio.TimerHandle]" = None
+        # telemetry federation + clock-offset probes: one digest on the
+        # control channel (and one PING per healthy cell) per interval
+        self.digest_interval_s = digest_interval_s
+        self._digest_handle: "Optional[asyncio.TimerHandle]" = None
+        self._trace_seq = 0
         self.relay_queue_limit = relay_queue_limit
         self.sessions: "dict[str, RelaySession]" = {}
         self.client_sessions: "set[EdgeClientSession]" = set()
@@ -594,6 +629,9 @@ class EdgeGateway:
             "parked_binds": 0,
             "remaps": 0,
             "heartbeat_expiries": 0,
+            "traces_stamped": 0,
+            "traces_closed": 0,
+            "digests_published": 0,
         }
         if create_client is not None:
             self.pub = create_client()
@@ -690,10 +728,13 @@ class EdgeGateway:
         if self._started:
             return
         self._started = True
+        # fleet identity: debug payload headers + cross-tier span lanes
+        get_fleet_view().set_identity("edge", self.edge_id)
         await self.sub.subscribe(relay.edge_channel(self.prefix, self.edge_id))
         await self.sub.subscribe(relay.control_channel(self.prefix))
         get_flight_recorder().record("__edge__", "edge_up", edge=self.edge_id)
         self._schedule_heartbeat_sweep()
+        self._digest_tick()
 
     def _schedule_heartbeat_sweep(self) -> None:
         if self.heartbeat_sweep_s <= 0 or self._sweep_handle is not None:
@@ -737,11 +778,157 @@ class EdgeGateway:
             if self._started:
                 self._schedule_heartbeat_sweep()
 
+    def _digest_tick(self) -> None:
+        """Per-interval federation work: publish this edge's telemetry
+        digest on the control channel (+ ingest locally), and PING every
+        healthy cell so the clock-offset estimates stay fresh for the
+        relay spans. Gated on the fleet view (lit by Metrics) for the
+        digests; pings ride only while tracing is on — both are no-ops
+        on an unobserved edge."""
+        self._digest_handle = None
+        view = get_fleet_view()
+        try:
+            if view.enabled:
+                digest = build_digest(
+                    role="edge",
+                    node_id=self.edge_id,
+                    interval_s=self.digest_interval_s,
+                    extra={
+                        "sessions": len(self.client_sessions),
+                        "placement_epoch": self.router.epoch,
+                        "edge": {
+                            "cells_healthy": len(self.router.healthy_cells()),
+                            "doc_channels": self._count_channels(),
+                            "parked_channels": self._count_parked(),
+                            "relay_queue_depth": self._relay_queue_depth(),
+                            "relay_sessions": len(self.sessions),
+                        },
+                    },
+                )
+                view.ingest(digest)
+                self.publish_control(
+                    relay.encode_envelope(
+                        relay.DIGEST,
+                        self.edge_id,
+                        "",
+                        json.dumps(digest, separators=(",", ":")).encode(),
+                    )
+                )
+                self.counters["digests_published"] += 1
+            if get_tracer().enabled:
+                ping_aux = json.dumps(
+                    {"t": time.perf_counter()}, separators=(",", ":")
+                )
+                for cell_id in self.router.healthy_cells():
+                    self.publish_to_cell(
+                        cell_id,
+                        relay.encode_envelope(relay.PING, self.edge_id, ping_aux),
+                    )
+        finally:
+            if self._started and self.digest_interval_s > 0:
+                try:
+                    loop = asyncio.get_event_loop()
+                except RuntimeError:
+                    return
+                self._digest_handle = loop.call_later(
+                    self.digest_interval_s, self._digest_tick
+                )
+
+    def stamp_trace(self, doc_name: str, t_receive: float) -> str:
+        """Sample one inbound update for cross-tier tracing: returns the
+        encoded trace-context aux (or "" when not sampled). The context
+        carries everything the return path needs — the edge holds no
+        per-trace state, in keeping with its statelessness."""
+        tracer = get_tracer()
+        if not tracer.enabled or not tracer.take_sample():
+            return ""
+        self._trace_seq += 1
+        self.counters["traces_stamped"] += 1
+        return relay.encode_trace_aux(
+            {
+                "id": f"{self.edge_id}:{self._trace_seq}",
+                "e": self.edge_id,
+                "d": doc_name,
+                "t0": t_receive,
+                "t1": time.perf_counter(),
+                "h": 1,
+            }
+        )
+
+    def _finish_cross_tier(
+        self, returns: list, t9a: float, t9b: float
+    ) -> None:
+        """Close cross-tier traces from a cell's TRACE_RET contexts:
+        emit the four edge-side spans and feed the fleet e2e histogram.
+
+        The chain closes on the SAME edge that stamped it, so `t0`/`t1`
+        (echoed back verbatim) and `t9a`/`t9b` share this edge's clock
+        and the end-to-end latency is a single-clock difference —
+        exact. Only the interior boundary needs reconciliation: the two
+        relay spans partition the edge-observed gap
+        `(t9a - t1) - interior`, split at the offset-corrected
+        cell-receive stamp (heartbeat-RTT estimate). Any one-way skew
+        folds into the relay spans — the split clamps to [0, gap], so
+        no span ever goes negative and the spans still sum exactly to
+        the edge-to-edge e2e."""
+        tracer = get_tracer()
+        view = get_fleet_view()
+        for ctx in returns:
+            try:
+                trace_id = ctx["id"]
+                t0 = float(ctx["t0"])
+                t1 = float(ctx["t1"])
+                t_cell_recv = float(ctx["tr"])
+                t_cell_close = float(ctx["ts"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            node = str(ctx.get("n", "cell"))
+            doc = ctx.get("d")
+            hop = int(ctx.get("h", 2))
+            estimator = view.offsets.get(node)
+            offset = 0.0 if estimator is None else estimator.offset_s
+            interior = max(t_cell_close - t_cell_recv, 0.0)
+            gap = max((t9a - t1) - interior, 0.0)
+            relay_out = min(max((t_cell_recv - offset) - t1, 0.0), gap)
+            relay_return = gap - relay_out
+            edge_ingress = max(t1 - t0, 0.0)
+            edge_egress = max(t9b - t9a, 0.0)
+            e2e = edge_ingress + gap + interior + edge_egress
+            e2e_ms = round(e2e * 1000.0, 3)
+            if tracer.enabled:
+                tracer.add_span(
+                    "update.edge_ingress", t0, t1,
+                    trace_id=trace_id, doc=doc, node=self.edge_id, hop=hop,
+                )
+                tracer.add_span(
+                    "update.relay_out", t1, t1 + relay_out,
+                    trace_id=trace_id, doc=doc, node=self.edge_id,
+                    clock_offset_ms=round(offset * 1000.0, 3),
+                )
+                tracer.add_span(
+                    "update.relay_return", t9a - relay_return, t9a,
+                    trace_id=trace_id, doc=doc, node=self.edge_id,
+                )
+                tracer.add_span(
+                    "update.edge_egress", t9a, t9b,
+                    trace_id=trace_id, doc=doc, node=self.edge_id,
+                    e2e_ms=e2e_ms,
+                )
+            view.record_cross_tier("edge_ingress", edge_ingress)
+            view.record_cross_tier("relay_out", relay_out)
+            view.record_cross_tier("relay_return", relay_return)
+            view.record_cross_tier("edge_egress", edge_egress)
+            view.record_cross_tier("total", e2e)
+            self.counters["traces_closed"] += 1
+
     def close(self) -> None:
         self._started = False
         if self._sweep_handle is not None:
             self._sweep_handle.cancel()
             self._sweep_handle = None
+        if self._digest_handle is not None:
+            self._digest_handle.cancel()
+            self._digest_handle = None
         for session in list(self.sessions.values()):
             session.closed = True
         self.sessions.clear()
@@ -759,6 +946,14 @@ class EdgeGateway:
                 self._tasks,
                 self.pub.publish(relay.cell_channel(self.prefix, cell_id), envelope),
             )
+
+    def publish_control(self, envelope: bytes) -> None:
+        channel = relay.control_channel(self.prefix)
+        nowait = getattr(self.pub, "publish_nowait", None)
+        if nowait is not None:
+            nowait(channel, envelope)
+        else:
+            spawn_tracked(self._tasks, self.pub.publish(channel, envelope))
 
     def open_session(self, owner: EdgeClientSession, cell_id: str) -> RelaySession:
         self._session_seq += 1
@@ -807,11 +1002,46 @@ class EdgeGateway:
                 self._handoff_cell(session_id, "drain")
             return
         if kind == relay.CELL_DOWN:
+            get_fleet_view().mark_down(session_id)
             if self.router.mark_dead(session_id):
                 get_flight_recorder().record(
                     "__edge__", "cell_down", cell=session_id, edge=self.edge_id
                 )
                 self._handoff_cell(session_id, "down")
+            return
+        if kind == relay.DIGEST:
+            # a peer's telemetry digest off the control channel (other
+            # edges and every cell publish). Our own publish echoes back
+            # here too — skip it: _digest_tick already ingested locally,
+            # and double-ingest would halve the self-peer's ring window
+            # and inflate the digest counters
+            view = get_fleet_view()
+            if view.enabled and session_id != self.edge_id:
+                try:
+                    view.ingest(json.loads(payload))
+                except Exception:
+                    pass
+            return
+        if kind == relay.PONG:
+            # clock-offset probe reply: session field = the cell's id,
+            # aux echoes our PING stamp plus the cell's own clock
+            try:
+                reply = json.loads(aux)
+                get_fleet_view().offset_for(session_id).observe(
+                    float(reply["t"]), float(reply["tc"]), time.perf_counter()
+                )
+            except Exception:
+                pass
+            return
+        if kind == relay.TRACE_RET:
+            # cross-tier trace returns (session field = the cell's id):
+            # processed at the gateway, independent of any relay session
+            # — a handoff racing the close can't lose the trace
+            t9a = time.perf_counter()
+            trace_ctx = relay.decode_trace_aux(aux)
+            returns = None if trace_ctx is None else trace_ctx.get("r")
+            if returns:
+                self._finish_cross_tier(returns, t9a, time.perf_counter())
             return
         session = self.sessions.get(session_id)
         if session is None:
@@ -863,6 +1093,7 @@ class EdgeGateway:
                     "established": channel.established,
                     "buffered": len(channel.buffer),
                 }
+        view = get_fleet_view()
         return {
             "edge_id": self.edge_id,
             "router": self.router.table(),
@@ -873,6 +1104,16 @@ class EdgeGateway:
             "channels": dict(sorted(bindings.items())),
             "client_sockets": len(self.client_sessions),
             "counters": dict(self.counters),
+            "clock_offsets": {
+                peer: {
+                    "offset_ms": round(est.offset_s * 1000.0, 3),
+                    "rtt_ms": None
+                    if est.rtt_s is None
+                    else round(est.rtt_s * 1000.0, 3),
+                    "samples": est.samples,
+                }
+                for peer, est in sorted(view.offsets.items())
+            },
         }
 
     def health_brief(self) -> dict:

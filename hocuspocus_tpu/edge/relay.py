@@ -52,6 +52,23 @@ CLOSED = 4  # cell closed the session (aux: "code:reason")
 CELL_UP = 10  # liveness announce — doubles as the heartbeat
 CELL_DRAINING = 11  # graceful drain started: remap my docs NOW
 CELL_DOWN = 12  # orderly departure (destroy)
+# telemetry federation (docs/guides/observability.md "fleet view"):
+# every role publishes a compact periodic digest on the control channel
+# (payload: JSON digest bytes; the node id rides the session field) —
+# the FleetView aggregator on any subscribed peer ingests them
+DIGEST = 13
+# clock-offset probes (cross-tier tracing): an edge PINGs a cell's
+# channel (aux: JSON {"t": sender perf_counter}); the cell answers PONG
+# on the edge's channel echoing the sender stamp plus its own clock —
+# the edge folds the RTT-midpoint offset estimate into the relay spans
+PING = 20
+PONG = 21
+# cross-tier trace returns: the cell closes a traced update's lifecycle
+# at the device barrier — AFTER the encode-once broadcast frame already
+# left (fan-out is host-decoupled) — so the return context rides its own
+# envelope back to the stamping edge (aux: {"v":1,"r":[...]}; same
+# pipelined lane, per-tick coalesced). Unknown to old edges: ignored.
+TRACE_RET = 22
 
 KIND_NAMES = {
     OPEN: "open",
@@ -62,6 +79,10 @@ KIND_NAMES = {
     CELL_UP: "cell_up",
     CELL_DRAINING: "cell_draining",
     CELL_DOWN: "cell_down",
+    DIGEST: "digest",
+    PING: "ping",
+    PONG: "pong",
+    TRACE_RET: "trace_return",
 }
 
 DEFAULT_PREFIX = "hocuspocus-edge"
@@ -112,3 +133,43 @@ def decode_open_aux(aux: str) -> dict:
     except Exception:
         data = {}
     return data if isinstance(data, dict) else {}
+
+
+# -- trace-context aux (versioned, optional envelope extension) -----------
+#
+# FRAME envelopes may carry a trace context in the (previously unused)
+# aux field — docs/guides/edge-routing.md. Edge→cell, a sampled inbound
+# update stamps `{"v": 1, "id": <fleet trace id>, "e": <edge id>,
+# "d": <doc>, "t0": <edge ingress stamp>, "t1": <edge publish stamp>,
+# "h": 1}` (stamps are the edge's own perf_counter — opaque to the
+# cell, echoed back verbatim so the edge stays stateless). Cell→edge,
+# a TRACE_RET envelope closing traced updates echoes
+# `{"v": 1, "r": [{...}, ...]}`: each original context plus the cell's
+# receive/close stamps `tr`/`ts` (the cell's OWN clock — the edge
+# reconciles via its heartbeat-RTT offset estimate), node id `n`, and
+# the incremented hop counter `h`. Both directions are OPTIONAL and
+# versioned: an empty/foreign/unversioned aux decodes to None and the
+# frame relays exactly as before, so pre-trace envelopes keep parsing.
+
+TRACE_AUX_VERSION = 1
+
+
+def encode_trace_aux(context: dict) -> str:
+    return json.dumps(
+        {"v": TRACE_AUX_VERSION, **context}, sort_keys=True, separators=(",", ":")
+    )
+
+
+def decode_trace_aux(aux: str) -> Optional[dict]:
+    """The trace context carried by a FRAME aux, or None when absent,
+    malformed, or from an incompatible version (forward-compat: unknown
+    versions are ignored, never an error)."""
+    if not aux:
+        return None
+    try:
+        data = json.loads(aux)
+    except Exception:
+        return None
+    if not isinstance(data, dict) or data.get("v") != TRACE_AUX_VERSION:
+        return None
+    return data

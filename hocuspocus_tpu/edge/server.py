@@ -69,8 +69,12 @@ class EdgeGatewayExtension(Extension):
 
             from aiohttp import web
 
+            from ..observability.fleet import stamp_header
+
             data.response = web.Response(
-                text=json.dumps(self.gateway.status()),
+                # the consistent attributable header every /debug
+                # endpoint carries: {"generated_utc", "role", "node_id"}
+                text=json.dumps(stamp_header(self.gateway.status())),
                 content_type="application/json",
             )
             error = _ServeResponse()

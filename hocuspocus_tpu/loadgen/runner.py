@@ -75,6 +75,7 @@ class ScenarioRunner:
         # process-global controller at teardown
         self._overload_config = params.get("overload")
         self._verify_convergence = bool(params.get("verify_convergence"))
+        self._tracer_state = None  # (enabled, sample) to restore post-run
         self.harness = ServedLoadHarness(
             num_docs=pop["num_docs"],
             instances=pop["instances"],
@@ -487,6 +488,33 @@ class ScenarioRunner:
                 }
                 for gateway in self.harness.edge_gateways
             }
+            # fleet observability evidence (docs/guides/observability.md
+            # fleet view): digest federation counts, cross-tier
+            # edge→cell→edge latency quantiles, stale peers — the
+            # bench gate's edge_fanout.cross_tier_e2e_p99 stage reads
+            # the p99 from here
+            from ..observability.fleet import get_fleet_view
+
+            view = get_fleet_view()
+            fleet_status = view.status()
+            evidence["fleet"] = {
+                "peers": fleet_status["totals"]["peers"],
+                "fresh_peers": fleet_status["totals"]["fresh"],
+                "stale_peers": len(fleet_status["stale_peers"]),
+                "digests_ingested": view.counters["digests_ingested"],
+                "epoch_skew": any(
+                    info["skew"] for info in fleet_status["epoch_skew"].values()
+                ),
+                "cross_tier_e2e_ms": fleet_status["cross_tier_e2e_ms"],
+                "traces_stamped": sum(
+                    gateway.counters.get("traces_stamped", 0)
+                    for gateway in self.harness.edge_gateways
+                ),
+                "traces_closed": sum(
+                    gateway.counters.get("traces_closed", 0)
+                    for gateway in self.harness.edge_gateways
+                ),
+            }
         multi = {}
         for i, ext in enumerate(self.harness.extensions):
             if callable(getattr(ext, "utilization_spread", None)):
@@ -561,6 +589,27 @@ class ScenarioRunner:
             schedule_hash=schedule.schedule_hash,
         )
         verdict = "fail"
+        self._tracer_state = None
+        if harness.edges > 0:
+            # edge topology: light cross-tier tracing so the run lands
+            # fleet evidence (extra.fleet cross_tier_e2e_ms feeds the
+            # bench gate). The fleet view resets to this run — like the
+            # overload controller, it is process-global state a scenario
+            # must not inherit; the tracer is restored at teardown.
+            from ..observability.fleet import get_fleet_view
+            from ..observability.tracing import get_tracer
+
+            view = get_fleet_view()
+            view.reset()
+            view.enable()
+            tracer = get_tracer()
+            self._tracer_state = (tracer.enabled, tracer.sample)
+            tracer.enabled = True
+            # 1-in-4: enough observations for the cross-tier quantiles
+            # at CI scale without perturbing the gated interactive_p99
+            # (every sampled update pays an aux encode + span chain +
+            # one TRACE_RET round trip)
+            tracer.sample = 4
         try:
             self._progress(
                 f"scenario {schedule.scenario}: booting population "
@@ -718,6 +767,12 @@ class ScenarioRunner:
             await self._teardown()
 
     async def _teardown(self) -> None:
+        if self._tracer_state is not None:
+            from ..observability.tracing import get_tracer
+
+            tracer = get_tracer()
+            tracer.enabled, tracer.sample = self._tracer_state
+            self._tracer_state = None
         for providers in self._joined.values():
             for provider in providers:
                 provider.destroy()

@@ -7,6 +7,13 @@ reference ships no tracing and no metrics exporter).
 
 from .device_watch import CompileTracker
 from .extension import Metrics
+from .fleet import (
+    ClockOffsetEstimator,
+    FleetView,
+    build_digest,
+    get_fleet_view,
+    stamp_header,
+)
 from .flight_recorder import FlightRecorder, get_flight_recorder
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .slo import SloEngine, SloTarget, counter_ratio_slo, fraction_slo, latency_slo
@@ -20,8 +27,10 @@ from .tracing import (
 from .wire import WireTelemetry, get_wire_telemetry
 
 __all__ = [
+    "ClockOffsetEstimator",
     "CompileTracker",
     "Counter",
+    "FleetView",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -32,12 +41,15 @@ __all__ = [
     "Tracer",
     "UpdateTraceBook",
     "WireTelemetry",
+    "build_digest",
     "counter_ratio_slo",
     "disable_tracing",
     "enable_tracing",
     "fraction_slo",
+    "get_fleet_view",
     "get_flight_recorder",
     "get_tracer",
     "get_wire_telemetry",
     "latency_slo",
+    "stamp_header",
 ]

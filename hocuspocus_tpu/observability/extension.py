@@ -16,12 +16,14 @@ instance at scrape time.
 from __future__ import annotations
 
 import asyncio
+import os
 import sys
 import time
 from typing import Optional
 
 from ..server.types import Extension, Payload
 from .device_watch import compile_metrics
+from .fleet import build_digest, get_fleet_view, stamp_header
 from .flight_recorder import get_flight_recorder
 from .metrics import MetricsRegistry
 from .slo import SloEngine, counter_ratio_slo, fraction_slo, latency_slo
@@ -41,6 +43,7 @@ class Metrics(Extension):
         debug_endpoints: bool = True,
         slo_e2e_p99_ms: float = 50.0,
         slo_error_rate: float = 0.001,
+        slo_fleet_e2e_ms: float = 250.0,
         slo_sample_interval_s: float = 15.0,
     ) -> None:
         self.registry = registry or MetricsRegistry()
@@ -49,7 +52,7 @@ class Metrics(Extension):
         # /debug/trace (Perfetto JSON), /debug/profile (on-demand jax
         # profiler capture), /debug/docs[/<name>] (flight recorder),
         # /debug/slo (burn-rate rollup), /debug/loadgen (scenario-run
-        # timeline)
+        # timeline), /debug/fleet (federated telemetry rollup)
         self.debug_endpoints = debug_endpoints
         self._instance = None
         self._plane_owner = None  # extension owning plane(s), for /debug/docs
@@ -152,6 +155,27 @@ class Metrics(Extension):
                 ),
             )
         )
+        # fleet view (observability/fleet.py): the federated-telemetry
+        # singleton — adopted like the wire collector, plus the fleet
+        # cross-tier e2e target (--slo-fleet-e2e-ms) fed by the
+        # edge-to-edge histogram. A process that never sees cross-tier
+        # traffic produces no observations, so the target simply never
+        # votes (no traffic != breach).
+        self.fleet = get_fleet_view().enable()
+        for metric in self.fleet.metrics():
+            try:
+                reg.register(metric)
+            except ValueError:
+                pass  # already adopted (shared registry, repeat bind)
+        self.slo.add(
+            latency_slo(
+                "fleet_e2e_latency",
+                self.fleet.e2e_histogram,
+                threshold_s=slo_fleet_e2e_ms / 1000.0,
+                objective=0.99,
+                stage="total",
+            )
+        )
         for metric in self.slo.metrics():
             reg.register(metric)
 
@@ -163,6 +187,9 @@ class Metrics(Extension):
         # light the socket edge: wire-telemetry sites cost one attribute
         # read until this flips
         self.wire.enable()
+        # default fleet identity (role extensions force their own later:
+        # CellIngress at configure, EdgeGateway at listen)
+        self.fleet.set_identity("monolith", f"monolith-{os.getpid()}", force=False)
         self._set_build_info()
         # slow-span promotion feeds the labelled counter even when the
         # span ring has wrapped (tracing.Tracer._promote_slow fires at
@@ -588,14 +615,37 @@ class Metrics(Extension):
         # would leave windows empty on servers nobody is scraping yet
         if self._slo_task is None or self._slo_task.done():
             self._slo_task = asyncio.ensure_future(self._slo_sampler())
+        # seed the fleet view so a fresh monolith answers /debug/fleet
+        # with itself before the first sampler tick
+        self._ingest_local_digest()
 
     async def _slo_sampler(self) -> None:
         try:
             while True:
                 await asyncio.sleep(self.slo.sample_interval_s)
                 self.slo.maybe_sample()
+                self._ingest_local_digest()
         except asyncio.CancelledError:
             pass
+
+    def _ingest_local_digest(self) -> None:
+        """Monolith-role federation: processes with no relay lane still
+        show up in their own /debug/fleet (and any co-resident view).
+        Edge/cell roles publish richer digests themselves — this ingest
+        defers to them."""
+        if self.fleet.role not in (None, "monolith"):
+            return
+        try:
+            self.fleet.ingest(
+                build_digest(
+                    role=self.fleet.role or "monolith",
+                    node_id=self.fleet.node_id or f"monolith-{os.getpid()}",
+                    instance=self._instance,
+                    interval_s=self.slo.sample_interval_s,
+                )
+            )
+        except Exception:
+            pass  # the sampler must never die to a digest
 
     async def connected(self, data: Payload) -> None:
         self.connects.inc()
@@ -683,6 +733,12 @@ class Metrics(Extension):
                     self._cell_owner.refresh_cell_metrics()
                 except Exception:
                     pass  # a mid-teardown cell must not fail the scrape
+            try:
+                # hocuspocus_fleet_* rollup gauges re-label from the
+                # current peer table at scrape time (like the cell gauges)
+                self.fleet.refresh_gauges()
+            except Exception:
+                pass
             body = self.registry.expose()
             if self.expose_tracer:
                 import json
@@ -719,7 +775,9 @@ class Metrics(Extension):
             # "degraded" still answers HTTP 200 — the server SERVES,
             # degraded is a steer signal for body-parsing probes, not a
             # kill signal that would drop every live session
-            self._serve_json(data, self._instance.get_health())
+            # healthz keeps its own payload contract (no debug header):
+            # balancer probes parse it, and extra keys buy them nothing
+            self._serve_json(data, self._instance.get_health(), stamp=False)
         if self.debug_endpoints:
             if path == "/debug/slo":
                 self.slo.maybe_sample()
@@ -731,6 +789,12 @@ class Metrics(Extension):
 
                 status["overload"] = get_overload_controller().status()
                 self._serve_json(data, status)
+            if path == "/debug/fleet":
+                # federated telemetry rollup (docs/guides/observability.md
+                # fleet view): every live role/cell this process knows
+                # about, from digests on the relay control channel plus
+                # its own — the one pane for "is the fleet healthy?"
+                self._serve_json(data, self.fleet.status())
             if path == "/debug/loadgen":
                 # live scenario-run timeline (docs/guides/load-testing.md):
                 # the loadgen runner narrates into a process-global
@@ -757,11 +821,16 @@ class Metrics(Extension):
                 self._serve_json(data, await self._run_profile(request))
         self.http_requests.inc()
 
-    def _serve_json(self, data: Payload, payload: dict) -> None:
+    def _serve_json(self, data: Payload, payload: dict, stamp: bool = True) -> None:
         import json
 
         from aiohttp import web
 
+        if stamp and isinstance(payload, dict):
+            # every /debug payload carries the consistent attributable
+            # header {"generated_utc", "role", "node_id"} — aggregated
+            # or archived captures stay traceable to their source
+            payload = stamp_header(payload)
         data.response = web.Response(
             text=json.dumps(payload), content_type="application/json"
         )

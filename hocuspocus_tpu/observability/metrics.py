@@ -181,13 +181,20 @@ class Histogram:
         series = self._series.get(tuple(sorted(labels.items())))
         return 0 if series is None else series[2]
 
-    def quantile(self, q: float, **labels: str) -> Optional[float]:
+    def quantile(self, q: float, **labels: str) -> float:
         """Estimated q-quantile for one label set (linear interpolation
         within the landing bucket, like PromQL's histogram_quantile).
-        None when the series has no observations."""
+
+        Degenerate label sets return the documented sentinel **0.0**:
+        a missing series, a series with zero observations, or a
+        histogram built with no finite buckets (where every observation
+        lands in +Inf and no bound can localize the quantile). Callers
+        that must distinguish "no data" from "fast" should guard on
+        `series_count(**labels)` first — rollups (e.g. FleetView) skip
+        empty series rather than averaging sentinel zeros in."""
         series = self._series.get(tuple(sorted(labels.items())))
-        if series is None or series[2] == 0:
-            return None
+        if series is None or series[2] == 0 or not self.buckets:
+            return 0.0
         target = q * series[2]
         cumulative = 0
         for i, bound in enumerate(self.buckets):
@@ -198,7 +205,10 @@ class Histogram:
                 in_bucket = series[0][i]
                 frac = (target - prev) / in_bucket if in_bucket else 0.0
                 return lower + (bound - lower) * frac
-        return self.buckets[-1] if self.buckets else None
+        # every counted observation sits past the last finite bound
+        # (the +Inf bucket): report the last bound, the best the
+        # bucket resolution can say
+        return self.buckets[-1]
 
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"

@@ -46,6 +46,16 @@ _ingress_mark: "contextvars.ContextVar[Optional[float]]" = contextvars.ContextVa
     "hocuspocus_tpu_ingress_mark", default=None
 )
 
+# cross-tier trace context (see Tracer.fleet_context): set by the cell's
+# relay ingress pump around each relayed frame dispatch, consumed by
+# UpdateTraceBook.stamp — a sampled update that crossed the edge tier
+# adopts the EDGE's trace id (and skips local sampling: the edge already
+# sampled), so the cell's stage spans join the edge's cross-process
+# chain. Per-task for the same reason as the ingress mark.
+_fleet_ctx: "contextvars.ContextVar[Optional[dict]]" = contextvars.ContextVar(
+    "hocuspocus_tpu_fleet_trace_ctx", default=None
+)
+
 
 class Span:
     """One completed (or in-flight) span."""
@@ -154,6 +164,17 @@ class Tracer:
     @ingress_mark.setter
     def ingress_mark(self, value: Optional[float]) -> None:
         _ingress_mark.set(value)
+
+    @property
+    def fleet_context(self) -> Optional[dict]:
+        """The current dispatch's relay trace context (edge-stamped
+        trace id + stamps + hop counter), or None when the frame did not
+        arrive through the edge tier / was not sampled there."""
+        return _fleet_ctx.get()
+
+    @fleet_context.setter
+    def fleet_context(self, value: Optional[dict]) -> None:
+        _fleet_ctx.set(value)
 
     # -- recording ---------------------------------------------------------
 
@@ -276,7 +297,12 @@ class Tracer:
         ("X") events with microsecond `ts`/`dur`, instantaneous ("i")
         events for zero-duration spans, one `tid` per recording thread,
         and span attributes (incl. the lifecycle trace id) under `args`.
-        """
+
+        Cross-tier spans (attribute `node=<role id>`, stamped by the
+        fleet trace plumbing) are merged under one synthetic pid PER
+        NODE with a matching process_name record, so a single Perfetto
+        view shows the full socket→cell→socket path as separate
+        role/cell lanes."""
         pid = os.getpid()
         origin = self._origin_perf
         events: list[dict] = [
@@ -288,16 +314,35 @@ class Tracer:
                 "args": {"name": "hocuspocus_tpu"},
             }
         ]
+        node_pids: dict[str, int] = {}
         for sp in list(self._spans):
             args = dict(sp.attributes or {})
             if sp.trace_id is not None:
                 args["trace_id"] = sp.trace_id
+            node = args.get("node")
+            if node is None:
+                span_pid = pid
+            else:
+                span_pid = node_pids.get(node)
+                if span_pid is None:
+                    # synthetic pid lane per fleet node, well clear of
+                    # real pid space so lanes never collide
+                    span_pid = node_pids[node] = 1_000_000 + len(node_pids)
+                    events.append(
+                        {
+                            "ph": "M",
+                            "name": "process_name",
+                            "pid": span_pid,
+                            "tid": 0,
+                            "args": {"name": str(node)},
+                        }
+                    )
             ts = (sp.start - origin) * 1e6
             end = sp.end if sp.end is not None else sp.start
             dur = (end - sp.start) * 1e6
             base = {
                 "name": sp.name,
-                "pid": pid,
+                "pid": span_pid,
                 "tid": sp.tid,
                 "ts": round(ts, 3),
                 "args": args,
@@ -358,6 +403,11 @@ class UpdateTraceBook:
         self.histogram = None  # labelled Histogram, bound by Metrics
         self.on_slow_flush: Optional[Callable[[str, float], Any]] = None
         self.slow_flush_ms: Optional[float] = None
+        # fleet node attribution for cross-tier traces: set by the cell
+        # ingress at configure time (the process-global identity is
+        # last-writer, wrong in a multi-cell process); None falls back
+        # to the process identity
+        self.node_id: Optional[str] = None
         self.dropped = 0
         # stamp/finish run on the event loop while take_drained/
         # complete_cycle run on the flush executor thread: the compound
@@ -397,17 +447,32 @@ class UpdateTraceBook:
     def stamp(self, name: str) -> Optional[int]:
         """Stamp one enqueued update with a fresh trace id (respecting
         the tracer's 1-in-N sampling). Returns the id, or None when not
-        sampled / tracing disabled / the pending set is full."""
+        sampled / tracing disabled / the pending set is full.
+
+        A live cross-tier context (`Tracer.fleet_context`, set by the
+        relay ingress pump) means the EDGE already sampled this update:
+        the stamp adopts the edge's trace id instead of allocating one
+        and skips local sampling, so the cell's stage spans extend the
+        edge's chain under one id."""
         tracer = self._resolve_tracer()
         if not tracer.enabled:
             return None
-        if not tracer.take_sample():
+        fleet = tracer.fleet_context
+        if fleet is not None and fleet.get("id") is None:
+            # a versioned-but-id-less aux (foreign producer) carries no
+            # edge sampling decision: fall back to local sampling, or
+            # every such update would be traced regardless of `sample`
+            fleet = None
+        if fleet is None and not tracer.take_sample():
             return None
         with self._lock:
             if self._pending_count >= self.MAX_PENDING:
                 self.dropped += 1
                 return None
-            trace_id = tracer.next_trace_id()
+            if fleet is not None:
+                trace_id = fleet["id"]
+            else:
+                trace_id = tracer.next_trace_id()
             t_enqueue = time.perf_counter()
             # a live ingress mark anchors the trace at the websocket
             # receive instead of the capture seam (never later than the
@@ -417,7 +482,7 @@ class UpdateTraceBook:
             if t_receive is not None and t_receive > t_enqueue:
                 t_receive = None
             self._pending.setdefault(name, []).append(
-                (trace_id, t_enqueue, t_receive)
+                (trace_id, t_enqueue, t_receive, fleet)
             )
             self._pending_count += 1
             self._live[name] = self._live.get(name, 0) + 1
@@ -457,7 +522,7 @@ class UpdateTraceBook:
                 self._pending_count -= len(entries)
                 if out is None:
                     out = []
-                for trace_id, t_enqueue, t_receive in entries:
+                for trace_id, t_enqueue, t_receive, fleet in entries:
                     out.append(
                         {
                             "trace_id": trace_id,
@@ -465,6 +530,7 @@ class UpdateTraceBook:
                             "t_enqueue": t_enqueue,
                             "t_receive": t_receive,
                             "t_drain": t_drain,
+                            "fleet": fleet,
                         }
                     )
         return out
@@ -485,6 +551,14 @@ class UpdateTraceBook:
                 trace_id = trace["trace_id"]
                 name = trace["doc"]
                 t_receive = trace.get("t_receive")
+                # cross-tier traces carry a node attribute so the
+                # Perfetto export groups this cell's stage spans under
+                # its own role/cell lane (pid) in the merged view
+                node = (
+                    (self.node_id or _fleet_node())
+                    if trace.get("fleet") is not None
+                    else None
+                )
                 stages = (
                     ("queue_wait", trace["t_enqueue"], trace["t_drain"]),
                     ("build", trace["t_drain"], t_build),
@@ -499,9 +573,19 @@ class UpdateTraceBook:
                         ("ingress", t_receive, trace["t_enqueue"]),
                     ) + stages
                 for stage, s0, s1 in stages:
-                    tracer.add_span(
-                        f"update.{stage}", s0, s1, trace_id=trace_id, doc=name
-                    )
+                    if node is None:
+                        tracer.add_span(
+                            f"update.{stage}", s0, s1, trace_id=trace_id, doc=name
+                        )
+                    else:
+                        tracer.add_span(
+                            f"update.{stage}",
+                            s0,
+                            s1,
+                            trace_id=trace_id,
+                            doc=name,
+                            node=node,
+                        )
                     if hist is not None:
                         hist.observe(max(s1 - s0, 0.0), stage=stage)
                 trace["t_sync"] = t_sync
@@ -580,6 +664,10 @@ class UpdateTraceBook:
             if t_start is None:
                 t_start = trace["t_enqueue"]
             e2e_ms = (t_now - t_start) * 1000.0
+            fleet = trace.get("fleet")
+            extra_attrs = (
+                {} if fleet is None else {"node": self.node_id or _fleet_node()}
+            )
             tracer.add_span(
                 "update.broadcast",
                 trace["t_sync"],
@@ -587,7 +675,15 @@ class UpdateTraceBook:
                 trace_id=trace["trace_id"],
                 doc=name,
                 e2e_ms=round(e2e_ms, 3),
+                **extra_attrs,
             )
+            if fleet is not None:
+                # cross-tier return context: echo the edge's stamps plus
+                # this process's receive/send boundaries (OUR clock) so
+                # the originating edge can close the chain — deposited
+                # for the relay envelope of this broadcast frame
+                # (observability/fleet.py TraceReturnOutbox)
+                self._deposit_fleet_return(name, fleet, t_start, t_now)
             if hist is not None:
                 hist.observe(max(t_now - trace["t_sync"], 0.0), stage="broadcast")
                 hist.observe(max(t_now - t_start, 0.0), stage="total")
@@ -602,6 +698,30 @@ class UpdateTraceBook:
                     pass
         self._unlive(name, len(entries))
         return len(entries)
+
+    def _deposit_fleet_return(
+        self, name: str, fleet: dict, t_receive: float, t_send: float
+    ) -> None:
+        try:
+            from .fleet import get_fleet_view
+
+            view = get_fleet_view()
+            view.trace_returns.deposit(
+                name,
+                {
+                    "id": fleet.get("id"),
+                    "e": str(fleet.get("e", "")),
+                    "d": name,
+                    "t0": fleet.get("t0"),
+                    "t1": fleet.get("t1"),
+                    "h": int(fleet.get("h", 1)) + 1,
+                    "tr": t_receive,
+                    "ts": t_send,
+                    "n": self.node_id or view.node_id or "cell",
+                },
+            )
+        except Exception:
+            pass  # tracing must never fail a broadcast
 
     def finish_all(self, t_now: Optional[float] = None) -> int:
         total = 0
@@ -623,6 +743,18 @@ class UpdateTraceBook:
                 self._flushed_count -= len(entries)
             self._live.pop(name, None)
             self._early_broadcast.pop(name, None)
+
+
+def _fleet_node() -> str:
+    """This process's fleet node id (span `node` attribute for the
+    merged cross-process Perfetto view). Lazy import: fleet.py imports
+    this module."""
+    try:
+        from .fleet import get_fleet_view
+
+        return get_fleet_view().node_id or "local"
+    except Exception:
+        return "local"
 
 
 # The default tracer every instrumentation site uses. Disabled by default:

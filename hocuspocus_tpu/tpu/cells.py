@@ -794,12 +794,14 @@ class MultiDeviceMergeExtension(Extension):
         out = []
         for index, cell in enumerate(self.cells):
             wait_p99 = None
-            if cell.lane is not None:
-                quantile = cell.lane.wait_seconds.quantile(
-                    0.99, **{"class": "interactive"}
+            if cell.lane is not None and cell.lane.wait_seconds.series_count(
+                **{"class": "interactive"}
+            ):
+                wait_p99 = round(
+                    cell.lane.wait_seconds.quantile(0.99, **{"class": "interactive"})
+                    * 1000.0,
+                    3,
                 )
-                if quantile is not None:
-                    wait_p99 = round(quantile * 1000.0, 3)
             stats = cell.plane.flush_stats
             out.append(
                 {

@@ -1,0 +1,579 @@
+"""Fleet observability plane (ISSUE 15): cross-tier trace propagation
+over the relay lane (edge→cell→edge span chain summing exactly to the
+edge-to-edge e2e, clock-skew folding, old-envelope fallback), telemetry
+federation (digests on the control channel, FleetView rollups,
+stale/down/epoch-skew transitions in the __fleet__ ring), the
+`/debug/fleet` endpoint over real HTTP on a 2-edge × 2-cell topology,
+and the consistent attributable /debug header."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from hocuspocus_tpu.edge import (
+    CellIngressExtension,
+    EdgeGatewayExtension,
+    EdgeServer,
+    relay,
+)
+from hocuspocus_tpu.net.mini_redis import MiniRedis
+from hocuspocus_tpu.net.resp import RedisSubscriber
+from hocuspocus_tpu.observability import (
+    ClockOffsetEstimator,
+    FleetView,
+    Metrics,
+    build_digest,
+    disable_tracing,
+    enable_tracing,
+    get_fleet_view,
+    get_flight_recorder,
+    get_tracer,
+)
+from hocuspocus_tpu.observability.fleet import TraceReturnOutbox
+from hocuspocus_tpu.provider import HocuspocusProvider
+from hocuspocus_tpu.provider.inprocess import InProcessProviderSocket
+from hocuspocus_tpu.server import Configuration, Server
+from hocuspocus_tpu.server.overload import get_overload_controller
+from hocuspocus_tpu.tpu import TpuMergeExtension
+
+from tests.utils import wait_for
+
+# the full cross-tier chain: four edge-side stages + the existing seven
+CELL_STAGES = (
+    "ingress",
+    "queue_wait",
+    "build",
+    "upload",
+    "device",
+    "readback",
+    "broadcast",
+)
+FLEET_SPAN_NAMES = {
+    f"update.{stage}"
+    for stage in ("edge_ingress", "relay_out", "relay_return", "edge_egress")
+    + CELL_STAGES
+}
+
+
+@pytest.fixture(autouse=True)
+def _fleet_isolation():
+    get_fleet_view().reset()
+    controller = get_overload_controller()
+    controller.reset()
+    yield
+    get_fleet_view().reset()
+    controller.reset()
+    disable_tracing()
+    get_tracer().clear()
+
+
+class FleetTopology:
+    """2 cells (full serve planes + Metrics) × N edges over MiniRedis —
+    the acceptance topology with observability lit on every role."""
+
+    def __init__(self) -> None:
+        self.redis = None
+        self.cells = []  # (Server, CellIngressExtension, Metrics)
+        self.edges = []  # (EdgeServer, EdgeGatewayExtension, Metrics)
+        self.sockets = []
+        self.providers = []
+
+    async def start(self, cells=2, edges=2):
+        self.redis = await MiniRedis().start()
+        host, port = "127.0.0.1", self.redis.port
+        for i in range(cells):
+            ingress = CellIngressExtension(
+                cell_id=f"cell-{i}", host=host, port=port, announce_interval_s=0.2
+            )
+            plane = TpuMergeExtension(
+                num_docs=8,
+                capacity=512,
+                flush_interval_ms=1,
+                broadcast_interval_ms=1,
+                serve=True,
+            )
+            metrics = Metrics()
+            server = Server(
+                Configuration(quiet=True, extensions=[metrics, ingress, plane])
+            )
+            await server.listen(port=0)
+            self.cells.append((server, ingress, metrics))
+        for i in range(edges):
+            gateway_ext = EdgeGatewayExtension(
+                edge_id=f"edge-{i}", host=host, port=port, digest_interval_s=0.2
+            )
+            metrics = Metrics()
+            server = EdgeServer(
+                Configuration(quiet=True, extensions=[metrics, gateway_ext])
+            )
+            await server.listen(port=0)
+            self.edges.append((server, gateway_ext, metrics))
+        for _, gateway_ext, _ in self.edges:
+            await wait_for(
+                lambda g=gateway_ext: len(g.gateway.router.healthy_cells())
+                == cells
+            )
+        return self
+
+    def provider(self, edge_index, name):
+        socket = InProcessProviderSocket(self.edges[edge_index][0])
+        self.sockets.append(socket)
+        provider = HocuspocusProvider(name=name, websocket_provider=socket)
+        provider.attach()
+        self.providers.append(provider)
+        return provider
+
+    async def close(self):
+        for provider in self.providers:
+            provider.destroy()
+        for socket in self.sockets:
+            socket.destroy()
+        await asyncio.sleep(0)
+        for server, *_ in self.edges + self.cells:
+            await server.destroy()
+        if self.redis is not None:
+            await self.redis.stop()
+
+
+def _fleet_trace_spans(tracer):
+    """-> {trace_id: [spans]} for cross-tier (edge-stamped) trace ids."""
+    by_id: dict = {}
+    for span in tracer.export():
+        if span["name"].startswith("update."):
+            trace_id = span.get("trace_id")
+            if isinstance(trace_id, str) and ":" in trace_id:
+                by_id.setdefault(trace_id, []).append(span)
+    return by_id
+
+
+async def _complete_fleet_trace(tracer):
+    """Wait for one cross-tier trace with the full 11-span chain."""
+
+    def complete():
+        for trace_id, spans in _fleet_trace_spans(tracer).items():
+            if {span["name"] for span in spans} == FLEET_SPAN_NAMES:
+                return trace_id, spans
+        return None
+
+    result = None
+
+    async def poll():
+        nonlocal result
+        while result is None:
+            result = complete()
+            if result is None:
+                await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(poll(), timeout=30.0)
+    return result
+
+
+# -- unit: clock offsets, outbox, digests, rollups ----------------------------
+
+
+def test_clock_offset_estimator_recovers_injected_skew():
+    """NTP-midpoint math: a peer clock running +5s ahead with symmetric
+    transit is recovered regardless of RTT; low-RTT samples dominate."""
+    est = ClockOffsetEstimator()
+    skew = 5.0
+    t = 100.0
+    for transit in (0.004, 0.002, 0.001, 0.003):
+        t_sent = t
+        t_peer = t_sent + transit + skew  # peer stamps mid-flight
+        t_recv = t_sent + 2 * transit
+        est.observe(t_sent, t_peer, t_recv)
+        t += 1.0
+    assert est.offset_s == pytest.approx(skew, abs=1e-9)
+    assert est.samples == 4
+    # an asymmetric high-RTT outlier moves the estimate only slightly
+    est.observe(200.0, 200.0 + skew + 0.5, 200.0 + 0.6)
+    assert abs(est.offset_s - skew) < 0.1
+
+
+def test_trace_return_outbox_bounded_with_accounting():
+    outbox = TraceReturnOutbox()
+    wakes = []
+    outbox.add_waker(lambda: wakes.append(1))
+    for i in range(outbox.MAX_PENDING + 10):
+        outbox.deposit(f"doc-{i}", {"id": i})
+    assert outbox.pending == outbox.MAX_PENDING
+    assert outbox.dropped == 10
+    assert len(wakes) == outbox.MAX_PENDING + 10
+    assert outbox.take("doc-missing") is None
+    drained = outbox.take_all()
+    assert outbox.pending == 0
+    assert sum(len(v) for v in drained.values()) == outbox.MAX_PENDING
+
+
+def test_digest_roundtrip_between_views():
+    """A digest built on one node ingests into a FRESH FleetView (the
+    cross-process federation path, minus the wire): peer table, role
+    table and rollups all populate; malformed digests are counted."""
+    digest = build_digest(role="cell", node_id="cell-7", interval_s=2.0)
+    encoded = json.dumps(digest)  # exactly what rides the DIGEST envelope
+    view = FleetView()
+    assert view.ingest(json.loads(encoded))
+    assert view.peer_state("cell-7") == "up"
+    status = view.status()
+    assert status["roles"] == {"cell": ["cell-7"]}
+    assert status["peers"]["cell-7"]["rung"] == "green"
+    assert status["totals"]["fresh"] == 1
+    # malformed: wrong version / missing identity — counted, never raised
+    assert not view.ingest({"v": 99, "role": "cell", "node_id": "x"})
+    assert not view.ingest({"v": 1, "role": "cell"})
+    assert not view.ingest("not a digest")
+    assert view.counters["digests_invalid"] == 3
+
+
+def test_fleet_view_stale_down_transitions_hit_fleet_ring():
+    recorder = get_flight_recorder()
+    recorder.forget("__fleet__")
+    view = FleetView()
+    view.ingest(build_digest(role="cell", node_id="cell-0", interval_s=0.1))
+    view.ingest(build_digest(role="edge", node_id="edge-0", interval_s=0.1))
+    events = [e["event"] for e in recorder.events("__fleet__")]
+    assert events.count("peer_up") == 2
+    # age cell-0 past the stale threshold (floor 5s), then past down
+    view._peer_state["cell-0"]["last_seen"] -= 10.0
+    assert view.stale_peers() == ["cell-0"]
+    assert view.peer_state("cell-0") == "stale"
+    view._peer_state["cell-0"]["last_seen"] -= 1000.0
+    view._sweep()
+    assert view.peer_state("cell-0") == "down"
+    # explicit departure (CELL_DOWN) for the edge
+    view.mark_down("edge-0")
+    events = [e["event"] for e in recorder.events("__fleet__")]
+    assert "peer_stale" in events
+    assert events.count("peer_down") == 2
+    # rollups exclude non-fresh peers
+    assert view.fresh_peers() == []
+    assert view.status()["totals"]["fresh"] == 0
+
+
+def test_fleet_view_epoch_skew_flags_edges_not_cells():
+    recorder = get_flight_recorder()
+    recorder.forget("__fleet__")
+    view = FleetView()
+    view.ingest(
+        build_digest(
+            role="edge", node_id="edge-0", extra={"placement_epoch": 4}
+        )
+    )
+    view.ingest(
+        build_digest(
+            role="edge", node_id="edge-1", extra={"placement_epoch": 4}
+        )
+    )
+    assert not view._epoch_skew()["edge"]["skew"]
+    view.ingest(
+        build_digest(
+            role="edge", node_id="edge-1", extra={"placement_epoch": 9}
+        )
+    )
+    skew = view._epoch_skew()
+    assert skew["edge"]["skew"]
+    assert skew["edge"]["epochs"] == {"edge-0": 4, "edge-1": 9}
+    assert "epoch_skew_detected" in [
+        e["event"] for e in recorder.events("__fleet__")
+    ]
+    # cell placement epochs are local bookkeeping: reported, never flagged
+    view.ingest(
+        build_digest(role="cell", node_id="cell-0", extra={"placement_epoch": 1})
+    )
+    view.ingest(
+        build_digest(role="cell", node_id="cell-1", extra={"placement_epoch": 7})
+    )
+    assert not view._epoch_skew()["cell"]["skew"]
+    view.refresh_gauges()
+    assert view.epoch_skew_gauge.value(role="edge") == 1.0
+    assert view.epoch_skew_gauge.value(role="cell") == 0.0
+
+
+def test_fleet_rollups_skip_empty_peers():
+    """A peer that doesn't report a field (an edge has no docs; a
+    booting cell has no sessions) is skipped, not averaged in as zero —
+    and the cross-tier quantiles stay None (never a fabricated 0.0)
+    until a trace actually lands."""
+    view = FleetView()
+    view.ingest(
+        build_digest(
+            role="cell", node_id="cell-0", extra={"sessions": 10, "docs": 100}
+        )
+    )
+    view.ingest(build_digest(role="edge", node_id="edge-0", extra={"sessions": 7}))
+    totals = view.status()["totals"]
+    assert totals["sessions"] == 17
+    assert totals["docs"] == 100  # the edge's missing docs never count as 0
+    assert view.cross_tier_quantiles() is None
+    view.record_cross_tier("total", 0.020)
+    quantiles = view.cross_tier_quantiles()
+    assert quantiles["count"] == 1
+    assert quantiles["p99_ms"] > 0
+
+
+# -- cross-tier trace round trip ----------------------------------------------
+
+
+async def test_cross_tier_trace_round_trip_span_sum_equals_e2e():
+    """THE acceptance invariant: one sampled update relayed
+    edge→cell→edge produces ONE trace whose eleven cross-process stage
+    spans (edge_ingress through edge_egress) sum exactly to the
+    edge-to-edge e2e latency — and the fleet e2e histogram sees it."""
+    tracer = enable_tracing(max_spans=4096)
+    tracer.clear()
+    topo = await FleetTopology().start(cells=2, edges=2)
+    try:
+        writer = topo.provider(0, "traced-doc")
+        reader = topo.provider(1, "traced-doc")
+        await wait_for(lambda: writer.synced and reader.synced)
+        writer.document.get_text("t").insert(0, "cross-tier hello")
+        trace_id, spans = await _complete_fleet_trace(tracer)
+
+        assert trace_id.startswith("edge-0:")
+        egress = next(s for s in spans if s["name"] == "update.edge_egress")
+        e2e_ms = egress["attributes"]["e2e_ms"]
+        span_sum = sum(s["duration_ms"] for s in spans)
+        assert span_sum == pytest.approx(e2e_ms, abs=0.01)
+        assert all(s["duration_ms"] >= 0 for s in spans), spans
+        # every span in the chain carries the node attribute that pins
+        # it to a Perfetto role lane
+        assert all(s["attributes"].get("node") for s in spans)
+        ingress = next(s for s in spans if s["name"] == "update.edge_ingress")
+        assert ingress["attributes"]["node"] == "edge-0"
+        assert ingress["attributes"]["hop"] == 2  # edge→cell→edge
+        # the fleet histogram's total series drives --slo-fleet-e2e-ms
+        view = get_fleet_view()
+        assert view.e2e_histogram.series_count(stage="total") >= 1
+        quantiles = view.cross_tier_quantiles()
+        assert quantiles is not None and quantiles["count"] >= 1
+        # stamping edge accounting
+        gateway = topo.edges[0][1].gateway
+        assert gateway.counters["traces_stamped"] >= 1
+        assert gateway.counters["traces_closed"] >= 1
+    finally:
+        await topo.close()
+
+
+async def test_cross_tier_trace_clock_skew_folds_into_relay_spans():
+    """Injected clock skew (a deliberately wrong offset estimate, plus
+    real relay latency injected in mini_redis delivery): no span goes
+    negative, and the chain still sums exactly to the reported e2e —
+    the skew folds into the relay spans."""
+    tracer = enable_tracing(max_spans=4096)
+    tracer.clear()
+    topo = await FleetTopology().start(cells=2, edges=2)
+    try:
+        # real transit on every relay hop
+        topo.redis.publish_latency_ms = 10
+        # a wildly wrong offset estimate toward every cell: +250ms skew
+        view = get_fleet_view()
+        for cell_id in ("cell-0", "cell-1"):
+            estimator = view.offset_for(cell_id)
+            estimator.offset_s = 0.25
+            estimator.samples = max(estimator.samples, 1)
+        writer = topo.provider(0, "skewed-doc")
+        reader = topo.provider(1, "skewed-doc")
+        await wait_for(lambda: writer.synced and reader.synced)
+        writer.document.get_text("t").insert(0, "skewed edit")
+        _trace_id, spans = await _complete_fleet_trace(tracer)
+
+        egress = next(s for s in spans if s["name"] == "update.edge_egress")
+        span_sum = sum(s["duration_ms"] for s in spans)
+        assert span_sum == pytest.approx(egress["attributes"]["e2e_ms"], abs=0.01)
+        assert all(s["duration_ms"] >= 0 for s in spans), [
+            (s["name"], s["duration_ms"]) for s in spans
+        ]
+        # the injected relay latency is visible: the two relay spans
+        # together carry at least one leg's worth of transit
+        relay_ms = sum(
+            s["duration_ms"]
+            for s in spans
+            if s["name"] in ("update.relay_out", "update.relay_return")
+        )
+        assert relay_ms >= 5.0
+    finally:
+        topo.redis.publish_latency_ms = 0
+        await topo.close()
+
+
+async def test_no_trace_context_fallback_old_envelopes_still_parse():
+    """Tracing off = no aux stamped (old-edge behavior), and hand-built
+    pre-trace envelopes (empty aux) flow through the new cell unchanged;
+    foreign aux decodes to None rather than erroring."""
+    assert relay.decode_trace_aux("") is None
+    assert relay.decode_trace_aux("not json") is None
+    assert relay.decode_trace_aux('{"v": 999, "id": "x"}') is None
+    assert relay.decode_trace_aux('["list"]') is None
+    context = {"id": "edge-0:1", "e": "edge-0", "t0": 1.0, "t1": 2.0, "h": 1}
+    assert relay.decode_trace_aux(relay.encode_trace_aux(context))["id"] == (
+        "edge-0:1"
+    )
+
+    topo = await FleetTopology().start(cells=1, edges=1)
+    try:
+        # tracing DISABLED: the edge stamps nothing — byte-for-byte the
+        # pre-trace envelope shape — and sync still converges
+        writer = topo.provider(0, "legacy-doc")
+        await wait_for(lambda: writer.synced)
+        writer.document.get_text("t").insert(0, "legacy edit")
+        gateway = topo.edges[0][1].gateway
+        await wait_for(
+            lambda: topo.cells[0][1].counters["frames_in"] > 0
+        )
+        assert gateway.counters["traces_stamped"] == 0
+        server = topo.cells[0][0]
+        await wait_for(lambda: "legacy-doc" in server.hocuspocus.documents)
+        from hocuspocus_tpu.crdt import encode_state_as_update
+
+        document = server.hocuspocus.documents["legacy-doc"]
+        await wait_for(
+            lambda: encode_state_as_update(document)
+            == encode_state_as_update(writer.document)
+        )
+    finally:
+        await topo.close()
+
+
+# -- federation over real HTTP (the acceptance endpoint) ----------------------
+
+
+async def test_debug_fleet_reports_whole_topology_over_http():
+    """Acceptance: GET /debug/fleet on ANY Metrics-enabled process of a
+    2-edge × 2-cell topology reports every live role/cell with health
+    rung, burn rates and placement epoch — plus the attributable
+    header; digests really ride the control channel (verified by a raw
+    subscriber feeding a fresh FleetView); hocuspocus_fleet_* gauges
+    render on /metrics."""
+    topo = await FleetTopology().start(cells=2, edges=2)
+    raw_digests = []
+
+    def collect(channel, data):
+        try:
+            kind, node_id, _aux, payload = relay.decode_envelope(data)
+        except Exception:
+            return
+        if kind == relay.DIGEST:
+            raw_digests.append((node_id, payload))
+
+    spy = RedisSubscriber(
+        "127.0.0.1", topo.redis.port, on_message=collect
+    )
+    try:
+        await spy.subscribe(relay.control_channel(relay.DEFAULT_PREFIX))
+        # every role publishes within one heartbeat/digest interval
+        await wait_for(
+            lambda: {node for node, _ in raw_digests}
+            >= {"cell-0", "cell-1", "edge-0", "edge-1"},
+            timeout=10.0,
+        )
+        # the bus carries real, parseable digests a cold process could use
+        fresh_view = FleetView()
+        for _node, payload in raw_digests[:8]:
+            assert fresh_view.ingest(json.loads(payload))
+        assert len(fresh_view.peers) >= 1
+
+        async with aiohttp.ClientSession() as session:
+            # any edge AND any cell answer with the whole topology
+            for server in (topo.edges[0][0], topo.cells[1][0]):
+                async with session.get(
+                    f"{server.http_url}/debug/fleet"
+                ) as response:
+                    assert response.status == 200
+                    payload = json.loads(await response.text())
+                assert {"generated_utc", "role", "node_id"} <= set(payload)
+                peers = payload["peers"]
+                assert {"cell-0", "cell-1", "edge-0", "edge-1"} <= set(peers)
+                assert payload["roles"]["cell"] == ["cell-0", "cell-1"]
+                assert payload["roles"]["edge"] == ["edge-0", "edge-1"]
+                for node_id in ("cell-0", "cell-1", "edge-0", "edge-1"):
+                    assert peers[node_id]["state"] == "up"
+                    assert peers[node_id]["rung"] == "green"
+                # burn rates ride every digest (engines sample at build)
+                for node_id in ("cell-0", "cell-1"):
+                    assert "slo_burn" in peers[node_id], peers[node_id]
+                    assert peers[node_id]["cell"]["edge_sessions"] >= 0
+                # placement epoch: edges report router epochs (equal —
+                # same control stream — so no skew flagged)
+                assert peers["edge-0"]["placement_epoch"] == (
+                    peers["edge-1"]["placement_epoch"]
+                )
+                assert not payload["epoch_skew"]["edge"]["skew"]
+                assert payload["stale_peers"] == []
+                assert payload["totals"]["fresh"] == 4
+
+            # hocuspocus_fleet_* rollups on /metrics
+            async with session.get(
+                f"{topo.edges[0][0].http_url}/metrics"
+            ) as response:
+                body = await response.text()
+            assert 'hocuspocus_fleet_peers{role="cell"} 2' in body
+            assert 'hocuspocus_fleet_peers{role="edge"} 2' in body
+            assert "hocuspocus_fleet_stale_peers 0" in body
+            assert "hocuspocus_fleet_e2e_seconds_count" in body
+            assert 'hocuspocus_fleet_digests_ingested_total{role="cell"}' in body
+
+            # the fleet SLO target is folded into /debug/slo
+            async with session.get(
+                f"{topo.edges[0][0].http_url}/debug/slo"
+            ) as response:
+                slo = json.loads(await response.text())
+            assert "fleet_e2e_latency" in slo["slos"]
+            assert {"generated_utc", "role", "node_id"} <= set(slo)
+    finally:
+        spy.close()
+        await topo.close()
+
+
+async def test_debug_endpoints_stamp_attributable_header():
+    """Every /debug payload carries {"generated_utc", "role",
+    "node_id"}; /debug/edge stamps it too; /healthz keeps its own
+    contract (no header)."""
+    topo = await FleetTopology().start(cells=1, edges=1)
+    try:
+        edge_url = topo.edges[0][0].http_url
+        cell_url = topo.cells[0][0].http_url
+        async with aiohttp.ClientSession() as session:
+            for url in (
+                f"{edge_url}/debug/fleet",
+                f"{edge_url}/debug/edge",
+                f"{cell_url}/debug/slo",
+                f"{cell_url}/debug/trace",
+                f"{cell_url}/debug/scheduler",
+                f"{cell_url}/debug/docs",
+            ):
+                async with session.get(url) as response:
+                    assert response.status == 200, url
+                    payload = json.loads(await response.text())
+                assert {"generated_utc", "role", "node_id"} <= set(payload), url
+                assert payload["generated_utc"].endswith("Z")
+            async with session.get(f"{edge_url}/debug/edge") as response:
+                edge_payload = json.loads(await response.text())
+            assert edge_payload["role"] in ("edge", "cell")  # in-process shared
+            async with session.get(f"{cell_url}/healthz") as response:
+                health = json.loads(await response.text())
+            assert "generated_utc" not in health
+    finally:
+        await topo.close()
+
+
+async def test_monolith_fleet_view_shows_itself():
+    """A plain monolith (no relay lane) still answers /debug/fleet with
+    its own digest — the single pane degrades gracefully to one pane."""
+    from tests.utils import new_hocuspocus
+
+    server = await new_hocuspocus(extensions=[Metrics()])
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"{server.http_url}/debug/fleet"
+            ) as response:
+                payload = json.loads(await response.text())
+        assert payload["roles"].get("monolith"), payload
+        node_id = payload["roles"]["monolith"][0]
+        assert payload["peers"][node_id]["state"] == "up"
+    finally:
+        await server.destroy()

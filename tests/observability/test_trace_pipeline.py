@@ -254,7 +254,35 @@ def test_histogram_quantile_interpolation():
         hist.observe(0.15, stage="s")
     q50 = hist.quantile(0.5, stage="s")
     assert 0.1 <= q50 <= 0.2
-    assert hist.quantile(0.5, stage="missing") is None
+    # missing/empty series: the documented 0.0 sentinel, never an
+    # exception — callers that must distinguish "no data" guard on
+    # series_count first (FleetView rollups skip empty peers entirely)
+    assert hist.quantile(0.5, stage="missing") == 0.0
+    assert hist.series_count(stage="missing") == 0
+
+
+def test_histogram_quantile_degenerate_labelsets_return_sentinel():
+    """The satellite guard: empty or single/zero-bucket label sets must
+    return the documented 0.0 sentinel (or the last finite bound when
+    every observation overflows it) instead of degenerate bisect
+    behavior."""
+    # no finite buckets at all: every observation lands in +Inf and no
+    # bound can localize a quantile — sentinel, not None/IndexError
+    unbucketed = Histogram("raw_seconds", "", buckets=())
+    unbucketed.observe(0.5, stage="s")
+    assert unbucketed.quantile(0.99, stage="s") == 0.0
+    assert unbucketed.quantile(0.5) == 0.0  # missing unlabelled series
+    # single bucket: in-range mass interpolates within [0, bound]...
+    single = Histogram("one_seconds", "", buckets=(0.1,))
+    for _ in range(10):
+        single.observe(0.05, stage="s")
+    assert 0.0 <= single.quantile(0.5, stage="s") <= 0.1
+    # ...and overflow mass reports the last finite bound (the best the
+    # bucket resolution can say), never an index past the bucket list
+    overflow = Histogram("over_seconds", "", buckets=(0.1,))
+    for _ in range(10):
+        overflow.observe(5.0, stage="s")
+    assert overflow.quantile(0.99, stage="s") == 0.1
 
 
 # -- _fmt_value ----------------------------------------------------------------

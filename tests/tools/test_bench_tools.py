@@ -285,3 +285,48 @@ def test_gate_extracts_edge_fanout_interactive_p99():
         payload, current, tolerance=0.25, floor_ms=0.25
     )
     assert any("edge_fanout.interactive_p99" in r for r in regressions)
+
+
+def test_gate_extracts_edge_fanout_cross_tier_e2e_p99():
+    """The fleet plane's edge→cell→edge trace p99 (extra.fleet) is a
+    gated stage — the relay hop growing a tail the interactive p99
+    misses must still fail the round. Absent fleet evidence (older
+    rounds) stays informational, never an error."""
+    payload = _artifact()
+    payload["extra"]["scenario_suite"] = {
+        "verdict": "pass",
+        "scenarios": {
+            "edge_fanout": {
+                "verdict": "pass",
+                "breached": [],
+                "phase_p99_ms": {"fanout": 8.0},
+                "fleet": {
+                    "peers": 4,
+                    "stale_peers": 0,
+                    "digests_ingested": 40,
+                    "cross_tier_e2e_ms": {
+                        "p50_ms": 10.0,
+                        "p99_ms": 40.0,
+                        "count": 64,
+                    },
+                },
+            }
+        },
+    }
+    stages = bench_gate.stage_p99s(payload)
+    assert stages["edge_fanout.cross_tier_e2e_p99"] == 40.0
+    current = json.loads(json.dumps(payload))
+    current["extra"]["scenario_suite"]["scenarios"]["edge_fanout"]["fleet"][
+        "cross_tier_e2e_ms"
+    ]["p99_ms"] = 400.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert any("edge_fanout.cross_tier_e2e_p99" in r for r in regressions)
+    # old rounds without fleet evidence: the stage is simply absent
+    old = _artifact()
+    old["extra"]["scenario_suite"] = {
+        "verdict": "pass",
+        "scenarios": {"edge_fanout": {"verdict": "pass", "phase_p99_ms": {}}},
+    }
+    assert "edge_fanout.cross_tier_e2e_p99" not in bench_gate.stage_p99s(old)

@@ -218,7 +218,9 @@ async def test_debug_loadgen_endpoint_serves_timeline():
             async with session.get(f"{server.http_url}/debug/loadgen") as response:
                 assert response.status == 200
                 payload = json.loads(await response.text())
-        assert set(payload) == {"active", "run", "last_run", "events"}
+        # timeline fields plus the consistent attributable /debug header
+        assert {"active", "run", "last_run", "events"} <= set(payload)
+        assert {"generated_utc", "role", "node_id"} <= set(payload)
         assert payload["active"] is False
     finally:
         await server.destroy()

@@ -161,6 +161,18 @@ def run_scenarios(
             },
             "artifact": os.path.relpath(artifact_path, _REPO_DIR),
         }
+        fleet = (result.get("extra") or {}).get("fleet")
+        if fleet:
+            # fleet federation evidence: the digest peer count proves
+            # every role published into the control channel during the
+            # run, and the cross-tier p99 feeds the
+            # edge_fanout.cross_tier_e2e_p99 gate stage
+            entry["fleet"] = {
+                "peers": fleet.get("peers"),
+                "digests_ingested": fleet.get("digests_ingested"),
+                "stale_peers": fleet.get("stale_peers"),
+                "cross_tier_e2e_ms": fleet.get("cross_tier_e2e_ms"),
+            }
         multi = (result.get("extra") or {}).get("multi_device")
         if multi:
             # multichip attribution: per-device doc/work spread,
@@ -292,6 +304,14 @@ def main(argv: "list[str] | None" = None) -> int:
         for name, entry in suite["scenarios"].items()
         if isinstance(entry, dict) and entry.get("multi_device")
     }
+    # fleet federation: the digest peer count per edge scenario — a
+    # capture whose peer count dropped below the topology size means a
+    # role went dark during the round (silent topology drift)
+    fleet_peers = {
+        name: (entry.get("fleet") or {}).get("peers")
+        for name, entry in suite["scenarios"].items()
+        if isinstance(entry, dict) and entry.get("fleet")
+    }
     manifest = {
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "git_rev": _git_rev(),
@@ -303,6 +323,7 @@ def main(argv: "list[str] | None" = None) -> int:
         # spread — multichip captures are comparable round over round
         "device_count": probe.get("device_count"),
         "multi_device": multi_device or None,
+        "fleet_digest_peers": fleet_peers or None,
         "stale_capture": stale,
         "fresh": bool(headline is not None and not stale),
         "scenario_suite": suite,

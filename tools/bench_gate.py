@@ -153,6 +153,17 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
             p99 = (edge.get("phase_p99_ms") or {}).get("fanout")
             if isinstance(p99, (int, float)) and not isinstance(p99, bool):
                 stages["edge_fanout.interactive_p99"] = float(p99)
+            # cross-tier trace latency: the fleet plane's edge→cell→edge
+            # e2e p99 (extra.fleet, fed by relay trace propagation) — a
+            # regression here means the relay hop or the device close
+            # path grew a tail the interactive p99 alone can miss
+            fleet = edge.get("fleet")
+            if isinstance(fleet, dict):
+                cross = fleet.get("cross_tier_e2e_ms")
+                if isinstance(cross, dict):
+                    p99 = cross.get("p99_ms")
+                    if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                        stages["edge_fanout.cross_tier_e2e_p99"] = float(p99)
     wal = extra.get("wal_load")
     if isinstance(wal, dict):
         append_p99 = wal.get("append_p99_ms")
