@@ -42,6 +42,7 @@ from ..server.transports import CallbackWebSocketTransport
 from ..server.types import Extension, Payload
 from . import relay
 from .relay import DEFAULT_PREFIX
+from .replica import ReplicaManager
 
 
 class _CellEdgeSession:
@@ -185,6 +186,9 @@ class CellIngressExtension(Extension):
             "trace_returns_sent": 0,
         }
         self._tasks: set = set()
+        # hot-doc replication roles (edge/replica.py): which docs this
+        # cell owns (streams ticks for) vs follows (applies ticks for)
+        self.replicas = ReplicaManager(self)
         self._announce_handle: Optional[asyncio.TimerHandle] = None
         # cross-tier trace-return drain: deposits may land from the
         # flush executor thread, so the wake-up crosses via
@@ -213,6 +217,10 @@ class CellIngressExtension(Extension):
 
     def publish_to_edge(self, edge_id: str, envelope: bytes) -> None:
         self._publish(relay.edge_channel(self.prefix, edge_id), envelope)
+
+    def publish_to_cell(self, cell_id: str, envelope: bytes) -> None:
+        """Cell → cell (the replica lane: FOLLOW/REPLICA_TICK/…)."""
+        self._publish(relay.cell_channel(self.prefix, cell_id), envelope)
 
     def _announce(self, kind: int) -> None:
         self._publish(
@@ -256,7 +264,12 @@ class CellIngressExtension(Extension):
                         "cell_id": self.cell_id,
                         "draining": self.draining,
                         "edge_sessions": len(self.sessions),
-                    }
+                    },
+                    # replication topology: per-doc follower sets +
+                    # tick seqs — edges harvest the seqs to pick the
+                    # FRESHEST follower at promotion time, /debug/fleet
+                    # renders the followers column off the same key
+                    "replica": self.replicas.stats(),
                 },
             )
         except Exception:
@@ -278,6 +291,20 @@ class CellIngressExtension(Extension):
         self.instance = data.instance
         # fleet identity: debug payload headers + cross-tier span lanes
         get_fleet_view().set_identity("cell", self.cell_id)
+        # hocuspocus_replica_* metrics: adopted by a co-installed
+        # Metrics extension's registry (same pattern as the edge's
+        # hocuspocus_edge_* family)
+        for extension in getattr(data.instance, "_extensions", []):
+            registry = getattr(extension, "registry", None)
+            if registry is not None and callable(
+                getattr(registry, "register", None)
+            ):
+                for metric in self.replicas.metrics():
+                    try:
+                        registry.register(metric)
+                    except ValueError:
+                        pass  # already adopted (shared registry)
+                break
         # pin THIS cell's id onto its planes' trace books: the
         # process-global identity is last-writer, so in a multi-cell
         # process the deposit-site fallback would attribute every
@@ -363,7 +390,20 @@ class CellIngressExtension(Extension):
         # the loop (publish_nowait ships on the next tick)
         await asyncio.sleep(0)
 
+    async def after_load_document(self, data: Payload) -> None:
+        # a doc this cell owns/follows was (re)loaded: the fresh fanout
+        # has no replica seam yet — re-attach before its first tick
+        self.replicas.on_document_loaded(data.document_name, data.document)
+
+    async def on_plane_broadcast(self, data: Payload) -> None:
+        """Plane-served docs bypass the fanout tick; the merged window
+        (remote/replica-origin ops already stripped) feeds the replica
+        lane here — owner ticks it to followers, a follower pushes it
+        up to its owner."""
+        self.replicas.on_plane_broadcast(data.document_name, data.update)
+
     async def on_destroy(self, data: Payload) -> None:
+        self.replicas.close()
         if self._announce_handle is not None:
             self._announce_handle.cancel()
             self._announce_handle = None
@@ -388,6 +428,8 @@ class CellIngressExtension(Extension):
             "degraded": False,
             "cell_id": self.cell_id,
             "edge_sessions": len(self.sessions),
+            "replica_owned": len(self.replicas.owned),
+            "replica_following": len(self.replicas.following),
         }
 
     # -- relay dispatch ------------------------------------------------------
@@ -428,9 +470,24 @@ class CellIngressExtension(Extension):
             return
         if kind == relay.CELL_DOWN and session_id != self.cell_id:
             get_fleet_view().mark_down(session_id)
+            self.replicas.on_peer_down(session_id)
             return
         if kind in (relay.CELL_UP, relay.CELL_DRAINING):
+            if kind == relay.CELL_DRAINING and session_id != self.cell_id:
+                # a draining peer stops serving its follower role
+                self.replicas.on_peer_down(session_id)
             return  # peer lifecycle: the router (on edges) owns this
+        if kind in (
+            relay.FOLLOW,
+            relay.UNFOLLOW,
+            relay.REPLICA_TICK,
+            relay.REPLICA_PUSH,
+        ):
+            # hot-doc replication lane (edge/replica.py): the sender's
+            # id — peer cell, or the edge for FOLLOW hints — rides the
+            # session field
+            self.replicas.dispatch(kind, session_id, aux, payload)
+            return
         if kind == relay.OPEN:
             if self.draining:
                 # stale route: the edge hasn't seen CELL_DRAINING yet —

@@ -37,6 +37,7 @@ re-offered by the rebind's resync exchange.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import time
 import uuid
@@ -69,6 +70,14 @@ from .router import CellRouter
 # frames a parked/re-establishing doc channel may buffer before the
 # oldest is shed (accounted; healed by the rebind resync)
 DEFAULT_RELAY_QUEUE_LIMIT = 1024
+
+# audience watermark for hot-doc replication
+# (docs/guides/hot-doc-replication.md): a doc whose local established
+# channels reach the watermark grows one follower cell per further
+# watermark's worth of audience (capped at healthy-1) and this edge
+# spreads its channels across owner + followers. Below the watermark
+# routing is byte-identical to the single-owner path.
+DEFAULT_REPLICA_WATERMARK = 256
 
 
 class RelaySession:
@@ -301,6 +310,9 @@ class EdgeClientSession:
             channel.established = True
             channel.auth_frame = data
             self.gateway.counters["channels_opened"] += 1
+            # audience first: this channel's own bind should already see
+            # the watermark it just crossed
+            self.gateway.note_channel_opened(channel.name)
             self._bind_channel(channel)
         finally:
             self._auth_pending.discard(document_name)
@@ -380,7 +392,10 @@ class EdgeClientSession:
         replay the resync SyncStep1 on handoff, flush the buffer.
         Returns False when no healthy cell exists (channel parks)."""
         handoff = reason is not None
-        cell_id = self.gateway.router.route(channel.name)
+        # audience-aware: below the replica watermark this IS
+        # router.route(); above it the channel spreads across the doc's
+        # owner + follower cells (docs/guides/hot-doc-replication.md)
+        cell_id = self.gateway.route_channel(channel.name, self.socket_id)
         if cell_id is None:
             self.gateway.counters["parked_binds"] += 1
             return False
@@ -539,6 +554,8 @@ class EdgeClientSession:
         if channel.heal_handle is not None:
             channel.heal_handle.cancel()
             channel.heal_handle = None
+        if channel.established and self.channels.get(channel.name) is channel:
+            self.gateway.note_channel_closed(channel.name)
         self.channels.pop(channel.name, None)
         self.hook_payloads.pop(channel.name, None)
         session = channel.session
@@ -559,6 +576,8 @@ class EdgeClientSession:
             if channel.heal_handle is not None:
                 channel.heal_handle.cancel()
                 channel.heal_handle = None
+            if channel.established:
+                self.gateway.note_channel_closed(channel.name)
             channel.buffer.clear()
         for session in list(self.cell_sessions.values()):
             self.gateway.close_session(session)
@@ -585,6 +604,7 @@ class EdgeGateway:
         heartbeat_timeout_s: Optional[float] = None,
         heartbeat_sweep_s: Optional[float] = None,
         digest_interval_s: float = 2.0,
+        replica_watermark: int = DEFAULT_REPLICA_WATERMARK,
     ) -> None:
         self.edge_id = edge_id or f"edge-{uuid.uuid4().hex[:8]}"
         self.prefix = prefix
@@ -632,7 +652,21 @@ class EdgeGateway:
             "traces_stamped": 0,
             "traces_closed": 0,
             "digests_published": 0,
+            "follow_hints": 0,
+            "promotions": 0,
         }
+        # -- hot-doc replication (docs/guides/hot-doc-replication.md) ---
+        # audience watermark (0 disables): per-doc ESTABLISHED channel
+        # counts on this edge drive the follower count
+        self.replica_watermark = replica_watermark
+        self._doc_audience: "dict[str, int]" = {}
+        # doc -> {"owner": cell, "followers": [cells], "hinted":
+        #         {(cell, owner), ...}} — the replication topology this
+        #         edge has grown (hints are idempotent per (cell, owner))
+        self._replica_routes: "dict[str, dict]" = {}
+        # doc -> cell -> last digest-reported tick seq: the freshness
+        # signal behind promote-the-freshest-follower
+        self._replica_seqs: "dict[str, dict[str, int]]" = {}
         if create_client is not None:
             self.pub = create_client()
         else:
@@ -688,6 +722,19 @@ class EdgeGateway:
             "Router epoch (bumps on every membership/override change)",
             fn=lambda: self.router.epoch,
         )
+        self.replicated_docs_gauge = Gauge(
+            "hocuspocus_replica_docs",
+            "Docs this edge routes with an owner + follower set",
+            fn=lambda: float(len(self._replica_routes)),
+        )
+        self.follow_hints_total = Counter(
+            "hocuspocus_replica_follow_hints_total",
+            "FOLLOW routing hints sent to follower cells",
+        )
+        self.edge_promotions_total = Counter(
+            "hocuspocus_replica_edge_promotions_total",
+            "Owner promotions driven by this edge, by reason",
+        )
 
     def metrics(self) -> tuple:
         """Metric objects for MetricsRegistry.register adoption."""
@@ -702,6 +749,9 @@ class EdgeGateway:
             self.stale_frames_total,
             self.relay_overflow_total,
             self.route_epoch_gauge,
+            self.replicated_docs_gauge,
+            self.follow_hints_total,
+            self.edge_promotions_total,
         )
 
     def _count_channels(self) -> int:
@@ -802,6 +852,7 @@ class EdgeGateway:
                             "parked_channels": self._count_parked(),
                             "relay_queue_depth": self._relay_queue_depth(),
                             "relay_sessions": len(self.sessions),
+                            "replicated_docs": len(self._replica_routes),
                         },
                     },
                 )
@@ -955,6 +1006,163 @@ class EdgeGateway:
         else:
             spawn_tracked(self._tasks, self.pub.publish(channel, envelope))
 
+    # -- hot-doc replication -------------------------------------------------
+
+    def note_channel_opened(self, doc_name: str) -> None:
+        self._doc_audience[doc_name] = self._doc_audience.get(doc_name, 0) + 1
+
+    def note_channel_closed(self, doc_name: str) -> None:
+        count = self._doc_audience.get(doc_name, 0) - 1
+        if count > 0:
+            self._doc_audience[doc_name] = count
+        else:
+            self._doc_audience.pop(doc_name, None)
+
+    def replica_route_set(self, doc_name: str) -> "list[str]":
+        """Audience-aware placement: [owner] below the watermark, else
+        [owner, follower...] with one follower per watermark's worth of
+        local audience (capped at healthy-1). Growing the set sends the
+        FOLLOW hints that stand the followers up; the set only shrinks
+        through cell churn — an audience dip must not thrash follower
+        bootstrap."""
+        watermark = self.replica_watermark
+        if watermark <= 0:
+            owner = self.router.route(doc_name)
+            return [] if owner is None else [owner]
+        audience = self._doc_audience.get(doc_name, 0)
+        wanted = audience // watermark
+        entry = self._replica_routes.get(doc_name)
+        if entry is not None:
+            wanted = max(wanted, len(entry["followers"]))
+        wanted = min(wanted, max(len(self.router.healthy_cells()) - 1, 0))
+        route_set = self.router.route_set(doc_name, wanted)
+        if len(route_set) > 1:
+            self._ensure_hints(doc_name, route_set)
+        return route_set
+
+    def route_channel(self, doc_name: str, socket_id: str) -> "Optional[str]":
+        """The serving cell for one (doc, socket) channel: the owner
+        below the watermark; above it, a stable spread across owner +
+        followers so the read storm lands proportionally on every
+        replica while a given socket always rebinds to the same slot
+        (its SyncStep1 replay heals the one-slot move on churn)."""
+        route_set = self.replica_route_set(doc_name)
+        if not route_set:
+            return None
+        if len(route_set) == 1:
+            return route_set[0]
+        digest = hashlib.blake2b(
+            f"{doc_name}\x00{socket_id}".encode(), digest_size=4
+        ).digest()
+        return route_set[int.from_bytes(digest, "big") % len(route_set)]
+
+    def _ensure_hints(self, doc_name: str, route_set: "list[str]") -> None:
+        owner = route_set[0]
+        entry = self._replica_routes.get(doc_name)
+        if entry is None:
+            entry = self._replica_routes[doc_name] = {
+                "owner": owner,
+                "followers": [],
+                "hinted": set(),
+            }
+        entry["owner"] = owner
+        entry["followers"] = [c for c in route_set[1:]]
+        for follower in route_set[1:]:
+            self._send_follow_hint(entry, follower, doc_name, owner)
+
+    def _send_follow_hint(
+        self, entry: dict, target: str, doc_name: str, owner: str
+    ) -> None:
+        """Idempotent per (target, owner): the target cell learns the
+        doc's owner — follower cells subscribe, the owner itself (on
+        promotion) flips role."""
+        key = (target, owner)
+        if key in entry["hinted"]:
+            return
+        entry["hinted"].add(key)
+        self.publish_to_cell(
+            target,
+            relay.encode_envelope(
+                relay.FOLLOW,
+                self.edge_id,
+                relay.encode_replica_aux(d=doc_name, o=owner),
+            ),
+        )
+        self.counters["follow_hints"] += 1
+        self.follow_hints_total.inc()
+        get_flight_recorder().record(
+            "__replica__",
+            "follow" if target != owner else "promoted",
+            doc=doc_name,
+            cell=target,
+            owner=owner,
+            edge=self.edge_id,
+        )
+
+    def _harvest_replica_digest(self, node_id: str, digest: dict) -> None:
+        """Cell digests carry per-doc tick seqs; the freshest-follower
+        pick at promotion time reads them here. Harvested from every
+        cell digest — including our own echo — so the signal survives
+        digest dedup policy."""
+        replica = digest.get("replica")
+        if not isinstance(replica, dict):
+            return
+        for section in ("owned", "following"):
+            docs = replica.get(section)
+            if not isinstance(docs, dict):
+                continue
+            for doc_name, info in docs.items():
+                seq = info.get("seq") if isinstance(info, dict) else None
+                if isinstance(seq, int):
+                    self._replica_seqs.setdefault(doc_name, {})[node_id] = seq
+
+    def _promote_replicas(self, cell_id: str, reason: str) -> None:
+        """The departed cell leaves every replica topology it was part
+        of. Followers just drop out; a departed OWNER promotes the
+        freshest surviving follower (highest digest-carried tick seq,
+        HRW-order tie-break), clears the doc's stale router entries
+        (`CellRouter.promote`), and re-hints every survivor so the
+        promoted cell flips role and the rest re-subscribe to it."""
+        for doc_name, entry in list(self._replica_routes.items()):
+            if entry["owner"] != cell_id:
+                if cell_id in entry["followers"]:
+                    entry["followers"] = [
+                        f for f in entry["followers"] if f != cell_id
+                    ]
+                continue
+            survivors = [
+                f
+                for f in entry["followers"]
+                if self.router.state_of(f) == "healthy"
+            ]
+            if not survivors:
+                # no replica to promote: drop the entry — the ordinary
+                # re-route + Auth/Step1 resync takes over
+                self._replica_routes.pop(doc_name, None)
+                continue
+            seqs = self._replica_seqs.get(doc_name, {})
+            new_owner = max(
+                survivors,
+                key=lambda c: (seqs.get(c, -1), -survivors.index(c)),
+            )
+            self.router.promote(doc_name, new_owner)
+            entry["owner"] = new_owner
+            entry["followers"] = [f for f in survivors if f != new_owner]
+            self.counters["promotions"] += 1
+            self.edge_promotions_total.inc(reason=reason)
+            get_flight_recorder().record(
+                "__replica__",
+                "promoted",
+                doc=doc_name,
+                old_owner=cell_id,
+                new_owner=new_owner,
+                reason=reason,
+                edge=self.edge_id,
+            )
+            self._send_follow_hint(entry, new_owner, doc_name, new_owner)
+            for follower in entry["followers"]:
+                self._send_follow_hint(entry, follower, doc_name, new_owner)
+
     def open_session(self, owner: EdgeClientSession, cell_id: str) -> RelaySession:
         self._session_seq += 1
         session_id = f"{self.edge_id}:{owner.socket_id[:8]}:{self._session_seq}"
@@ -1015,12 +1223,20 @@ class EdgeGateway:
             # here too — skip it: _digest_tick already ingested locally,
             # and double-ingest would halve the self-peer's ring window
             # and inflate the digest counters
-            view = get_fleet_view()
-            if view.enabled and session_id != self.edge_id:
-                try:
-                    view.ingest(json.loads(payload))
-                except Exception:
-                    pass
+            try:
+                digest = json.loads(payload)
+            except Exception:
+                return
+            if isinstance(digest, dict):
+                # replica tick seqs ride cell digests: harvest before
+                # the self-echo skip so freshness survives dedup
+                self._harvest_replica_digest(session_id, digest)
+                view = get_fleet_view()
+                if view.enabled and session_id != self.edge_id:
+                    try:
+                        view.ingest(digest)
+                    except Exception:
+                        pass
             return
         if kind == relay.PONG:
             # clock-offset probe reply: session field = the cell's id,
@@ -1064,6 +1280,9 @@ class EdgeGateway:
         clients keep their sockets; each channel replays Auth+Step1 on
         its new cell."""
         self.counters["remaps"] += 1
+        # promotions FIRST: the rebinds below must route through the
+        # promoted owner's fresh placement, not the dead cell's
+        self._promote_replicas(cell_id, reason)
         affected = [
             session
             for session in self.sessions.values()
@@ -1104,6 +1323,20 @@ class EdgeGateway:
             "channels": dict(sorted(bindings.items())),
             "client_sockets": len(self.client_sessions),
             "counters": dict(self.counters),
+            "replica": {
+                "watermark": self.replica_watermark,
+                "docs": {
+                    doc: {
+                        "owner": entry["owner"],
+                        "followers": list(entry["followers"]),
+                        "audience": self._doc_audience.get(doc, 0),
+                        "seqs": dict(
+                            sorted(self._replica_seqs.get(doc, {}).items())
+                        ),
+                    }
+                    for doc, entry in sorted(self._replica_routes.items())
+                },
+            },
             "clock_offsets": {
                 peer: {
                     "offset_ms": round(est.offset_s * 1000.0, 3),

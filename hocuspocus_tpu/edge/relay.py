@@ -35,6 +35,7 @@ order with no handshake round trip.
 
 from __future__ import annotations
 
+import base64
 import json
 from typing import Optional
 
@@ -70,6 +71,30 @@ PONG = 21
 # pipelined lane, per-tick coalesced). Unknown to old edges: ignored.
 TRACE_RET = 22
 
+# hot-doc replication kinds (docs/guides/hot-doc-replication.md). Two
+# FOLLOW shapes share one kind, told apart by the aux keys:
+#   edge → cell   aux {"d": doc, "o": owner_id} — a routing hint: "this
+#                 doc's owner is `o`; follow it". When `o` names the
+#                 receiving cell itself, the cell BECOMES the owner
+#                 (promotion path).
+#   cell → cell   aux {"d": doc, "f": follower_id, "sv": b64 state
+#                 vector} — the follower subscribing at (or resyncing
+#                 with) the owner; the owner answers with a REPLICA_TICK
+#                 carrying the SV-diff plus its own state vector.
+# REPLICA_TICK (owner → follower) aux {"d": doc, "s": seq} carries the
+# owner's per-tick coalesced update; a bootstrap/resync reply adds
+# {"r": 1, "sv": owner SV b64} and resets the follower's seq counter.
+# A seq gap means a lost tick: the follower re-FOLLOWs with its local
+# state vector — the same state-based SyncStep1 resync exchange that
+# heals the relay everywhere else, never a silent divergence.
+# REPLICA_PUSH (follower → owner) aux {"d": doc} forwards coalesced
+# follower-local writes up to the owner, which applies them under a
+# replicable origin so the next tick re-streams them to every follower.
+FOLLOW = 30
+UNFOLLOW = 31
+REPLICA_TICK = 32
+REPLICA_PUSH = 33
+
 KIND_NAMES = {
     OPEN: "open",
     FRAME: "frame",
@@ -83,6 +108,10 @@ KIND_NAMES = {
     PING: "ping",
     PONG: "pong",
     TRACE_RET: "trace_return",
+    FOLLOW: "follow",
+    UNFOLLOW: "unfollow",
+    REPLICA_TICK: "replica_tick",
+    REPLICA_PUSH: "replica_push",
 }
 
 DEFAULT_PREFIX = "hocuspocus-edge"
@@ -172,4 +201,42 @@ def decode_trace_aux(aux: str) -> Optional[dict]:
         return None
     if not isinstance(data, dict) or data.get("v") != TRACE_AUX_VERSION:
         return None
+    return data
+
+
+# -- replica aux (FOLLOW / UNFOLLOW / REPLICA_TICK / REPLICA_PUSH) ---------
+#
+# Replica envelopes carry structured JSON in the aux field; state vectors
+# (raw lib0 bytes) ride base64 under "sv". Malformed aux decodes to {} —
+# the dispatcher drops the envelope and the follower's gap detector plus
+# the FOLLOW resync exchange recover, same contract as the rest of the
+# relay (at-most-once delivery healed by state-based resync).
+
+
+def encode_replica_aux(**fields) -> str:
+    aux = {}
+    for key, value in fields.items():
+        if value is None:
+            continue
+        if isinstance(value, (bytes, bytearray)):
+            value = base64.b64encode(bytes(value)).decode("ascii")
+        aux[key] = value
+    return json.dumps(aux, sort_keys=True, separators=(",", ":"))
+
+
+def decode_replica_aux(aux: str) -> dict:
+    """The replica envelope's aux dict with any "sv" field decoded back
+    to raw state-vector bytes; {} when absent or malformed."""
+    try:
+        data = json.loads(aux) if aux else {}
+    except Exception:
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    sv = data.get("sv")
+    if isinstance(sv, str):
+        try:
+            data["sv"] = base64.b64decode(sv.encode("ascii"))
+        except Exception:
+            return {}
     return data

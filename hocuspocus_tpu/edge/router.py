@@ -117,6 +117,22 @@ class CellRouter:
         if self.overrides.pop(doc_name, None) is not None:
             self.epoch += 1
 
+    def promote(self, doc_name: str, cell_id: str) -> None:
+        """Follower → owner promotion (hot-doc replication): make
+        `cell_id` the doc's owner and CLEAR any stale placement entry
+        first — a stranded override naming the dead owner would shadow
+        the promotion the moment that cell re-announced, re-splitting
+        the doc across two owners. When the promoted cell is already
+        the rendezvous winner no override is needed at all (the natural
+        map IS the promotion); otherwise a fresh override pins it."""
+        self.overrides.pop(doc_name, None)
+        entry = self.cells.get(cell_id)
+        if entry is not None and entry["state"] == HEALTHY:
+            natural = self.route(doc_name)
+            if natural != cell_id:
+                self.overrides[doc_name] = cell_id
+        self.epoch += 1
+
     # -- placement -----------------------------------------------------------
 
     @staticmethod
@@ -143,6 +159,26 @@ class CellRouter:
         # deterministic tie-break on the id keeps the map stable across
         # processes even in the astronomically unlikely score collision
         return max(cells, key=lambda cell: (self._score(doc_name, cell), cell))
+
+    def route_set(self, doc_name: str, followers: int) -> "list[str]":
+        """Audience-aware placement (hot-doc replication): the owner
+        plus up to `followers` follower cells, owner first, followers
+        in rendezvous order. Override-aware — position 0 is always
+        exactly `route(doc_name)`, so the replicated and unreplicated
+        answers can never disagree about the owner. Followers inherit
+        HRW's minimal-movement property: cell churn moves only the
+        follower slots the churned cell occupied."""
+        owner = self.route(doc_name)
+        if owner is None:
+            return []
+        if followers <= 0:
+            return [owner]
+        ranked = sorted(
+            self.healthy_cells(),
+            key=lambda cell: (self._score(doc_name, cell), cell),
+            reverse=True,
+        )
+        return [owner] + [c for c in ranked if c != owner][:followers]
 
     def table(self) -> dict:
         """The `/debug/edge` routing view."""

@@ -63,6 +63,7 @@ class ServedLoadHarness:
         capacity: int = 1024,
         flush_interval_ms: float = 2.0,
         docs_per_socket: int = 512,
+        replica_watermark: "Optional[int]" = None,
         sync_timeout: float = 600.0,
         background_fraction: int = 16,
         with_metrics: bool = False,
@@ -94,6 +95,10 @@ class ServedLoadHarness:
         self.capacity = capacity
         self.flush_interval_ms = flush_interval_ms
         self.docs_per_socket = docs_per_socket
+        # hot-doc replication knob (docs/guides/hot-doc-replication.md):
+        # None keeps the gateway default; mega-audience scenarios set a
+        # CI-scale watermark so a small join wave grows follower cells
+        self.replica_watermark = replica_watermark
         self.sync_timeout = sync_timeout
         self.background_fraction = background_fraction
         # with_metrics: add a Metrics extension per instance (enables
@@ -211,9 +216,14 @@ class ServedLoadHarness:
             self.cell_ingresses.append(ingress)
             self.extensions.append(plane_ext)
         for i in range(self.edges):
-            gateway_ext = EdgeGatewayExtension(
-                edge_id=f"loadgen-edge-{i}", host=host, port=port
-            )
+            gateway_options: "dict[str, Any]" = {
+                "edge_id": f"loadgen-edge-{i}",
+                "host": host,
+                "port": port,
+            }
+            if self.replica_watermark is not None:
+                gateway_options["replica_watermark"] = int(self.replica_watermark)
+            gateway_ext = EdgeGatewayExtension(**gateway_options)
             server = EdgeServer(
                 Configuration(quiet=True, extensions=[gateway_ext])
             )

@@ -28,6 +28,9 @@ workloads, Eg-walker arXiv:2409.14252 realistic-concurrency merges):
 - ``multi_device_storm`` — hot-doc skew on the per-chip cell plane: one
   mega-doc plus a small-doc population forces load-aware rebalancing
   mid-run (docs migrate between device cells with zero acked loss)
+- ``mega_audience``    — one viral doc, few writers, a huge read
+  audience through the edge tier: the replica watermark grows follower
+  cells and the fan-out spreads across them (owner work stays bounded)
 """
 
 from __future__ import annotations
@@ -643,6 +646,79 @@ def edge_fanout(
     )
 
 
+def mega_audience(
+    num_docs: int = 4,
+    phase_ms: int = 1500,
+    joins: int = 18,
+    watermark: int = 6,
+) -> Scenario:
+    """One doc goes viral (docs/guides/hot-doc-replication.md): a tiny
+    writer population keeps editing doc 0 while a huge read audience
+    piles in through edge 1 — crossing the replica watermark mid-run,
+    so the router grows an owner + follower placement, followers
+    bootstrap off the owner's snapshot rail and the edge spreads the
+    audience's channels across the whole route set. The fanout phase's
+    p99 is the `mega_audience.fanout_p99` gate stage in
+    tools/bench_gate.py: the measured write→observe path must stay FLAT
+    as the audience (and the follower count) scales, because the owner
+    only streams one coalesced tick per flush regardless of audience —
+    reads are the followers' problem. ``verify_convergence`` latches a
+    follower serving stale state into the verdict, and the per-edge
+    route tables + per-cell ReplicaManager stats land in
+    ``extra.replica`` so follower counts and tick lag are checkable
+    from the artifact alone."""
+    return Scenario(
+        name="mega_audience",
+        description="viral mega-doc: huge read audience fanned out over "
+        "follower cells while the write path stays on one owner",
+        num_docs=num_docs,
+        sampled=min(4, num_docs),
+        edges=2,
+        cells=3,
+        shards=1,
+        capacity=768,
+        docs_per_socket=num_docs,
+        params={
+            "verify_convergence": True,
+            "joins": joins,
+            # CI-scale watermark: the join wave must cross it with room
+            # to want several followers (wanted = audience // watermark,
+            # capped at healthy-1 by the gateway)
+            "replica_watermark": watermark,
+        },
+        phases=[
+            # every 2nd op lands on doc 0 at NORMAL sizes (the doc is
+            # hot by audience, not by payload — mega_doc covers that)
+            PhaseSpec(
+                "steady",
+                phase_ms,
+                _edit_gen(16.0, mega_every=2, mega_lo=16, mega_hi=32),
+                slo_e2e_ms=1000.0,
+            ),
+            PhaseSpec(
+                "swarm",
+                phase_ms,
+                _compose(
+                    _edit_gen(16.0, mega_every=2, mega_lo=16, mega_hi=32),
+                    _join_storm_gen(joins),
+                ),
+                # the swarm measures join time-to-synced WHILE followers
+                # bootstrap — a follower mid-hydration still admits and
+                # serves SyncStep2, so joins must not stall on it
+                slo_e2e_ms=2000.0,
+                slo_objective=0.90,
+            ),
+            PhaseSpec(
+                "fanout",
+                phase_ms,
+                _edit_gen(24.0, mega_every=2, mega_lo=16, mega_hi=32),
+                slo_e2e_ms=1000.0,
+                slo_objective=0.90,
+            ),
+        ],
+    )
+
+
 def edge_handoff(
     num_docs: int = 8,
     phase_ms: int = 1500,
@@ -708,12 +784,13 @@ SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "multi_device_storm": multi_device_storm,
     "edge_fanout": edge_fanout,
     "edge_handoff": edge_handoff,
+    "mega_audience": mega_audience,
 }
 
 # the default suite bench.py / bench_capture run: fast enough for every
 # round, covers the single-instance, cross-instance, overload-shed,
 # partition-heal, multi-device-rebalance and edge-tier (split front
-# door + cell-drain handoff) paths
+# door, cell-drain handoff, hot-doc follower fan-out) paths
 BENCH_SUITE = (
     "smoke",
     "replication_lag",
@@ -722,6 +799,7 @@ BENCH_SUITE = (
     "multi_device_storm",
     "edge_fanout",
     "edge_handoff",
+    "mega_audience",
 )
 
 

@@ -745,10 +745,21 @@ class FleetView:
                 "queues",
                 "edge",
                 "cell",
+                "replica",
             ):
                 value = digest.get(key)
                 if value is not None:
                     entry[key] = value
+            replica = digest.get("replica")
+            if isinstance(replica, dict):
+                # the hot-doc followers column: how many follower
+                # subscriptions this cell is serving (owner side) and
+                # how many docs it follows (replica side)
+                entry["followers"] = sum(
+                    len(owned.get("followers") or ())
+                    for owned in (replica.get("owned") or {}).values()
+                )
+                entry["following"] = len(replica.get("following") or ())
             peers[node_id] = entry
             if digest.get("cells") is not None:
                 cells[node_id] = digest["cells"]
@@ -763,6 +774,11 @@ class FleetView:
                 "fresh": len(fresh),
                 "sessions": self._sum_field("sessions", fresh),
                 "docs": self._sum_field("docs", fresh),
+                "followers": sum(
+                    entry.get("followers", 0)
+                    for node_id, entry in peers.items()
+                    if self._peer_state[node_id]["state"] == "up"
+                ),
             },
             "cross_tier_e2e_ms": self.cross_tier_quantiles(),
             "counters": dict(self.counters),

@@ -4,7 +4,7 @@ from .debounce import Debouncer
 from .direct_connection import DirectConnection
 from .document import Document
 from .hocuspocus import Hocuspocus, RequestInfo, REDIS_ORIGIN
-from .types import WAL_ORIGIN
+from .types import REPLICA_ORIGIN, WAL_ORIGIN
 from .message_receiver import MessageReceiver
 from .overload import (
     OverloadController,
@@ -26,6 +26,7 @@ __all__ = [
     "RequestInfo",
     "REDIS_ORIGIN",
     "WAL_ORIGIN",
+    "REPLICA_ORIGIN",
     "MessageReceiver",
     "OverloadController",
     "OverloadExtension",

@@ -19,7 +19,7 @@ from ..protocol.awareness import (
 from ..protocol.frames import build_update_frame
 from ..protocol.message import OutgoingMessage
 from .fanout import DocumentFanout
-from .types import REDIS_ORIGIN
+from .types import REDIS_ORIGIN, REPLICA_ORIGIN
 
 
 class Document(Doc):
@@ -180,9 +180,14 @@ class Document(Doc):
         # update; here bursts within one event-loop iteration coalesce
         # into ONE merged frame — same latency via call_soon, 1/N the
         # frame builds + websocket sends + receiver applies). Updates
-        # applied FROM the redis bus are flagged non-replicable so the
-        # tick's replication seam can't echo them back across instances.
-        self.fanout.queue_update(update, replicate=origin != REDIS_ORIGIN, gate=gate)
+        # applied FROM the redis bus or the hot-doc replica stream are
+        # flagged non-replicable so the tick's replication seams can't
+        # echo them back across instances (or between owner/followers).
+        self.fanout.queue_update(
+            update,
+            replicate=origin not in (REDIS_ORIGIN, REPLICA_ORIGIN),
+            gate=gate,
+        )
 
     async def wait_wal_durable(self, max_rounds: int = 16) -> None:
         """Wait until every update currently applied to this doc has a

@@ -257,6 +257,14 @@ class DocumentFanout:
         # boundary: republishing them would echo between instances.
         self.replicate_updates: Optional[Callable[[Optional[bytes], list], Any]] = None
         self.replicate_awareness: Optional[Callable[[bytes], Any]] = None
+        # hot-doc replication seam (edge/replica.py): same contract as
+        # replicate_updates — the tick's replicable (local-origin)
+        # updates, coalesced. At an OWNER the sink streams them as a
+        # seq-numbered REPLICA_TICK to every follower; at a FOLLOWER it
+        # forwards locally-written updates up to the owner
+        # (REPLICA_PUSH). Tick-applied updates carry REPLICA_ORIGIN and
+        # are non-replicable, so the seam never echoes.
+        self.replica_sink: Optional[Callable[[list], Any]] = None
         # durability gates (storage/extension.py): group-commit futures
         # the tick must wait out before DELIVERING — an update is never
         # shown to a client while the WAL write that covers it is still
@@ -380,6 +388,15 @@ class DocumentFanout:
                         wire.record_fanout_frame(
                             len(pending), (len(pending) - 1) * len(audience)
                         )
+                if self.replica_sink is not None:
+                    sink_updates = [
+                        u for u, r in zip(pending, replicate_flags) if r
+                    ]
+                    if sink_updates:
+                        try:
+                            self.replica_sink(sink_updates)
+                        except Exception:
+                            pass  # replication must never break local fan-out
                 if self.replicate_updates is not None:
                     replicable = [
                         u for u, r in zip(pending, replicate_flags) if r
@@ -502,3 +519,4 @@ class DocumentFanout:
         self._gate_tasks.clear()
         self.replicate_updates = None
         self.replicate_awareness = None
+        self.replica_sink = None

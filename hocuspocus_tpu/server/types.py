@@ -21,6 +21,13 @@ REDIS_ORIGIN = "__hocuspocus__redis__origin__"
 # edits.
 WAL_ORIGIN = "__hocuspocus__wal__origin__"
 
+# Transaction origin for updates applied from the hot-doc replication
+# stream (edge/replica.py REPLICA_TICK at a follower): like REDIS_ORIGIN
+# these must never re-enter the replication seams — the owner's tick
+# stream is the single source, so re-streaming a tick apply would echo
+# forever between owner and followers.
+REPLICA_ORIGIN = "__hocuspocus__replica__origin__"
+
 # All lifecycle hooks, in the reference's vocabulary (snake_cased).
 HOOK_NAMES = (
     "on_configure",

@@ -328,6 +328,14 @@ class MultiDeviceMergeExtension(Extension):
     def cell_for(self, document_name: str) -> TpuMergeExtension:
         return self.cells[self.cell_index_for(document_name)]
 
+    def residency_for(self, document_name: str):
+        """The owning cell's ResidencyManager, or None when residency is
+        off. Hot-doc replication (edge/replica.py) snapshots an owner's
+        doc (`replica_snapshot`, no evict) and seeds a follower's arena
+        (`adopt_snapshot` + `request_hydration`) through this handle —
+        the same rail cross-cell migration rides."""
+        return self.cell_for(document_name).plane.residency
+
     # -- lifecycle hooks (broadcast) -----------------------------------------
 
     async def on_listen(self, data: Payload) -> None:

@@ -2274,6 +2274,7 @@ class TpuMergeExtension(Extension):
     def try_capture(self, document, update: bytes, origin) -> bool:
         """Claim an update for plane-batched broadcast. False = CPU fan-out."""
         from ..server.hocuspocus import REDIS_ORIGIN
+        from ..server.types import REPLICA_ORIGIN
 
         name = document.name
         if not self.serve or name not in self._docs:
@@ -2311,7 +2312,12 @@ class TpuMergeExtension(Extension):
         # after that drain would miss its own flush cycle
         book = plane.update_traces
         trace_id = plane.note_trace(name) if book.enabled else None
-        accepted = plane.enqueue_update(name, update, remote=origin == REDIS_ORIGIN)
+        # replica-stream applies count as remote ops: the merged window's
+        # cross_update must carry only locally-originated ops, or the
+        # plane would echo the owner's ticks back over the replica lane
+        accepted = plane.enqueue_update(
+            name, update, remote=origin in (REDIS_ORIGIN, REPLICA_ORIGIN)
+        )
         if trace_id is not None and not accepted:
             # nothing queued (deduplicated, or the doc degraded during
             # the enqueue — where retire already dropped the doc's book)

@@ -435,6 +435,17 @@ class ResidencyManager:
         lossless)."""
         self._evicted_add(name, snapshot)
 
+    def replica_snapshot(self, name: str, document) -> Optional[bytes]:
+        """Hot-doc replication, owner side (edge/replica.py): the same
+        serving-path full-state encode the migration rail uses — but
+        WITHOUT evicting. The owner keeps its rows, write path, and WAL;
+        the follower adopts the snapshot (`adopt_snapshot`) and hydrates
+        through its own admission queue, exactly like a migration
+        target. Returns None when no encode path is available (caller
+        falls back to a plain CPU state diff)."""
+        self.touch(name)
+        return self._snapshot(name, document)
+
     def _snapshot(self, name: str, document) -> Optional[bytes]:
         """Encoded full state for the eviction record. The plane's own
         serving path first (healthy + covers the CPU doc, so the bytes

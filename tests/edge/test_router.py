@@ -123,6 +123,73 @@ def test_expire_stale_marks_dead_after_heartbeat_timeout():
     assert router.state_of("cell-a") == HEALTHY
 
 
+def test_promote_clears_stale_override_not_stranded():
+    """ISSUE-16 satellite regression: follower→owner promotion CLEARS
+    the doc's stale placement entry instead of stranding it. A stranded
+    override naming the dead old owner would shadow the promotion the
+    moment that cell re-announced — re-splitting the doc across two
+    owners (the stale-follower-route bug)."""
+    router = CellRouter()
+    for i in range(3):
+        router.add_cell(f"cell-{i}")
+    doc = "viral-doc"
+    natural = router.route(doc)
+    # the doc was pinned to a non-natural owner, which then died
+    old_owner = next(c for c in router.healthy_cells() if c != natural)
+    router.set_override(doc, old_owner)
+    router.mark_dead(old_owner)
+    epoch = router.epoch
+    router.promote(doc, natural)
+    assert router.epoch == epoch + 1  # observers see the remap
+    # the stale pin is GONE (natural winner needs no override at all)
+    assert doc not in router.overrides
+    assert router.route(doc) == natural
+    # the dead old owner re-announcing must NOT reclaim the doc
+    router.add_cell(old_owner)
+    assert router.route(doc) == natural
+
+
+def test_promote_pins_only_non_natural_winner():
+    router = CellRouter()
+    for i in range(3):
+        router.add_cell(f"cell-{i}")
+    doc = "viral-doc"
+    natural = router.route(doc)
+    promoted = next(c for c in router.healthy_cells() if c != natural)
+    router.promote(doc, promoted)
+    assert router.overrides[doc] == promoted
+    assert router.route(doc) == promoted
+    # promoting the rendezvous winner itself leaves the override table
+    # clean — the natural map IS the promotion
+    router.promote(doc, natural)
+    assert doc not in router.overrides
+    assert router.route(doc) == natural
+
+
+def test_route_set_owner_first_override_aware_and_capped():
+    router = CellRouter()
+    for i in range(4):
+        router.add_cell(f"cell-{i}")
+    doc = "viral-doc"
+    owner = router.route(doc)
+    assert router.route_set(doc, 0) == [owner]
+    route_set = router.route_set(doc, 2)
+    assert route_set[0] == owner
+    assert len(route_set) == 3 and len(set(route_set)) == 3
+    # asking for more followers than the fleet holds caps at healthy
+    assert len(router.route_set(doc, 99)) == 4
+    # position 0 always agrees with route(): an override moves the
+    # owner slot and the old owner re-ranks in as a follower
+    pinned = route_set[1]
+    router.set_override(doc, pinned)
+    pinned_set = router.route_set(doc, 2)
+    assert pinned_set[0] == pinned and pinned not in pinned_set[1:]
+    assert owner in pinned_set[1:]
+    for i in range(4):
+        router.mark_dead(f"cell-{i}")
+    assert router.route_set(doc, 2) == []
+
+
 def test_table_reports_states_and_overrides():
     router = CellRouter(overrides={"doc-x": "cell-a"})
     router.add_cell("cell-a")

@@ -144,6 +144,17 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
             p99 = (storm_md.get("phase_p99_ms") or {}).get("storm")
             if isinstance(p99, (int, float)) and not isinstance(p99, bool):
                 stages["multi_device_storm.interactive_p99"] = float(p99)
+        # hot-doc fan-out latency: the mega_audience scenario's
+        # fanout-phase p99 is measured while a watermark-crossing read
+        # audience is spread over follower cells — a regression here
+        # means audience growth started bleeding back into the owner's
+        # write→observe path (the flat-fan-out promise of
+        # docs/guides/hot-doc-replication.md)
+        mega = (suite.get("scenarios") or {}).get("mega_audience")
+        if isinstance(mega, dict):
+            p99 = (mega.get("phase_p99_ms") or {}).get("fanout")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["mega_audience.fanout_p99"] = float(p99)
         # edge-tier interactive latency: the edge_fanout scenario's
         # fanout-phase p99 is measured writer->edge->cell->edge->reader
         # under a door-admitted join storm — a regression here means
