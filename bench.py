@@ -1673,6 +1673,20 @@ def _measure_mixed_load() -> dict:
         lane0, ext.plane.lane = ext.plane.lane, None
         ext.plane.warmup_compiles()
         ext.plane.lane = lane0
+        # per-microbatch wall time: every plane.flush in the leg —
+        # interactive drains, hydration rounds, compaction presyncs —
+        # so the minimal-work run merge's cost shows up as a p99 drop
+        # HERE (the fast columns skip the full-row integrate sweep)
+        flush_ms: list = []
+        orig_flush = ext.plane.flush
+
+        def timed_flush(*f_args, **f_kwargs):
+            f_t0 = _time.perf_counter()
+            result = orig_flush(*f_args, **f_kwargs)
+            flush_ms.append((_time.perf_counter() - f_t0) * 1000.0)
+            return result
+
+        ext.plane.flush = timed_flush
         docs: dict = {}
         sources: dict = {}
 
@@ -1820,11 +1834,28 @@ def _measure_mixed_load() -> dict:
         while (mgr._queue or mgr._drain_running) and _time.perf_counter() < deadline:
             await _asyncio.sleep(0.005)
         ext.cancel_timers()
+        ext.plane.flush = orig_flush
         arr = np.array(lat) * 1000.0
         sync_arr = np.array(sync_lat or [0.0]) * 1000.0
+        flush_arr = np.array(flush_ms or [0.0])
+        # minimal-work merge accounting: what fraction of integrated
+        # ops rode the append program vs the full integrate, and what
+        # fraction of SyncStep2 delete-set reads came off the device
+        # pack vs the host row gather
+        fast_ops = ext.plane.counters["flush_fast_ops"]
+        slow_ops = ext.plane.counters["flush_slow_ops"]
+        enc_dev = ext.plane.counters["sync_encode_device"]
+        enc_host = ext.plane.counters["sync_encode_host"]
         out = {
             "interactive_p50_ms": round(float(np.percentile(arr, 50)), 3),
             "interactive_p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "microbatch_p50_ms": round(float(np.percentile(flush_arr, 50)), 3),
+            "microbatch_p99_ms": round(float(np.percentile(flush_arr, 99)), 3),
+            "microbatches": len(flush_ms),
+            "fast_path_fraction": round(fast_ops / max(fast_ops + slow_ops, 1), 3),
+            "fast_path_ops": fast_ops,
+            "slow_path_ops": slow_ops,
+            "device_encode_share": round(enc_dev / max(enc_dev + enc_host, 1), 3),
             "interactive_sync_p50_ms": round(float(np.percentile(sync_arr, 50)), 3),
             "interactive_sync_p99_ms": round(float(np.percentile(sync_arr, 99)), 3),
             "samples": len(lat),
@@ -1931,6 +1962,8 @@ def _measure_catchup_storm() -> dict:
         )
         texts: dict = {}
         tails: dict = {}
+        sample_refs: dict = {}
+        probe_sample = int(os.environ.get("BENCH_STORM_PROBES", 256))
         for i in range(storm):
             ref = Doc()
             ref.get_text("t").insert(0, "cold doc %05d " % i + "payload " * 3)
@@ -1941,6 +1974,8 @@ def _measure_catchup_storm() -> dict:
                 ref.get_text("t").insert(0, "tail %d " % i)
                 tails[f"storm-{i}"] = ref
             texts[f"storm-{i}"] = ref.get_text("t").to_string()
+            if len(sample_refs) < probe_sample:
+                sample_refs[f"storm-{i}"] = ref
             mgr.evicted[f"storm-{i}"] = EvictedDoc(snapshot, 0.0)
 
         inflight_max = 0
@@ -1968,6 +2003,19 @@ def _measure_catchup_storm() -> dict:
             for name, want in texts.items()
             if not (plane.is_supported(name) and plane.text(name) == want)
         )
+        # post-storm cold joiners: every probe is a fresh SyncStep2
+        # (sv=None, no cache priors) through the serving encode — the
+        # path the on-device catch-up pack exists for. Gated by
+        # tools/bench_gate.py as catchup_storm.cold_sync_p99.
+        cold_lat: list = []
+        for name, ref in sample_refs.items():
+            p0 = _time.perf_counter()
+            payload = serving.encode_state_as_update(name, ref, None)
+            if payload is not None:
+                cold_lat.append(_time.perf_counter() - p0)
+        cold_arr = np.array(cold_lat or [0.0]) * 1000.0
+        enc_dev = plane.counters["sync_encode_device"]
+        enc_host = plane.counters["sync_encode_host"]
         stats = mgr.stats_snapshot()
         hydrated = plane.counters["docs_hydrated"]
         return {
@@ -1984,6 +2032,10 @@ def _measure_catchup_storm() -> dict:
             "max_inflight": inflight_max,
             "completed": completed,
             "lost_updates": lost,
+            "cold_sync_probes": len(cold_lat),
+            "cold_sync_p50_ms": round(float(np.percentile(cold_arr, 50)), 3),
+            "cold_sync_p99_ms": round(float(np.percentile(cold_arr, 99)), 3),
+            "device_encode_share": round(enc_dev / max(enc_dev + enc_host, 1), 3),
         }
 
     return _asyncio.run(run())

@@ -348,6 +348,17 @@ class ReplicaManager:
                         bootstrap = payload is not None
                     except Exception:
                         payload = None
+            if payload is None and not cold:
+                # warm follower: the plane serves the SV-diff (device
+                # tombstone pack, no host serve-log walk) when healthy
+                residency = self._residency(doc_name)
+                if residency is not None:
+                    try:
+                        payload = residency.replica_catchup(
+                            doc_name, document, follower_sv
+                        )
+                    except Exception:
+                        payload = None
             if payload is None:
                 try:
                     payload = encode_state_as_update(

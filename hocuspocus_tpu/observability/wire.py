@@ -132,7 +132,8 @@ class WireTelemetry:
         )
         self.sync_cache_events = Counter(
             "hocuspocus_wire_sync_cache_total",
-            "Join-storm sync cache lookups by result (hit/miss/eviction)",
+            "Join-storm sync cache lookups by result (hit/miss/eviction)"
+            " and encode path (device/host)",
         )
         self.send_queue_overflows = Counter(
             "hocuspocus_wire_send_queue_overflow_total",
@@ -263,8 +264,21 @@ class WireTelemetry:
     def record_tier(self, transition: str) -> None:
         self.catchup_tier_transitions.inc(transition=transition)
 
-    def record_sync_cache(self, result: str, count: int = 1) -> None:
-        self.sync_cache_events.inc(count, result=result)
+    def record_sync_cache(
+        self, result: str, count: int = 1, path: str = "host"
+    ) -> None:
+        """path labels the serve's delete-set read route: "device" when
+        the packed on-device catch-up encode is active for the doc,
+        "host" for the full-row gather (pack disabled or degraded)."""
+        self.sync_cache_events.inc(count, result=result, path=path)
+
+    def _sync_cache_total(self, result: str) -> float:
+        """Sum one result across path labels (device/host)."""
+        return sum(
+            value
+            for key, value in self.sync_cache_events._values.items()
+            if dict(key).get("result") == result
+        )
 
     def record_queue_overflow(self) -> None:
         self.send_queue_overflows.inc()
@@ -455,8 +469,8 @@ class WireTelemetry:
             "sends_elided_catchup": self.fanout_sends_elided.value(reason="catchup"),
             "tier_entries": self.catchup_tier_transitions.value(transition="enter"),
             "tier_exits": self.catchup_tier_transitions.value(transition="exit"),
-            "sync_cache_hits": self.sync_cache_events.value(result="hit"),
-            "sync_cache_misses": self.sync_cache_events.value(result="miss"),
+            "sync_cache_hits": self._sync_cache_total("hit"),
+            "sync_cache_misses": self._sync_cache_total("miss"),
             "queue_overflows": sum(self.send_queue_overflows._values.values()),
             "pubsub_publishes": sum(self.pubsub_publishes._values.values()),
             "pubsub_deliveries": sum(self.pubsub_deliveries._values.values()),

@@ -368,3 +368,177 @@ def state_vector_diff(
     missing_from = jnp.minimum(client_clocks, doc_clocks)
     missing_len = jnp.maximum(doc_clocks - client_clocks, 0)
     return missing_from, missing_len
+
+
+# -- minimal-work run merge (the sequential fast path) ------------------------
+#
+# The integrate scan above pays K passes over the whole arena row no
+# matter what the ops are — the eg-walker observation (arXiv:2409.14252)
+# is that merge cost should track the CONCURRENT region, and the common
+# op mix (one author typing, a cold snapshot hydrating) is a pure chain
+# of tail appends with an EMPTY concurrent region. For those the YATA
+# window between `left = rank-tail` and `right = doc end` contains
+# nothing, so integration degenerates to "fill the next free slots":
+# rank = slot index, origin_rank = slot index - 1, no conflict scan, no
+# rank bumps, and the whole chain lands in ONE arena pass instead of one
+# scan pass per op.
+#
+# The HOST decides eligibility (merge_plane._classify_fast): a batch
+# column takes this kernel only when every drained op is an insert whose
+# left origin is the tracked rank-tail of the chain and whose right
+# origin is NONE — exactly the "append at document end" shape, for which
+# this kernel is bit-identical to the scan path (including the
+# longest-fitting-prefix overflow semantics below). Anything else —
+# deletes, mid-doc inserts, unknown tails — falls back to the full-row
+# integrate for that column.
+
+
+def _append_runs_one(state: DocState, client, clock, run_len) -> tuple:
+    """Apply up to K chained tail-append runs to one document.
+
+    client/clock/run_len are (K,) coalesced runs (host-merged maximal
+    same-client consecutive-clock chains; run_len == 0 = padding). The
+    caller guarantees run m's left origin is the last unit of run m-1
+    (run 0's left is the current rank-tail / doc start), so the only
+    per-run work is the capacity ladder: a run integrates while the
+    chain is alive and it fits, a run that does not fit marks overflow
+    and kills the chain (later runs' origins are then missing — the
+    exact deps_ok cascade the scan path produces, including its quirk
+    that a dead-chain run only flags overflow when it ALSO fails its
+    own fits check against the unchanged length)."""
+    n = state.id_client.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    base = state.length
+    is_run = run_len > 0
+
+    def fit_step(carry, m):
+        applied, alive, over = carry
+        fits = base + applied + run_len[m] <= n
+        live = alive & fits & is_run[m]
+        start = applied
+        applied = applied + jnp.where(live, run_len[m], 0)
+        over = over | (is_run[m] & ~fits)
+        alive = alive & (fits | ~is_run[m])
+        return (applied, alive, over), (start, live)
+
+    (applied_total, _alive, overflow), (starts, lives) = jax.lax.scan(
+        fit_step,
+        (jnp.int32(0), jnp.bool_(True), state.overflow),
+        jnp.arange(client.shape[0]),
+    )
+
+    # one elementwise fill pass: new units occupy slots [base, base +
+    # applied_total) in chain order, so slot i carries rank i and
+    # origin rank i - 1 (run 0's first unit origins the old rank-tail
+    # at rank base - 1 = i - 1; the doc-start case is -1 = i - 1 too)
+    off = idx - base
+
+    def fill_step(carry, m):
+        sel_client, sel_clock, in_new = carry
+        in_run = lives[m] & (off >= starts[m]) & (off < starts[m] + run_len[m])
+        sel_client = jnp.where(in_run, client[m], sel_client)
+        sel_clock = jnp.where(in_run, clock[m] + (off - starts[m]), sel_clock)
+        return (sel_client, sel_clock, in_new | in_run), None
+
+    (sel_client, sel_clock, in_new), _ = jax.lax.scan(
+        fill_step,
+        (state.id_client, state.id_clock, jnp.zeros((n,), bool)),
+        jnp.arange(client.shape[0]),
+    )
+    new_state = DocState(
+        id_client=sel_client,
+        id_clock=sel_clock,
+        rank=jnp.where(in_new, idx, state.rank),
+        origin_rank=jnp.where(in_new, idx - 1, state.origin_rank),
+        deleted=jnp.where(in_new, False, state.deleted),
+        length=base + applied_total,
+        overflow=overflow,
+    )
+    return new_state, jnp.sum(lives.astype(jnp.int32))
+
+
+_append_runs_batch = jax.vmap(_append_runs_one, in_axes=(0, 1, 1, 1))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def append_run_slots_sparse(
+    state: DocState, client, clock, run_len, slots: jax.Array
+) -> tuple[DocState, jax.Array]:
+    """Fast-path integrate for B all-sequential busy docs.
+
+    client (K, B) uint32 / clock (K, B) int32 / run_len (K, B) int32
+    are coalesced tail-append runs per column; slots is the int32 (B,)
+    routing vector with the same gather-clip/scatter-drop padding
+    contract as integrate_op_slots_sparse (sentinel = num_docs,
+    padding columns all run_len == 0). Near-O(new ops) device work per
+    column instead of K full-row scan passes."""
+    sub = gather_doc_rows(state, slots)
+    sub, counts = _append_runs_batch(sub, client, clock, run_len)
+    state = scatter_doc_rows(state, sub, slots)
+    count, _ = jax.lax.optimization_barrier((jnp.sum(counts), state.length))
+    return state, count
+
+
+# -- on-device catch-up support (SyncStep2 serving) ---------------------------
+
+
+def _tail_probe_one(state: DocState) -> tuple:
+    """(client, clock) id of the rank-tail unit of one document.
+
+    The rank-tail (rank == length - 1) is the only unit a pure tail
+    append may name as its left origin with a NONE right origin, so
+    this pair is everything the host classifier needs to re-arm a
+    slot's chain tracking. Masked SUMS, not maxes: exactly one unit
+    matches (dense ranks), and a masked max through an int32 view
+    would misread uint32 client ids with the high bit set. An empty
+    doc matches nothing and reads as (0, 0) — the host keys on
+    length == 0 before trusting the pair."""
+    tail = state.rank == state.length - 1
+    client = jnp.sum(jnp.where(tail, state.id_client, jnp.uint32(0)), dtype=jnp.uint32)
+    clock = jnp.sum(jnp.where(tail, state.id_clock, 0))
+    return client, clock.astype(jnp.uint32)
+
+
+@partial(jax.jit)
+def tail_probe(state: DocState, slots: jax.Array) -> jax.Array:
+    """Rank-tail ids for the B requested doc rows, as ONE (2B,) uint32
+    readback: [clients..., clocks...]. Padding slots (sentinel
+    num_docs) clip to row 0 and return garbage the host ignores."""
+    sub = gather_doc_rows(state, slots)
+    clients, clocks = jax.vmap(_tail_probe_one)(sub)
+    return jnp.concatenate([clients, clocks])
+
+
+@partial(jax.jit, static_argnames=("width",))
+def catchup_pack(state: DocState, slots: jax.Array, width: int) -> jax.Array:
+    """Device-side SyncStep2 delete-set pack for B requested doc rows.
+
+    The host serve path used to read each row's full (3, B, N)
+    [deleted, id_client, id_clock] planes and filter tombstones on the
+    CPU; this kernel does the gather + prefix-sum compaction on device
+    and ships only the packed tombstones: ONE (B + 2*B*width,) uint32
+    readback laid out [counts (B,), clients (B, width) flat, clocks
+    (B, width) flat], in arena order (the host sorts/merges exactly as
+    before, so the emitted DeleteSet bytes are identical). A row with
+    more than `width` tombstones reports the true count and the host
+    falls back to the full-row read for that row."""
+
+    def one(row: DocState):
+        n = row.id_client.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        dead = (idx < row.length) & row.deleted
+        pos = jnp.cumsum(dead.astype(jnp.int32)) - 1
+        dst = jnp.where(dead, pos, width)  # width = drop sentinel
+        clients = (
+            jnp.zeros((width,), jnp.uint32).at[dst].set(row.id_client, mode="drop")
+        )
+        clocks = (
+            jnp.zeros((width,), jnp.int32).at[dst].set(row.id_clock, mode="drop")
+        )
+        return jnp.sum(dead.astype(jnp.int32)), clients, clocks.astype(jnp.uint32)
+
+    sub = gather_doc_rows(state, slots)
+    counts, clients, clocks = jax.vmap(one)(sub)
+    return jnp.concatenate(
+        [counts.astype(jnp.uint32), clients.reshape(-1), clocks.reshape(-1)]
+    )

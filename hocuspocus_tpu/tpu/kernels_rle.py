@@ -397,6 +397,219 @@ def compact_doc_rows_rle(state: RleState, slots) -> tuple[RleState, jax.Array]:
     return state, counts
 
 
+# -- minimal-work run merge (the sequential fast path) ------------------------
+#
+# RLE twin of kernels.append_run_slots_sparse: the host classifier
+# (merge_plane._classify_fast) routes a batch column here only when
+# every drained op is a chained tail append (left origin = tracked
+# rank-tail, right origin = NONE), for which the YATA window is empty
+# and integration needs no conflict scan, no splits and no rank bumps.
+# Two shapes of device work per coalesced run:
+#
+# - EXTEND: run 0 continues the arena's rank-tail entry (same client,
+#   consecutive clock, entry not tombstoned) — run_len += len, zero new
+#   entries. The scan path would append a fresh entry instead; the
+#   fast path's layout is exactly the merge the RLE compactor
+#   (_compact_one_rle) performs later, so unit expansion — and every
+#   serve derived from it — is identical while entry pressure drops.
+# - APPEND: one new entry at the next free lane with rank = old total
+#   + chain offset and orank = rank - 1, the same fields the scan
+#   path's _append_entry writes for an end-of-doc insert.
+#
+# Overflow semantics: a run that needs a lane when none is free flags
+# overflow and kills the chain (later runs' origins would be missing).
+# This admits strictly MORE work near capacity than the scan path's
+# conservative `num_runs + 2 <= R` split margin (extensions need no
+# lane at all) — a doc the fast path still fits would have overflowed
+# under the slow path, never the reverse, so the retire/degrade story
+# is unchanged and the equivalence fuzz compares unit expansions away
+# from the capacity edge.
+
+
+def _append_entries_one_rle(state: RleState, client, clock, run_len) -> tuple:
+    """Apply up to K chained tail-append runs to one document row."""
+    r = state.run_client.shape[0]
+    idx = jnp.arange(r, dtype=jnp.int32)
+    total = state.total_units
+    entries = state.num_runs
+    is_run = run_len > 0
+
+    # the rank-tail entry: occupied entry spans are disjoint and cover
+    # [0, total), so exactly one nonempty entry ends at `total` (none
+    # when the doc is empty) — masked sums extract its fields
+    occupied = (idx < entries) & (state.run_len > 0)
+    tail = occupied & (state.run_rank + state.run_len == total) & (total > 0)
+    tail_client = jnp.sum(jnp.where(tail, state.run_client, jnp.uint32(0)), dtype=jnp.uint32)
+    tail_end_clock = jnp.sum(jnp.where(tail, state.run_clock + state.run_len, 0))
+    tail_deleted = jnp.any(tail & state.run_deleted)
+    ext0 = (
+        is_run[0]
+        & (total > 0)
+        & jnp.any(tail)
+        & (tail_client == client[0])
+        & (clock[0] == tail_end_clock)
+        & ~tail_deleted
+    )
+
+    def fit_step(carry, m):
+        applied_units, new_entries, alive, over = carry
+        extend = (m == 0) & ext0
+        fits = extend | (entries + new_entries + 1 <= r)
+        live = alive & fits & is_run[m]
+        start = applied_units
+        lane = entries + new_entries
+        applied_units = applied_units + jnp.where(live, run_len[m], 0)
+        new_entries = new_entries + jnp.where(live & ~extend, 1, 0)
+        over = over | (is_run[m] & ~fits)
+        alive = alive & (fits | ~is_run[m])
+        return (applied_units, new_entries, alive, over), (
+            start,
+            lane,
+            live & ~extend,
+        )
+
+    (applied_units, _new_entries, _alive, overflow), (starts, lanes, appends) = (
+        jax.lax.scan(
+            fit_step,
+            (jnp.int32(0), jnp.int32(0), jnp.bool_(True), state.overflow),
+            jnp.arange(client.shape[0]),
+        )
+    )
+
+    # extension first (its own lane, disjoint from every appended lane)
+    extend_applied = ext0  # an extension always fits
+    run_len_out = jnp.where(
+        tail & extend_applied, state.run_len + run_len[0], state.run_len
+    )
+
+    def write_step(carry, m):
+        e_client, e_clock, e_len, e_rank, e_orank, e_deleted = carry
+        at = appends[m] & (idx == lanes[m])
+        e_client = jnp.where(at, client[m], e_client)
+        e_clock = jnp.where(at, clock[m], e_clock)
+        e_len = jnp.where(at, run_len[m], e_len)
+        e_rank = jnp.where(at, total + starts[m], e_rank)
+        e_orank = jnp.where(at, total + starts[m] - 1, e_orank)
+        e_deleted = jnp.where(at, False, e_deleted)
+        return (e_client, e_clock, e_len, e_rank, e_orank, e_deleted), None
+
+    (e_client, e_clock, e_len, e_rank, e_orank, e_deleted), _ = jax.lax.scan(
+        write_step,
+        (
+            state.run_client,
+            state.run_clock,
+            run_len_out,
+            state.run_rank,
+            state.run_orank,
+            state.run_deleted,
+        ),
+        jnp.arange(client.shape[0]),
+    )
+    new_state = RleState(
+        run_client=e_client,
+        run_clock=e_clock,
+        run_len=e_len,
+        run_rank=e_rank,
+        run_orank=e_orank,
+        run_deleted=e_deleted,
+        num_runs=entries + jnp.sum(appends.astype(jnp.int32)),
+        total_units=total + applied_units,
+        overflow=overflow,
+    )
+    applied_runs = jnp.sum(appends.astype(jnp.int32)) + extend_applied.astype(jnp.int32)
+    return new_state, applied_runs
+
+
+_append_entries_batch_rle = jax.vmap(_append_entries_one_rle, in_axes=(0, 1, 1, 1))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def append_run_slots_rle_sparse(
+    state: RleState, client, clock, run_len, slots
+) -> tuple[RleState, jax.Array]:
+    """Fast-path integrate for B all-sequential busy docs (RLE arena).
+
+    Same batch layout and padding contract as the unit arena's
+    kernels.append_run_slots_sparse: (K, B) coalesced runs + int32
+    (B,) slot routing (sentinel = num_docs)."""
+    from .kernels import gather_doc_rows, scatter_doc_rows
+
+    sub = gather_doc_rows(state, slots)
+    sub, counts = _append_entries_batch_rle(sub, client, clock, run_len)
+    state = scatter_doc_rows(state, sub, slots)
+    count, _ = jax.lax.optimization_barrier((jnp.sum(counts), state.total_units))
+    return state, count
+
+
+# -- on-device catch-up support (SyncStep2 serving) ---------------------------
+
+
+def _tail_probe_one_rle(state: RleState) -> tuple:
+    """(client, clock) id of the rank-tail UNIT of one document row —
+    the RLE twin of kernels._tail_probe_one (same host contract: an
+    empty doc reads as (0, 0), keyed on total_units == 0)."""
+    r = state.run_client.shape[0]
+    idx = jnp.arange(r, dtype=jnp.int32)
+    occupied = (idx < state.num_runs) & (state.run_len > 0)
+    tail = occupied & (state.run_rank + state.run_len == state.total_units) & (
+        state.total_units > 0
+    )
+    client = jnp.sum(jnp.where(tail, state.run_client, jnp.uint32(0)), dtype=jnp.uint32)
+    clock = jnp.sum(jnp.where(tail, state.run_clock + state.run_len - 1, 0))
+    return client, clock.astype(jnp.uint32)
+
+
+@jax.jit
+def tail_probe_rle(state: RleState, slots) -> jax.Array:
+    """(2B,) uint32 [clients..., clocks...] rank-tail ids for the B
+    requested rows (same contract as kernels.tail_probe)."""
+    from .kernels import gather_doc_rows
+
+    sub = gather_doc_rows(state, slots)
+    clients, clocks = jax.vmap(_tail_probe_one_rle)(sub)
+    return jnp.concatenate([clients, clocks])
+
+
+@partial(jax.jit, static_argnames=("width",))
+def catchup_pack_rle(state: RleState, slots, width: int) -> jax.Array:
+    """Device-side delete-set pack for B requested rows (RLE arena):
+    ONE (B + 3*B*width,) uint32 readback laid out [counts (B,),
+    clients flat, clocks flat, lens flat] of the tombstoned entries in
+    lane order — the host sorts/merges exactly as the full-row path
+    did, so emitted DeleteSet bytes are identical. Rows with more than
+    `width` tombstoned entries report the true count and fall back."""
+    from .kernels import gather_doc_rows
+
+    def one(row: RleState):
+        r = row.run_client.shape[0]
+        idx = jnp.arange(r, dtype=jnp.int32)
+        dead = (idx < row.num_runs) & row.run_deleted & (row.run_len > 0)
+        pos = jnp.cumsum(dead.astype(jnp.int32)) - 1
+        dst = jnp.where(dead, pos, width)  # width = drop sentinel
+        clients = (
+            jnp.zeros((width,), jnp.uint32).at[dst].set(row.run_client, mode="drop")
+        )
+        clocks = jnp.zeros((width,), jnp.int32).at[dst].set(row.run_clock, mode="drop")
+        lens = jnp.zeros((width,), jnp.int32).at[dst].set(row.run_len, mode="drop")
+        return (
+            jnp.sum(dead.astype(jnp.int32)),
+            clients,
+            clocks.astype(jnp.uint32),
+            lens.astype(jnp.uint32),
+        )
+
+    sub = gather_doc_rows(state, slots)
+    counts, clients, clocks, lens = jax.vmap(one)(sub)
+    return jnp.concatenate(
+        [
+            counts.astype(jnp.uint32),
+            clients.reshape(-1),
+            clocks.reshape(-1),
+            lens.reshape(-1),
+        ]
+    )
+
+
 # -- host-side extraction ----------------------------------------------------
 
 

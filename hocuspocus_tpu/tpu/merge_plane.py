@@ -126,6 +126,38 @@ class _FlushStaging:
         return k * b * per_field + (b * 4 if with_slots else 0)
 
 
+class _AppendStaging:
+    """Run-merge twin of _FlushStaging: the append fast path ships only
+    three (K, B) run fields (client, clock, run_len) plus the (B,)
+    routing vector — under half the dense op layout's bytes — and only
+    the run_len view needs resetting per batch (run_len == 0 IS the
+    noop sentinel; stale client/clock under a zero length are never
+    read by the kernel)."""
+
+    __slots__ = ("client", "clock", "run_len", "slots")
+
+    def __init__(self, k_max: int, num_docs: int) -> None:
+        self.client = np.zeros((k_max, num_docs), np.uint32)
+        self.clock = np.zeros((k_max, num_docs), np.int32)
+        self.run_len = np.zeros((k_max, num_docs), np.int32)
+        self.slots = np.zeros((num_docs,), np.int32)
+
+    def views(self, k: int, b: int) -> tuple:
+        views = (
+            self.client[:k, :b],
+            self.clock[:k, :b],
+            self.run_len[:k, :b],
+        )
+        views[2][...] = 0
+        return views
+
+    def slot_view(self, b: int) -> np.ndarray:
+        return self.slots[:b]
+
+    def nbytes(self, k: int, b: int) -> int:
+        return k * b * 12 + b * 4
+
+
 class MergePlane:
     """Device-resident arenas for up to `num_docs` sequences.
 
@@ -189,9 +221,11 @@ class MergePlane:
         self._sharded_step = None
         self._sharded_sparse_step = None
         self._sharded_compact_step = None
+        self._sharded_append_step = None
         self._op_shardings = None
         self._sparse_op_shardings = None
         self._slots_sharding = None
+        self._append_field_sharding = None
         if mesh is not None:
             from .sharding import (
                 make_sharded_rle_sparse_step,
@@ -217,19 +251,31 @@ class MergePlane:
                 make_sharded_rle_compact_step,
             )
 
+            from .sharding import (
+                make_sharded_append_step,
+                make_sharded_rle_append_step,
+            )
+
             if arena == "rle":
                 self.state = make_sharded_rle_state(mesh, num_docs, capacity)
                 self._sharded_step = make_sharded_rle_step(mesh)
                 self._sharded_sparse_step = make_sharded_rle_sparse_step(mesh)
                 self._sharded_compact_step = make_sharded_rle_compact_step(mesh)
+                self._sharded_append_step = make_sharded_rle_append_step(mesh)
             else:
                 self.state = make_sharded_state(mesh, num_docs, capacity)
                 self._sharded_step = make_sharded_step(mesh)
                 self._sharded_sparse_step = make_sharded_sparse_step(mesh)
                 self._sharded_compact_step = make_sharded_compact_step(mesh)
+                self._sharded_append_step = make_sharded_append_step(mesh)
             self._op_shardings = ops_sharding(mesh)
             self._sparse_op_shardings, self._slots_sharding = sparse_ops_sharding(
                 mesh
+            )
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._append_field_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None)
             )
         else:
             self.state = self._make_empty(num_docs, capacity)
@@ -265,6 +311,23 @@ class MergePlane:
         # over every slot instead of a Python loop over every doc.
         self.dispatched_units = np.zeros(num_docs, np.int64)
         self.validated_units = np.zeros(num_docs, np.int64)
+        # minimal-work run merge (the sequential fast path): the flush
+        # classifier routes a drained column to the O(new ops) append
+        # program only when every op chains off the column's RANK TAIL
+        # — the id of the last unit in rank order, tracked host-side so
+        # eligibility costs no device read. A tail is (client, clock)
+        # with client == NONE_CLIENT meaning "empty row"; _tail_known
+        # gates the whole check (False -> the column takes the full
+        # integrate, and the slot joins _tail_dirty so the next flush
+        # cycle's health readback re-arms it with one fused tail_probe
+        # over the dirty slots — never an O(D) sweep). Rows start, and
+        # are cleared back to, known-empty; full-integrate columns and
+        # residency compaction (rank remaps) invalidate.
+        self.run_merge_enabled = True
+        self._tail_client = np.full(num_docs, NONE_CLIENT, np.uint32)
+        self._tail_clock = np.zeros(num_docs, np.int64)
+        self._tail_known = np.ones(num_docs, bool)
+        self._tail_dirty: set[int] = set()
         # slots currently bound to a live (non-retired) doc: the post-
         # flush health sweep masks with this so freed/retired rows
         # compared against stale caches can't read as desyncs
@@ -323,6 +386,11 @@ class MergePlane:
             "sync_cache_hits": 0,
             "sync_cache_misses": 0,
             "sync_cache_evictions": 0,
+            # on-device catch-up encode: slots whose tombstone read
+            # shipped as the packed device readback vs the full-row
+            # host gather (pack-width overflow or pack disabled)
+            "sync_encode_device": 0,
+            "sync_encode_host": 0,
             "plane_broadcasts": 0,
             "cpu_fallbacks": 0,
             # flush-engine accounting: staging buffers are allocated
@@ -333,6 +401,13 @@ class MergePlane:
             "flush_staging_reuses": 0,
             "flush_batches_sparse": 0,
             "flush_batches_dense": 0,
+            # minimal-work run merge: ops dispatched through the
+            # append fast path vs the full-row integrate, plus the
+            # fast-path batch count (the sparse/dense counters above
+            # keep counting only full-integrate batches)
+            "flush_batches_fast": 0,
+            "flush_fast_ops": 0,
+            "flush_slow_ops": 0,
         }
         # last completed flush cycle's stage breakdown (exported as
         # gauges by observability/extension.py; reported by bench.py's
@@ -350,6 +425,11 @@ class MergePlane:
             "batch_b": 0,
             "batches": 0,
             "upload_bytes": 0,
+            # per-cycle fast/slow split (run-merge classifier): the
+            # fraction is this cycle's, the counters above accumulate
+            "fast_path_ops": 0,
+            "slow_path_ops": 0,
+            "fast_path_fraction": 0.0,
         }
         # residency manager seam (tpu/residency.py): set by the manager
         # at construction. retire_doc consults it to preserve host logs
@@ -379,6 +459,13 @@ class MergePlane:
         # then, so the block is ~always a no-op).
         self._staging: "Optional[list[_FlushStaging]]" = None
         self._staging_inflight: "list[Optional[tuple]]" = [None, None]
+        # fast-path twin of the staging pair: 3 run fields + routing,
+        # same double-buffer + inflight-retire discipline, alternated
+        # on its own batch counter (fast and slow batches interleave
+        # freely within a cycle)
+        self._append_staging: "Optional[list[_AppendStaging]]" = None
+        self._append_inflight: "list[Optional[tuple]]" = [None, None]
+        self._append_batches = 0
         # native text lane (enable_lane): the C++ host path for plain-
         # text docs. _lane_banned remembers docs that demoted (rich
         # content) so re-onboarding goes straight to the Python path.
@@ -465,6 +552,34 @@ class MergePlane:
 
         return compact_doc_rows_fast
 
+    def _append_step_fn(self):
+        """The run-append fast-path kernel: takes (state, (K, B) client,
+        clock, run_len, (B,) slot routing), returns (state, applied-run
+        count). Dispatched only for columns the flush classifier proved
+        all-sequential (see _classify_fast)."""
+        if self._sharded_append_step is not None:
+            return self._sharded_append_step
+        if self.arena == "rle":
+            from .pallas_kernels_rle import append_run_slots_rle_sparse_fast
+
+            return append_run_slots_rle_sparse_fast
+        from .pallas_kernels import append_run_slots_sparse_fast
+
+        return append_run_slots_sparse_fast
+
+    def _tail_probe_fn(self):
+        """The rank-tail id readback kernel for this arena: (state, (W,)
+        slots) -> (2W,) uint32 [clients..., clocks...]. Used by
+        _sync_health to re-arm tails the full-integrate path or a
+        compaction invalidated."""
+        if self.arena == "rle":
+            from .kernels_rle import tail_probe_rle
+
+            return tail_probe_rle
+        from .kernels import tail_probe
+
+        return tail_probe
+
     # -- native text lane --------------------------------------------------
 
     def enable_lane(self) -> bool:
@@ -507,6 +622,7 @@ class MergePlane:
         self.validated_units[slot] = 0
         self.slot_live[slot] = True
         self.slot_gen[slot] += 1
+        self._set_tail_empty(slot)
         self._lane_codec.lane_open(self._lane, slot)
         return doc
 
@@ -622,6 +738,7 @@ class MergePlane:
         self.validated_units[slot] = 0  # freed slots keep length 0 too
         self.slot_live[slot] = True
         self.slot_gen[slot] += 1
+        self._set_tail_empty(slot)
         return slot
 
     def note_trace(self, name: str) -> Optional[int]:
@@ -719,6 +836,8 @@ class MergePlane:
         # (c) unit_logs is REBOUND (not mutated): an in-flight serve
         #     holding the old list keeps a consistent snapshot.
         for slot in doc.seqs.values():
+            self._tail_known[slot] = False  # rows go inert: never fast-path
+            self._tail_dirty.discard(slot)
             if not preserve:
                 # preserve-mode keeps the QUEUES too: those ops are
                 # already in the serve/unit logs and the lowerer's known
@@ -737,6 +856,8 @@ class MergePlane:
             self._lane_codec.lane_clear_queue(self._lane, slot)
             self.slot_live[slot] = False
             self.slot_gen[slot] += 1
+            self._tail_known[slot] = False
+            self._tail_dirty.discard(slot)
 
     def _clear_slot(self, slot: int) -> None:
         self._clear_slots([slot])
@@ -783,7 +904,28 @@ class MergePlane:
                     for field, empty_field in zip(self.state, empty)
                 )
             )
+        for slot in slots:
+            self._set_tail_empty(slot)
         self.flush_epoch += 1
+
+    def _set_tail_empty(self, slot: int) -> None:
+        """Mark a slot's rank tail KNOWN-EMPTY (fresh/cleared row)."""
+        self._tail_client[slot] = NONE_CLIENT
+        self._tail_clock[slot] = 0
+        self._tail_known[slot] = True
+        self._tail_dirty.discard(slot)
+
+    def invalidate_tails(self, slots) -> None:
+        """Forget the tracked rank tails for `slots` (and queue them for
+        the probe re-arm at the next flush readback). Called by the
+        residency manager after a compaction — tombstone GC remaps
+        ranks, so the host-tracked tail id may no longer be the rank
+        tail."""
+        for slot in slots:
+            slot = int(slot)
+            self._tail_known[slot] = False
+            if self.slot_live[slot]:
+                self._tail_dirty.add(slot)
 
     def drop_doc_logs(self, name: str) -> None:
         """Finish a log-preserving retire (see retire_doc): the
@@ -1011,7 +1153,11 @@ class MergePlane:
         share. Returns True when any program was actually dispatched.
         """
         full_grid = shape is None
-        shapes = [shape] if shape is not None else self.warmup_shapes()
+        shapes = (
+            [shape]
+            if shape is not None
+            else self.warmup_shapes() + self.warmup_aux_shapes()
+        )
         shapes = [
             entry if isinstance(entry, tuple) else (entry, self.num_docs)
             for entry in shapes
@@ -1027,28 +1173,40 @@ class MergePlane:
                 shapes,
                 device=self._warm_device_key(),
             )
-            for k, b in covered:
-                if b >= self.num_docs:
-                    self.compile_watch.mark_covered(
-                        "integrate_dense", (k, self.num_docs)
-                    )
-                else:
-                    self.compile_watch.mark_covered("integrate_sparse", (k, b))
+            for entry in covered:
+                site, shape_key = self._warm_site(entry)
+                self.compile_watch.mark_covered(site, shape_key)
         dispatched = False
         with self._step_lock:
-            for k, b in shapes:
-                if b >= self.num_docs:
+            for entry in shapes:
+                site, shape_key = self._warm_site(entry)
+                if site == "append_sparse":
+                    _, k, b = entry
+                    args = self._empty_append_batch(k, b)
+                    with self.compile_watch.track(site, shape_key, warmup=True):
+                        self.state, count = self._append_step_fn()(
+                            self.state, *args
+                        )
+                        int(count)  # completion barrier (data-dependent)
+                elif site == "tail_probe":
+                    _, w = entry
+                    probe = np.zeros((w,), np.int32)  # re-reads row 0
+                    with self.compile_watch.track(site, shape_key, warmup=True):
+                        np.asarray(
+                            self._tail_probe_fn()(
+                                self.state, self._upload_slots(probe)
+                            )
+                        )
+                elif site == "integrate_dense":
+                    k, b = entry
                     ops = self._empty_batch(k)
-                    with self.compile_watch.track(
-                        "integrate_dense", (k, self.num_docs), warmup=True
-                    ):
+                    with self.compile_watch.track(site, shape_key, warmup=True):
                         self.state, count = self._step_fn()(self.state, ops)
                         int(count)  # completion barrier (data-dependent)
                 else:
+                    k, b = entry
                     ops, slots = self._empty_sparse_batch(k, b)
-                    with self.compile_watch.track(
-                        "integrate_sparse", (k, b), warmup=True
-                    ):
+                    with self.compile_watch.track(site, shape_key, warmup=True):
                         self.state, count = self._sparse_step_fn()(
                             self.state, ops, slots
                         )
@@ -1060,7 +1218,7 @@ class MergePlane:
                         self.arena,
                         self.num_docs,
                         self.capacity,
-                        (k, b),
+                        entry,
                         device=self._warm_device_key(),
                     )
         if full_grid:
@@ -1141,6 +1299,44 @@ class MergePlane:
             (k, self.num_docs) for k in self._k_buckets()
         ]
 
+    def warmup_aux_shapes(self) -> "list[tuple]":
+        """Tagged warm-grid entries beyond the integrate (k, b) pairs:
+        the run-append fast path's ("append", K_max, B) ladder (same
+        pinned-K discipline as the sparse integrate, plus the
+        num_docs-wide routing the dense regime takes) and the
+        ("tail", W) probe widths _sync_health can dispatch. Kept out
+        of warmup_shapes() so its (k, b)-pair contract — relied on by
+        the supervisor grid checks — survives."""
+        k_max = self._k_buckets()[-1]
+        shapes: "list[tuple]" = [
+            ("append", k_max, b) for b in self._b_buckets() + [self.num_docs]
+        ]
+        widths = [16] if self.num_docs <= 16 else [16, self._TAIL_PROBE_MAX]
+        shapes += [("tail", w) for w in widths]
+        return shapes
+
+    def _warm_site(self, entry: tuple) -> "tuple[str, tuple]":
+        """(compile-watch site, shape key) for one warm-grid entry —
+        plain (k, b) integrate pairs or tagged aux entries."""
+        if entry[0] == "append":
+            return "append_sparse", (entry[1], entry[2])
+        if entry[0] == "tail":
+            return "tail_probe", (entry[1],)
+        k, b = entry
+        if b >= self.num_docs:
+            return "integrate_dense", (k, self.num_docs)
+        return "integrate_sparse", (k, b)
+
+    def _empty_append_batch(self, k: int, b: int) -> tuple:
+        """All-noop append fast-path args (run_len == 0 everywhere,
+        every routing entry the drop sentinel): applies nothing,
+        compiles the exact program of a real (k, b) fast batch."""
+        client = np.zeros((k, b), np.uint32)
+        clock = np.zeros((k, b), np.int32)
+        run_len = np.zeros((k, b), np.int32)
+        slots = np.full((b,), self.num_docs, np.int32)
+        return self._upload_append_batch((client, clock, run_len), slots)
+
     def _bucket_b(self, busy: int) -> int:
         """Round a busy width up to its sparse bucket; num_docs (the
         dense layout) when it exceeds the top sparse bucket."""
@@ -1194,6 +1390,8 @@ class MergePlane:
         k_max = self._k_buckets()[-1]
         total = 0
         batches = 0
+        device_batches = 0
+        fast_total = slow_total = 0
         build_ms = upload_ms = dispatch_ms = 0.0
         upload_bytes = 0
         k_last = b_last = busy_last = 0
@@ -1209,81 +1407,160 @@ class MergePlane:
                 cycle_traces = book.take_drained(
                     (self.slot_owner.get(int(s)) for s in drained[4]), t0
                 )
-            built, depth = drained[5], drained[6]
-            # sparse batches pin K to the top bucket (one compiled
-            # program per B bucket — see warmup_shapes); dense batches
-            # keep the power-of-two K ladder, where the op axis
-            # multiplies a full-population sweep
-            dense, b_bucket = self._plan_batch(int(drained[4].size))
-            if dense:
-                k = 1
-                while k < depth:
-                    k *= 2
-            else:
-                k = k_max
-            staging = self._staging_for(batches, k)
-            fields, slot_view, b, b_actual = self._assemble_batch(
-                k, drained, staging, dense, b_bucket
-            )
-            t1 = time.perf_counter()
-            if slot_view is None:
-                step_args = (self._upload_batch(fields),)
-                step = self._step_fn()
-                self.counters["flush_batches_dense"] += 1
-            else:
-                ops, slots_dev = self._upload_sparse_batch(fields, slot_view)
-                step_args = (ops, slots_dev)
-                step = self._sparse_step_fn()
-                self.counters["flush_batches_sparse"] += 1
-            # remember what this staging buffer fed the device:
-            # _staging_for blocks on it before the buffer's next reuse
-            # (two batches from now), so an async transfer can never
-            # still be reading views a later batch resets
-            self._staging_inflight[batches % 2] = step_args
-            t2 = time.perf_counter()
-            # `built` is the host-side op count — identical to the
-            # device's kind!=NOOP sum by construction, so the flush
-            # needs no per-batch count readback (a full RTT each on
-            # remote-attached TPUs); _sync_health below is the cycle's
-            # single completion barrier (content readback — buffer
-            # *readiness* of aliased Pallas outputs is not trustworthy,
-            # see bench.py sync()). The dispatch itself is ASYNC: while
-            # the device integrates batch i, the next loop iteration
-            # builds and uploads batch i+1 from the OTHER staging
-            # buffer — that alternation is the double-buffered pipeline.
-            if tracer.enabled:
-                with tracer.device_span(
-                    "merge_plane.integrate", slots=k, busy=b
-                ) as span:
+            built = drained[5]
+            busy_total = int(drained[4].size)
+            # minimal-work run merge: split the drained columns into
+            # all-sequential (fast) and genuinely-concurrent (slow)
+            # sets. A column is entirely one or the other per batch —
+            # the two dispatches below touch disjoint rows, so their
+            # relative order is immaterial.
+            fast = None
+            slow = drained
+            if self.run_merge_enabled:
+                fast, slow = self._classify_fast(drained)
+            if fast is not None:
+                (
+                    run_row, run_col, f_client, f_clock, f_run,
+                    f_slots, f_ops, f_tail_cl, f_tail_ck,
+                ) = fast
+                nf = int(f_slots.size)
+                bf = self._bucket_b(nf)
+                staging_f = self._append_staging_for(self._append_batches, k_max)
+                cl_v, ck_v, rn_v = staging_f.views(k_max, bf)
+                cl_v[run_row, run_col] = f_client
+                ck_v[run_row, run_col] = f_clock
+                rn_v[run_row, run_col] = f_run
+                slot_view_f = staging_f.slot_view(bf)
+                slot_view_f[:nf] = f_slots
+                slot_view_f[nf:] = self.num_docs
+                t1 = time.perf_counter()
+                args_f = self._upload_append_batch(
+                    (cl_v, ck_v, rn_v), slot_view_f
+                )
+                self._append_inflight[self._append_batches % 2] = args_f
+                self._append_batches += 1
+                t2 = time.perf_counter()
+                step_f = self._append_step_fn()
+                if tracer.enabled:
+                    with tracer.device_span(
+                        "merge_plane.append", slots=k_max, busy=bf
+                    ) as span:
+                        self.state, _count = step_f(self.state, *args_f)
+                        span.set("integrated", f_ops)
+                else:
+                    self.state, _count = step_f(self.state, *args_f)
+                t_dispatch = time.perf_counter()
+                self.compile_watch.observe(
+                    "append_sparse", (k_max, bf), t_dispatch - t2
+                )
+                # the dispatched runs land at the rank tail, so the new
+                # tail is each column's last coalesced run — tracked
+                # here with no device read; the slot stays fast-eligible
+                self._tail_client[f_slots] = f_tail_cl
+                self._tail_clock[f_slots] = f_tail_ck
+                self.counters["flush_batches_fast"] += 1
+                self.counters["flush_fast_ops"] += f_ops
+                fast_total += f_ops
+                device_batches += 1
+                build_ms += (t1 - t0) * 1000.0
+                upload_ms += (t2 - t1) * 1000.0
+                dispatch_ms += (t_dispatch - t2) * 1000.0
+                upload_bytes += staging_f.nbytes(k_max, bf)
+                k_last, b_last = k_max, bf
+                if cycle_traces and slow is None:
+                    trace_batches.append((cycle_traces, t1, t2, t_dispatch))
+                t0 = t_dispatch  # the slow build, if any, starts here
+            if slow is not None:
+                depth = slow[6]
+                # sparse batches pin K to the top bucket (one compiled
+                # program per B bucket — see warmup_shapes); dense
+                # batches keep the power-of-two K ladder, where the op
+                # axis multiplies a full-population sweep
+                dense, b_bucket = self._plan_batch(int(slow[4].size))
+                if dense:
+                    k = 1
+                    while k < depth:
+                        k *= 2
+                else:
+                    k = k_max
+                staging = self._staging_for(batches, k)
+                fields, slot_view, b, b_actual = self._assemble_batch(
+                    k, slow, staging, dense, b_bucket
+                )
+                t1 = time.perf_counter()
+                if slot_view is None:
+                    step_args = (self._upload_batch(fields),)
+                    step = self._step_fn()
+                    self.counters["flush_batches_dense"] += 1
+                else:
+                    ops, slots_dev = self._upload_sparse_batch(fields, slot_view)
+                    step_args = (ops, slots_dev)
+                    step = self._sparse_step_fn()
+                    self.counters["flush_batches_sparse"] += 1
+                # remember what this staging buffer fed the device:
+                # _staging_for blocks on it before the buffer's next
+                # reuse (two batches from now), so an async transfer can
+                # never still be reading views a later batch resets
+                self._staging_inflight[batches % 2] = step_args
+                t2 = time.perf_counter()
+                # `built` is the host-side op count — identical to the
+                # device's kind!=NOOP sum by construction, so the flush
+                # needs no per-batch count readback (a full RTT each on
+                # remote-attached TPUs); _sync_health below is the
+                # cycle's single completion barrier (content readback —
+                # buffer *readiness* of aliased Pallas outputs is not
+                # trustworthy, see bench.py sync()). The dispatch itself
+                # is ASYNC: while the device integrates batch i, the
+                # next loop iteration builds and uploads batch i+1 from
+                # the OTHER staging buffer — that alternation is the
+                # double-buffered pipeline.
+                if tracer.enabled:
+                    with tracer.device_span(
+                        "merge_plane.integrate", slots=k, busy=b
+                    ) as span:
+                        self.state, _count = step(self.state, *step_args)
+                        span.set("integrated", slow[5])
+                else:
                     self.state, _count = step(self.state, *step_args)
-                    span.set("integrated", built)
-            else:
-                self.state, _count = step(self.state, *step_args)
-            t_dispatch = time.perf_counter()
-            # compile-event classification from the timestamps already
-            # taken: a first dispatch at this (site, shape) paid its
-            # XLA/Mosaic compile inline in t_dispatch - t2
-            if slot_view is None:
-                self.compile_watch.observe(
-                    "integrate_dense", (k, self.num_docs), t_dispatch - t2
-                )
-            else:
-                self.compile_watch.observe(
-                    "integrate_sparse", (k, b), t_dispatch - t2
-                )
-            if cycle_traces:
-                trace_batches.append((cycle_traces, t1, t2, t_dispatch))
+                t_dispatch = time.perf_counter()
+                # compile-event classification from the timestamps
+                # already taken: a first dispatch at this (site, shape)
+                # paid its XLA/Mosaic compile inline in t_dispatch - t2
+                if slot_view is None:
+                    self.compile_watch.observe(
+                        "integrate_dense", (k, self.num_docs), t_dispatch - t2
+                    )
+                else:
+                    self.compile_watch.observe(
+                        "integrate_sparse", (k, b), t_dispatch - t2
+                    )
+                # full-integrate columns invalidate their tracked rank
+                # tails (a concurrent insert/delete may have moved the
+                # tail); _sync_health re-arms the live ones below
+                slow_cols = slow[4].astype(np.intp)
+                self._tail_known[slow_cols] = False
+                for col in slow_cols:
+                    col = int(col)
+                    if self.slot_live[col]:
+                        self._tail_dirty.add(col)
+                self.counters["flush_slow_ops"] += slow[5]
+                slow_total += slow[5]
+                device_batches += 1
+                if cycle_traces:
+                    trace_batches.append((cycle_traces, t1, t2, t_dispatch))
+                build_ms += (t1 - t0) * 1000.0
+                upload_ms += (t2 - t1) * 1000.0
+                # ~0 where dispatch is truly asynchronous; on
+                # synchronous backends this is the device compute the
+                # cycle pays inline
+                dispatch_ms += (t_dispatch - t2) * 1000.0
+                upload_bytes += staging.nbytes(k, b, slot_view is not None)
+                k_last, b_last = k, b
             total += built
+            busy_last = busy_total
             batches += 1
-            build_ms += (t1 - t0) * 1000.0
-            upload_ms += (t2 - t1) * 1000.0
-            # ~0 where dispatch is truly asynchronous; on synchronous
-            # backends this is the device compute the cycle pays inline
-            dispatch_ms += (t_dispatch - t2) * 1000.0
-            upload_bytes += staging.nbytes(k, b, slot_view is not None)
-            k_last, b_last, busy_last = k, b, b_actual
         if batches:
-            self._note_dispatch("flush", batches)
+            self._note_dispatch("flush", device_batches)
             t3 = time.perf_counter()
             self._sync_health()
             t_sync = time.perf_counter()
@@ -1309,6 +1586,9 @@ class MergePlane:
                 batch_b=b_last,
                 batches=batches,
                 upload_bytes=upload_bytes,
+                fast_path_ops=fast_total,
+                slow_path_ops=slow_total,
+                fast_path_fraction=round(fast_total / max(total, 1), 6),
             )
         self.total_integrated += total
         return total
@@ -1328,6 +1608,13 @@ class MergePlane:
         staging_bytes = 0
         for staging in self._staging or ():
             staging_bytes += pytree_nbytes(staging.fields) + staging.slots.nbytes
+        for staging in self._append_staging or ():
+            staging_bytes += (
+                staging.client.nbytes
+                + staging.clock.nbytes
+                + staging.run_len.nbytes
+                + staging.slots.nbytes
+            )
         stats = {
             "arena_bytes": pytree_nbytes(self.state),
             "staging_bytes": staging_bytes,
@@ -1351,19 +1638,68 @@ class MergePlane:
         compare device rows against exactly the ops the device has
         integrated, never against optimistically-ahead host logs. A
         launch failure surfaces here and propagates to the caller
-        (flush -> extension degrade path)."""
+        (flush -> extension degrade path).
+
+        When full-integrate columns (or a compaction) invalidated
+        tracked rank tails, the dirty LIVE slots' tail ids ride the
+        same fused readback via the tail_probe kernel — one transfer,
+        never a second RTT — and re-arm the run-merge classifier for
+        the next cycle. At most _TAIL_PROBE_MAX slots re-arm per cycle
+        (two compiled probe widths, never an unbounded shape ladder);
+        the remainder stay dirty for the next readback."""
         import jax.numpy as jnp
 
-        combined = np.asarray(
-            jnp.concatenate(
-                [self.state.length, self.state.overflow.astype(jnp.int32)]
+        probe_slots = None
+        probe_width = 0
+        if self._tail_dirty and self.run_merge_enabled:
+            live = sorted(
+                slot for slot in self._tail_dirty if self.slot_live[slot]
             )
+            self._tail_dirty.clear()
+            if len(live) > self._TAIL_PROBE_MAX:
+                self._tail_dirty.update(live[self._TAIL_PROBE_MAX :])
+                live = live[: self._TAIL_PROBE_MAX]
+            if live:
+                probe_slots = np.asarray(live, np.intp)
+                probe_width = (
+                    16 if len(live) <= 16 else self._TAIL_PROBE_MAX
+                )
+        parts = [
+            self.state.length.astype(jnp.uint32),
+            self.state.overflow.astype(jnp.uint32),
+        ]
+        if probe_slots is not None:
+            padded = np.zeros(probe_width, np.int32)
+            padded[: probe_slots.size] = probe_slots  # pad: re-read slot 0
+            with self.compile_watch.track("tail_probe", (probe_width,)):
+                parts.append(
+                    self._tail_probe_fn()(self.state, self._upload_slots(padded))
+                )
+            self._note_dispatch("tail_probe")
+        combined = np.asarray(jnp.concatenate(parts))
+        lengths = combined[: self.num_docs].astype(np.int64)
+        self.last_lengths = lengths
+        self.last_overflows = combined[self.num_docs : 2 * self.num_docs].astype(
+            bool
         )
-        self.last_lengths = combined[: self.num_docs]
-        self.last_overflows = combined[self.num_docs :].astype(bool)
+        if probe_slots is not None:
+            probe = combined[2 * self.num_docs :]
+            n = probe_slots.size
+            clients = probe[:n].astype(np.uint32)
+            clocks = probe[probe_width : probe_width + n].astype(np.int64)
+            empty = lengths[probe_slots] == 0
+            self._tail_client[probe_slots] = np.where(
+                empty, np.uint32(NONE_CLIENT), clients
+            )
+            self._tail_clock[probe_slots] = np.where(empty, 0, clocks)
+            self._tail_known[probe_slots] = True
         self.validated_units = self.dispatched_units.copy()
         self.last_gen = self.slot_gen.copy()
         self.flush_epoch += 1
+
+    # per-cycle cap on tail re-arms: bounds both the probe's device
+    # work and the compiled width ladder to {16, _TAIL_PROBE_MAX}
+    _TAIL_PROBE_MAX = 256
 
     def _drain_ops(self, k: int):
         """Pop up to k ops from every BUSY queue (Python + native lane)
@@ -1444,6 +1780,208 @@ class MergePlane:
             cols = py_cols
         return rows, slots, vals, lane, cols, built, depth
 
+    def _classify_fast(self, drained):
+        """The run-merge concurrency classifier: split one drained cycle
+        into fast COLUMNS (every op a chained tail append — integrable
+        by the near-O(new ops) append program) and slow columns (the
+        full-row integrate). Returns (fast_pack | None, slow | None)
+        where `slow` has the same shape as a _drain_ops result (lane
+        ops already folded into the flat arrays, lane=None).
+
+        An op is a pure tail append iff it is an INSERT with no right
+        origin whose left origin is the column's current rank tail —
+        the Yjs end-append shape. For such ops the YATA conflict window
+        is empty, so the append program is bit-identical to the scan
+        integrate (tpu/kernels.py, "minimal-work run merge"). Chains
+        verify inductively: op m's left must be op m-1's last unit.
+        All checks are vectorized numpy over the drained cycle — the
+        classifier costs O(drained ops), no Python per-op loop, no
+        device read (tails are host-tracked, see _tail_known)."""
+        rows, slots, vals, lane, cols, built, depth = drained
+        n_py = len(rows)
+        if lane is None and n_py == 0:
+            return None, drained
+        parts_row: list = []
+        parts_slot: list = []
+        parts_f: "list[list]" = [[] for _ in range(8)]
+        if n_py:
+            parts_row.append(np.asarray(rows, np.int64))
+            parts_slot.append(np.asarray(slots, np.int64))
+            for i in range(8):
+                dtype = np.uint32 if i in (1, 4, 6) else np.int64
+                parts_f[i].append(np.asarray(vals[i], dtype))
+        if lane is not None:
+            parts_row.append(np.frombuffer(lane[1], np.int64))
+            parts_slot.append(np.frombuffer(lane[2], np.int64))
+            for i, buf in enumerate(lane[3:11]):
+                if i in (1, 4, 6):
+                    parts_f[i].append(np.frombuffer(buf, np.uint32))
+                else:
+                    parts_f[i].append(
+                        np.frombuffer(buf, np.int32).astype(np.int64)
+                    )
+        if len(parts_row) == 1:
+            op_row, op_slot = parts_row[0], parts_slot[0]
+            fields = [p[0] for p in parts_f]
+        else:
+            op_row = np.concatenate(parts_row)
+            op_slot = np.concatenate(parts_slot)
+            fields = [np.concatenate(p) for p in parts_f]
+        n = op_slot.size
+        # column-major order: a slot's ops are contiguous, row-ordered
+        # (a slot drains from exactly one source — Python queue or lane
+        # — so concatenation never interleaves within a column)
+        order = np.lexsort((op_row, op_slot))
+        s = op_slot[order]
+        row_s = op_row[order]
+        kind_s = fields[0][order]
+        cl_s = fields[1][order]
+        ck_s = fields[2][order]
+        rn_s = fields[3][order]
+        lc_s = fields[4][order]
+        lk_s = fields[5][order]
+        rc_s = fields[6][order]
+        rk_s = fields[7][order]
+        first = np.ones(n, bool)
+        first[1:] = s[1:] != s[:-1]
+        sp = s.astype(np.intp)
+        head_ok = np.where(
+            lc_s == NONE_CLIENT,
+            # an origin-less insert appends only to an EMPTY row
+            self._tail_client[sp] == np.uint32(NONE_CLIENT),
+            (lc_s == self._tail_client[sp])
+            & (lk_s == self._tail_clock[sp]),
+        )
+        prev_cl = np.empty(n, np.uint32)
+        prev_end = np.empty(n, np.int64)
+        prev_cl[0] = 0
+        prev_end[0] = 0
+        prev_cl[1:] = cl_s[:-1]
+        prev_end[1:] = ck_s[:-1] + rn_s[:-1] - 1
+        ok = (
+            (kind_s == KIND_INSERT)
+            & (rc_s == NONE_CLIENT)
+            & self._tail_known[sp]
+            & np.where(first, head_ok, (lc_s == prev_cl) & (lk_s == prev_end))
+        )
+        col_starts = np.flatnonzero(first)
+        col_ok = np.logical_and.reduceat(ok, col_starts)
+        if not col_ok.any():
+            return None, drained
+        counts = np.diff(np.append(col_starts, n))
+        member = np.repeat(col_ok, counts)
+        # coalesce the fast subset: consecutive same-client runs with
+        # clock continuity merge into ONE device run (a typing burst of
+        # K ops ships as a single (client, clock, len) triple)
+        fs = s[member]
+        fcl = cl_s[member]
+        fck = ck_s[member]
+        frn = rn_s[member]
+        m = int(fs.size)
+        newrun = np.ones(m, bool)
+        newrun[1:] = (
+            (fs[1:] != fs[:-1])
+            | (fcl[1:] != fcl[:-1])
+            | (fck[1:] != fck[:-1] + frn[:-1])
+        )
+        run_starts = np.flatnonzero(newrun)
+        run_slot = fs[run_starts]
+        run_client = fcl[run_starts]
+        run_clock = fck[run_starts]
+        run_len = np.add.reduceat(frn, run_starts)
+        run_first = np.ones(run_slot.size, bool)
+        run_first[1:] = run_slot[1:] != run_slot[:-1]
+        col_of_run = np.cumsum(run_first) - 1
+        first_run = np.flatnonzero(run_first)
+        run_row = np.arange(run_slot.size) - first_run[col_of_run]
+        last_run = np.append(first_run[1:] - 1, run_slot.size - 1)
+        fast = (
+            run_row.astype(np.intp),
+            col_of_run.astype(np.intp),
+            run_client,
+            run_clock.astype(np.int64),
+            run_len.astype(np.int64),
+            run_slot[run_first].astype(np.int64),
+            m,
+            run_client[last_run],
+            (run_clock[last_run] + run_len[last_run] - 1).astype(np.int64),
+        )
+        if member.all():
+            return fast, None
+        keep = ~member
+        slow = (
+            row_s[keep],
+            s[keep],
+            (
+                kind_s[keep], cl_s[keep], ck_s[keep], rn_s[keep],
+                lc_s[keep], lk_s[keep], rc_s[keep], rk_s[keep],
+            ),
+            None,
+            s[col_starts][~col_ok],
+            int(n - m),
+            int(row_s[keep].max()) + 1,
+        )
+        return fast, slow
+
+    def _append_staging_for(self, batch_index: int, k: int) -> _AppendStaging:
+        """The append fast path's staging buffer for this batch — same
+        double-buffer + retire-before-reuse discipline as _staging_for."""
+        if (
+            self._append_staging is None
+            or self._append_staging[0].client.shape[0] < k
+        ):
+            k_max = max(self._k_buckets()[-1], k)
+            self._append_staging = [
+                _AppendStaging(k_max, self.num_docs) for _ in range(2)
+            ]
+            self._append_inflight = [None, None]
+            self.counters["flush_staging_allocs"] += 2
+        else:
+            self.counters["flush_staging_reuses"] += 1
+        index = batch_index % 2
+        inflight = self._append_inflight[index]
+        if inflight is not None:
+            import jax
+
+            jax.block_until_ready(inflight)
+            self._append_inflight[index] = None
+        return self._append_staging[index]
+
+    def _upload_append_batch(self, fields: tuple, slots: np.ndarray) -> tuple:
+        """Upload the three (K, B) run fields + (B,) routing — the
+        append twin of _upload_sparse_batch (same placement rules)."""
+        if self._append_field_sharding is not None:
+            import jax
+
+            return tuple(
+                jax.device_put(field, self._append_field_sharding)
+                for field in fields
+            ) + (jax.device_put(slots, self._slots_sharding),)
+        if self.device is not None:
+            import jax
+
+            return tuple(
+                jax.device_put(field, self.device) for field in fields
+            ) + (jax.device_put(slots, self.device),)
+        import jax.numpy as jnp
+
+        return tuple(jnp.asarray(field) for field in fields) + (
+            jnp.asarray(slots),
+        )
+
+    def _upload_slots(self, slots: np.ndarray):
+        """Upload a bare routing vector (tail probe) with the plane's
+        placement rules."""
+        import jax
+
+        if self._slots_sharding is not None:
+            return jax.device_put(slots, self._slots_sharding)
+        if self.device is not None:
+            return jax.device_put(slots, self.device)
+        import jax.numpy as jnp
+
+        return jnp.asarray(slots)
+
     def _staging_for(self, batch_index: int, k: int) -> _FlushStaging:
         """The staging buffer for this batch (alternating between the
         two preallocated sets), with its previous upload retired first:
@@ -1502,7 +2040,7 @@ class MergePlane:
             # the scatter drops the write — padding can never alias a
             # busy row (see kernels.integrate_op_slots_sparse)
             slot_view[b_actual:] = self.num_docs
-        if rows:
+        if len(rows):  # list (live drain) or ndarray (classifier remainder)
             ri = np.asarray(rows, np.intp)
             views[0][ri, col_idx] = vals[0]
             views[1][ri, col_idx] = np.asarray(vals[1], np.uint32)
@@ -2062,7 +2600,9 @@ class TpuMergeExtension(Extension):
             # one lock acquisition per shape: early client syncs and
             # unloads interleave between compiles instead of stalling
             # for the whole warmup
-            for shape in self.plane.warmup_shapes():
+            for shape in (
+                self.plane.warmup_shapes() + self.plane.warmup_aux_shapes()
+            ):
                 ticket = None
                 if self.lane is not None:
                     try:
@@ -2091,24 +2631,31 @@ class TpuMergeExtension(Extension):
             # compile is the recompile-storm signal
             self.plane.compile_watch.mark_warmed()
             if self.serving is not None:
-                ticket = None
-                if self.lane is not None:
+                # one lock acquisition per gather width (mirrors the
+                # shape loop above): a lane-demote rebuild or an early
+                # sync serve slots in between compiles
+                for width in self.serving._gather_widths():
+                    ticket = None
+                    if self.lane is not None:
+                        try:
+                            ticket = await self.lane.admit(
+                                CLASS_CANARY, site="warmup", weight=1
+                            )
+                        except LaneDeferred:
+                            return
                     try:
-                        ticket = await self.lane.admit(
-                            CLASS_CANARY, site="warmup", weight=1
-                        )
-                    except LaneDeferred:
-                        return
-                try:
-                    async with self.plane.flush_lock:
-                        await loop.run_in_executor(None, self.serving.warmup_gathers)
-                except Exception:
-                    from ..server import logger as _logger_mod
+                        async with self.plane.flush_lock:
+                            await loop.run_in_executor(
+                                None,
+                                lambda w=width: self.serving.warmup_gathers(w),
+                            )
+                    except Exception:
+                        from ..server import logger as _logger_mod
 
-                    _logger_mod.log_error("gather warmup failed (continuing)")
-                finally:
-                    if ticket is not None:
-                        ticket.release()
+                        _logger_mod.log_error("gather warmup failed (continuing)")
+                    finally:
+                        if ticket is not None:
+                            ticket.release()
 
         self._spawn_tracked(warm())
         self._schedule_residency()

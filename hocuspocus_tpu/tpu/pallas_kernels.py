@@ -381,6 +381,27 @@ def integrate_op_slots_sparse_fast(
     return integrate_op_slots_sparse(state, ops, slots)
 
 
+# -- minimal-work run merge (sequential fast path) -----------------------------
+
+
+def append_run_slots_sparse_fast(
+    state: DocState, client, clock, run_len, slots
+) -> tuple[DocState, jax.Array]:
+    """Backend dispatcher for the run-append fast path.
+
+    The integrate scan needs Mosaic because every op slot re-reads the
+    whole (B, N) sub-arena from HBM — K passes of conflict scanning.
+    The append program has no conflict scan at all: one fit pass over a
+    (K,) carry and one fused masked fill of each gathered row, so the
+    XLA lowering is already a single read + write of the touched rows
+    on every backend. This wrapper keeps the plane's call seam uniform
+    with the integrate/compact dispatchers so a future VMEM-resident
+    variant slots in without touching the plane."""
+    from .kernels import append_run_slots_sparse
+
+    return append_run_slots_sparse(state, client, clock, run_len, slots)
+
+
 # -- on-device compaction ------------------------------------------------------
 
 

@@ -419,6 +419,20 @@ def integrate_op_slots_rle_sparse_fast(state: RleState, ops: OpBatch, slots):
     return integrate_op_slots_rle_sparse(state, ops, slots)
 
 
+# -- minimal-work run merge (sequential fast path) -----------------------------
+
+
+def append_run_slots_rle_sparse_fast(state: RleState, client, clock, run_len, slots):
+    """Backend dispatcher for the RLE run-append fast path — like the
+    compact step, the program is one fit scan over a (K,) carry plus a
+    fused masked entry write per gathered row, with no K-pass HBM
+    amplification for a Mosaic kernel to kill (see
+    pallas_kernels.append_run_slots_sparse_fast)."""
+    from .kernels_rle import append_run_slots_rle_sparse
+
+    return append_run_slots_rle_sparse(state, client, clock, run_len, slots)
+
+
 # -- on-device compaction ------------------------------------------------------
 
 

@@ -446,6 +446,22 @@ class ResidencyManager:
         self.touch(name)
         return self._snapshot(name, document)
 
+    def replica_catchup(
+        self, name: str, document, sv_bytes: Optional[bytes]
+    ) -> Optional[bytes]:
+        """Hot-doc replication, warm-follower side: the SV-diff for a
+        follower resyncing after a gap, served from the plane (device
+        tombstone pack + serve-log window) exactly like a stale
+        reconnect's SyncStep2. Returns None when the plane can't serve
+        (caller falls back to the CPU diff)."""
+        if self.serving is None:
+            return None
+        self.touch(name)
+        try:
+            return self.serving.encode_state_as_update(name, document, sv_bytes)
+        except Exception:
+            return None
+
     def _snapshot(self, name: str, document) -> Optional[bytes]:
         """Encoded full state for the eviction record. The plane's own
         serving path first (healthy + covers the CPU doc, so the bytes
@@ -897,6 +913,10 @@ class ResidencyManager:
             plane.state, jnp.asarray(routed, jnp.int32)
         )
         plane._note_dispatch("compact")
+        # tombstone GC remapped ranks: the host-tracked rank tails for
+        # these rows are stale — the run-merge classifier must not
+        # fast-path against them until the next flush readback re-arms
+        plane.invalidate_tails(slots)
         return np.asarray(sizes)[: len(slots)]
 
     def _writable_health_caches(self) -> None:

@@ -184,6 +184,11 @@ class PlaneServing:
         # sync serve resolves to None (CPU fallback) WITHOUT touching
         # the device — a wedged runtime must never stall a document
         self.paused = False
+        # on-device catch-up encode: tombstone reads ship as packed
+        # (counts + tombstones) readbacks instead of full arena rows;
+        # rows whose tombstone count overflows the pack width fall back
+        # to the full-row gather per chunk (see _fetch_slot_rows)
+        self.device_pack_enabled = True
         # unresolved batched-sync futures, so abort_pending can resolve
         # waiters stranded behind a wedged flush
         self._inflight: set = set()
@@ -481,7 +486,110 @@ class PlaneServing:
             )
         )
 
+    @staticmethod
+    def _merge_ranges(
+        raw: "list[tuple[int, int, int]]",
+    ) -> "list[tuple[int, int, int]]":
+        """Merge sorted id-adjacent (client, clock, length) ranges once
+        at fetch time so every serve consumes ready ranges."""
+        ranges: list[tuple[int, int, int]] = []
+        for c, k, l in raw:
+            if ranges and ranges[-1][0] == c and ranges[-1][1] + ranges[-1][2] == k:
+                ranges[-1] = (c, ranges[-1][1], ranges[-1][2] + l)
+            else:
+                ranges.append((c, k, l))
+        return ranges
+
+    def _pack_width(self) -> int:
+        """Tombstone-pack lane width: narrow enough that the packed
+        readback (B + 2·B·W or B + 3·B·W uint32) stays far below the
+        full-row read, wide enough for the overwhelming majority of
+        rows. One static value = one compiled pack program per gather
+        width."""
+        state = self.plane.state
+        dim = (
+            state.run_client.shape[1]
+            if self.plane.arena == "rle"
+            else state.id_client.shape[1]
+        )
+        return min(128, int(dim))
+
     def _fetch_slot_rows(self, chunk: "list[int]", epoch: int) -> None:
+        """Fill the tombstone cache for a slot chunk: the on-device
+        packed read first, a full-row host gather for any slot whose
+        tombstone count overflowed the pack width."""
+        if self.device_pack_enabled:
+            overflow = self._fetch_slot_rows_device(chunk, epoch)
+            if overflow:
+                self._fetch_slot_rows_host(overflow, epoch)
+            return
+        self._fetch_slot_rows_host(chunk, epoch)
+        self.plane.counters["sync_encode_host"] += len(chunk)
+
+    def _fetch_slot_rows_device(self, chunk: "list[int]", epoch: int) -> "list[int]":
+        """Packed tombstone fetch: the device gathers the chunk's rows,
+        masks live tombstones and prefix-sum-compacts them into a
+        (B + planes·B·W) uint32 readback — O(tombstones) on the wire
+        instead of O(arena width). Returns the slots whose tombstone
+        count exceeded the pack width (the host full-row path re-reads
+        exactly those). Tombstones arrive in arena order; the host
+        sorts and merges identically to the full-row path, so the
+        DeleteSet bytes emitted downstream are byte-identical."""
+        import jax.numpy as jnp
+
+        plane = self.plane
+        width = next(w for w in self._gather_widths() if w >= len(chunk))
+        pack_w = self._pack_width()
+        padded = chunk + [chunk[0]] * (width - len(chunk))
+        rle = plane.arena == "rle"
+        with plane._step_lock:  # never gather donated buffers mid-flush
+            t0 = time.perf_counter()
+            slots_dev = jnp.asarray(padded, jnp.int32)
+            shape_key = (width, pack_w)
+            with plane.compile_watch.track("catchup_pack", shape_key):
+                if rle:
+                    from .kernels_rle import catchup_pack_rle
+
+                    fused = np.asarray(
+                        catchup_pack_rle(plane.state, slots_dev, pack_w)
+                    )
+                else:
+                    from .kernels import catchup_pack
+
+                    fused = np.asarray(catchup_pack(plane.state, slots_dev, pack_w))
+            plane._note_dispatch("sync")
+            gens = [int(plane.slot_gen[slot]) for slot in chunk]
+            plane.device_stats["readback_stall_ms_total"] += (
+                time.perf_counter() - t0
+            ) * 1000.0
+            plane.device_stats["readback_stalls"] += 1
+        planes = 3 if rle else 2
+        counts = fused[:width]
+        body = fused[width:].reshape(planes, width, pack_w)
+        overflow: list[int] = []
+        for i, slot in enumerate(chunk):
+            count = int(counts[i])
+            if count > pack_w:
+                overflow.append(slot)
+                continue
+            clients = body[0, i, :count]
+            clocks = body[1, i, :count].astype(np.int64)
+            if rle:
+                lens = body[2, i, :count].astype(np.int64)
+                raw = sorted(zip(clients.tolist(), clocks.tolist(), lens.tolist()))
+            else:
+                raw = [
+                    (c, k, 1)
+                    for c, k in sorted(zip(clients.tolist(), clocks.tolist()))
+                ]
+            self._tombstone_cache[slot] = (
+                (gens[i], epoch),
+                self._merge_ranges(raw),
+            )
+        plane.counters["sync_encode_device"] += len(chunk) - len(overflow)
+        return overflow
+
+    def _fetch_slot_rows_host(self, chunk: "list[int]", epoch: int) -> None:
         plane = self.plane
         width = next(w for w in self._gather_widths() if w >= len(chunk))
         with plane._step_lock:  # never gather donated buffers mid-flush
@@ -511,23 +619,41 @@ class PlaneServing:
                 )
             else:
                 raw = [(c, k, 1) for c, k in sorted(zip(clients.tolist(), clocks.tolist()))]
-            # merge id-adjacent ranges once at fetch time so every serve
-            # consumes ready ranges
-            ranges: list[tuple[int, int, int]] = []
-            for c, k, l in raw:
-                if ranges and ranges[-1][0] == c and ranges[-1][1] + ranges[-1][2] == k:
-                    ranges[-1] = (c, ranges[-1][1], ranges[-1][2] + l)
-                else:
-                    ranges.append((c, k, l))
-            self._tombstone_cache[slot] = ((gens[i], epoch), ranges)
+            self._tombstone_cache[slot] = (
+                (gens[i], epoch),
+                self._merge_ranges(raw),
+            )
+        plane.counters["sync_encode_host"] += len(chunk)
 
-    def warmup_gathers(self) -> None:
-        """Compile the tombstone-gather programs (one per fixed width)
-        so the first reconnect storm pays data transfer, not XLA
-        compile time. Run from the extension's listen-time warm task."""
-        with self.plane._step_lock:
-            for width in self._gather_widths():
-                self._gather_rows([0] * width)
+    def warmup_gathers(self, width: Optional[int] = None) -> None:
+        """Compile the tombstone-gather AND catch-up pack programs (one
+        per fixed width) so the first reconnect storm pays data
+        transfer, not XLA compile time. Run from the extension's
+        listen-time warm task — which passes one `width` per call so
+        interactive work (sync serves, lane-demote rebuilds) interleaves
+        between compiles instead of waiting out the whole ladder."""
+        import jax.numpy as jnp
+
+        plane = self.plane
+        pack_w = self._pack_width()
+        widths = self._gather_widths() if width is None else [width]
+        with plane._step_lock:
+            for w in widths:
+                self._gather_rows([0] * w)
+                shape_key = (w, pack_w)
+                with plane.compile_watch.track(
+                    "catchup_pack", shape_key, warmup=True
+                ):
+                    slots_dev = jnp.asarray([0] * w, jnp.int32)
+                    if plane.arena == "rle":
+                        from .kernels_rle import catchup_pack_rle
+
+                        np.asarray(catchup_pack_rle(plane.state, slots_dev, pack_w))
+                    else:
+                        from .kernels import catchup_pack
+
+                        np.asarray(catchup_pack(plane.state, slots_dev, pack_w))
+                plane.compile_watch.mark_covered("catchup_pack", shape_key)
 
     def _device_delete_set(self, doc: PlaneDoc) -> DeleteSet:
         """Tombstones as the DEVICE sees them, across every row of the
@@ -672,6 +798,11 @@ class PlaneServing:
             ):
                 sm[client] = sm[client] - 1
 
+    def _encode_path(self) -> str:
+        """/metrics path label for sync-cache events: which delete-set
+        read route serves on a miss."""
+        return "device" if self.device_pack_enabled else "host"
+
     def _cache_lookup(self, doc: PlaneDoc, epoch_key, sv_key) -> Optional[bytes]:
         payload = self._sync_cache.get(doc.name, doc, epoch_key, sv_key)
         counters = self.plane.counters
@@ -679,11 +810,11 @@ class PlaneServing:
         if payload is not None:
             counters["sync_cache_hits"] += 1
             if wire.enabled:
-                wire.record_sync_cache("hit")
+                wire.record_sync_cache("hit", path=self._encode_path())
         else:
             counters["sync_cache_misses"] += 1
             if wire.enabled:
-                wire.record_sync_cache("miss")
+                wire.record_sync_cache("miss", path=self._encode_path())
         return payload
 
     def _cache_store(self, doc: PlaneDoc, epoch_key, sv_key, payload: bytes) -> None:
@@ -694,7 +825,9 @@ class PlaneServing:
             self.plane.counters["sync_cache_evictions"] += evicted
             wire = get_wire_telemetry()
             if wire.enabled:
-                wire.record_sync_cache("eviction", evicted)
+                wire.record_sync_cache(
+                    "eviction", evicted, path=self._encode_path()
+                )
 
     def _encode_from_sm(self, doc: PlaneDoc, sm: dict[int, int]) -> bytes:
         """SyncStep2 bytes for a doc given the per-client cutoff map.

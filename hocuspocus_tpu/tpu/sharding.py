@@ -159,6 +159,42 @@ def make_sharded_sparse_step(mesh: Mesh):
     )
 
 
+def make_sharded_append_step(mesh: Mesh):
+    """Jitted multi-chip run-append step (the sequential fast path):
+    three replicated (K, B) run fields + the (B,) slot routing vector
+    against the doc-sharded arenas — the same small-batch replication
+    discipline as make_sharded_sparse_step, so per-flush traffic scales
+    with B whichever path the classifier picks."""
+    from .kernels import append_run_slots_sparse
+
+    st_shard = state_sharding(mesh)
+    _, slot_shard = sparse_ops_sharding(mesh)
+    replicated = NamedSharding(mesh, P(None, None))
+    count_sharding = NamedSharding(mesh, P())
+    return jax.jit(
+        append_run_slots_sparse.__wrapped__,
+        in_shardings=(st_shard, replicated, replicated, replicated, slot_shard),
+        out_shardings=(st_shard, count_sharding),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_rle_append_step(mesh: Mesh):
+    """RLE twin of make_sharded_append_step."""
+    from .kernels_rle import append_run_slots_rle_sparse
+
+    st_shard = rle_state_sharding(mesh)
+    _, slot_shard = sparse_ops_sharding(mesh)
+    replicated = NamedSharding(mesh, P(None, None))
+    count_sharding = NamedSharding(mesh, P())
+    return jax.jit(
+        append_run_slots_rle_sparse.__wrapped__,
+        in_shardings=(st_shard, replicated, replicated, replicated, slot_shard),
+        out_shardings=(st_shard, count_sharding),
+        donate_argnums=(0,),
+    )
+
+
 def make_sharded_compact_step(mesh: Mesh):
     """Jitted multi-chip compact (tombstone-GC) step: the (B,) slot
     routing vector replicates like the sparse op batches, the

@@ -30,7 +30,9 @@ def test_warm_grid_compiles_once_then_only_hits():
     differential against the existing warmup-grid behavior."""
     plane = MergePlane(num_docs=8, capacity=256, max_slots_per_flush=4)
     watch = plane.compile_watch
-    grid = plane.warmup_shapes()
+    # the full warm grid: integrate (k, b) pairs plus the tagged
+    # run-append / tail-probe aux shapes
+    grid = plane.warmup_shapes() + plane.warmup_aux_shapes()
     assert watch.fresh_compiles == 0
 
     plane.warmup_compiles()

@@ -240,8 +240,9 @@ def test_shared_warm_registry_skips_covered_shapes():
     reset_warm_registry()
     first = MergePlane(num_docs=8, capacity=128)
     grid = first.warmup_shapes()
+    full_grid_len = len(grid) + len(first.warmup_aux_shapes())
     assert first.warmup_compiles(shared=True) is True
-    assert first.compile_watch.fresh_compiles == len(grid)
+    assert first.compile_watch.fresh_compiles == full_grid_len
     # an identically-shaped plane skips every covered shape: no
     # dispatches, tracker seeded so live flushes classify as the
     # cache hits they are (module-level jit cache)
@@ -257,7 +258,7 @@ def test_shared_warm_registry_skips_covered_shapes():
     # direct (unshared) warmups keep their full per-plane behavior
     third = MergePlane(num_docs=8, capacity=128)
     third.warmup_compiles()
-    assert third.compile_watch.fresh_compiles == len(grid)
+    assert third.compile_watch.fresh_compiles == full_grid_len
     reset_warm_registry()
 
 

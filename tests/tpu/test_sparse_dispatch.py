@@ -202,6 +202,10 @@ def test_staging_reused_not_reallocated():
     regression here silently reintroduces the 8x(K, D)-fresh-allocs-
     per-batch host cost the pipeline removed."""
     plane = MergePlane(num_docs=16, capacity=512)
+    # pin the FULL-INTEGRATE staging path: with the run-merge
+    # classifier on, pure tail appends ship through the append staging
+    # instead (covered by the twin test below)
+    plane.run_merge_enabled = False
     plane.register("doc")
     source = Doc()
     updates: list = []
@@ -227,6 +231,48 @@ def test_staging_reused_not_reallocated():
     assert plane.counters["flush_staging_allocs"] == 2  # still the same two
     assert [id(field) for field in plane._staging[0].fields] + [
         id(field) for field in plane._staging[1].fields
+    ] == first_ids
+    assert plane.text("doc") == source.get_text("t").to_string()
+
+
+def test_append_staging_reused_not_reallocated():
+    """Fast-path twin: sequential appends route through the run-merge
+    append staging (two sets, double buffering), which must also be
+    allocated once and reused — and never allocate the full-integrate
+    staging at all on a pure-sequential workload."""
+    plane = MergePlane(num_docs=16, capacity=512)
+    plane.register("doc")
+    source = Doc()
+    updates: list = []
+    source.on("update", lambda update, *rest: updates.append(update))
+    text = source.get_text("t")
+    cycles = 6
+    for cycle in range(cycles):
+        text.insert(len(text), f"cycle {cycle} ")
+        for update in updates:
+            plane.enqueue_update("doc", update)
+        updates.clear()
+        plane.flush()
+    assert plane.counters["flush_batches_fast"] == cycles
+    assert plane.counters["flush_fast_ops"] > 0
+    assert plane.counters["flush_slow_ops"] == 0
+    assert plane.flush_stats["fast_path_fraction"] == 1.0
+    assert plane._staging is None  # the slow path never ran
+    assert plane.counters["flush_staging_allocs"] == 2
+    assert plane.counters["flush_staging_reuses"] == cycles - 1
+    first_ids = [
+        id(plane._append_staging[0].client),
+        id(plane._append_staging[1].client),
+    ]
+    text.insert(len(text), "tail")
+    for update in updates:
+        plane.enqueue_update("doc", update)
+    updates.clear()
+    plane.flush()
+    assert plane.counters["flush_staging_allocs"] == 2
+    assert [
+        id(plane._append_staging[0].client),
+        id(plane._append_staging[1].client),
     ] == first_ids
     assert plane.text("doc") == source.get_text("t").to_string()
 
@@ -263,6 +309,10 @@ def test_flush_pipeline_smoke_mixed_widths():
     dispatches: one busy doc (sparse B=1), a few (sparse bucket), all
     busy (dense fallback), and a multi-batch backlog drain."""
     plane = MergePlane(num_docs=8, capacity=512, max_slots_per_flush=2)
+    # classic-path smoke: first-ever inserts into empty docs would
+    # otherwise route through the run-merge append program (see
+    # test_mixed_fast_slow_flush_smoke for the classifier's widths)
+    plane.run_merge_enabled = False
     serving = PlaneServing(plane)
     population = 8
     docs, pending = {}, {}
@@ -323,6 +373,72 @@ def test_flush_pipeline_smoke_mixed_widths():
             rebuilt.get_text("t").to_string()
             == docs[name].get_text("t").to_string()
         )
+
+
+def test_mixed_fast_slow_flush_smoke():
+    """Run-merge classifier smoke: one flush cycle carrying both
+    all-sequential columns (tail appends -> append program) and
+    concurrent columns (prepends -> full integrate) dispatches both
+    paths, splits the op accounting per path, and still serves state
+    equal to the CPU ground truth."""
+    plane = MergePlane(num_docs=8, capacity=512)
+    serving = PlaneServing(plane)
+    docs, pending = {}, {}
+    for i in range(4):
+        name = f"doc-{i}"
+        plane.register(name)
+        doc = Doc()
+        queue: list = []
+        doc.on("update", lambda update, *rest, queue=queue: queue.append(update))
+        docs[name], pending[name] = doc, queue
+
+    def push(name):
+        for update in pending[name]:
+            plane.enqueue_update(name, update)
+        pending[name].clear()
+
+    # seed every doc (first insert into an empty row: fast)
+    for i in range(4):
+        docs[f"doc-{i}"].get_text("t").insert(0, "seed ")
+        push(f"doc-{i}")
+    plane.flush()
+    assert plane.counters["flush_batches_fast"] >= 1
+    assert plane.counters["flush_slow_ops"] == 0
+    # docs 0/1 keep appending (fast), docs 2/3 prepend (slow) — one
+    # cycle must split the columns across both dispatch paths
+    for i in (0, 1):
+        text = docs[f"doc-{i}"].get_text("t")
+        text.insert(len(text), "tail")
+        push(f"doc-{i}")
+    for i in (2, 3):
+        docs[f"doc-{i}"].get_text("t").insert(0, "head ")
+        push(f"doc-{i}")
+    fast_before = plane.counters["flush_fast_ops"]
+    plane.flush()
+    assert plane.counters["flush_fast_ops"] > fast_before
+    assert plane.counters["flush_slow_ops"] > 0
+    assert plane.counters["flush_batches_sparse"] >= 1
+    assert 0.0 < plane.flush_stats["fast_path_fraction"] < 1.0
+    # a slow column's tail re-arms via the probe: the NEXT append to a
+    # prepended doc goes fast again
+    text = docs["doc-2"].get_text("t")
+    text.insert(len(text), "end")
+    push("doc-2")
+    fast_before = plane.counters["flush_fast_ops"]
+    plane.flush()
+    assert plane.counters["flush_fast_ops"] > fast_before
+    # served state equals ground truth across both paths
+    serving.refresh()
+    for i in range(4):
+        name = f"doc-{i}"
+        assert plane.text(name) == docs[name].get_text("t").to_string(), name
+        served = serving.encode_state_as_update(name, docs[name], None)
+        rebuilt = Doc()
+        apply_update(rebuilt, served)
+        assert (
+            rebuilt.get_text("t").to_string()
+            == docs[name].get_text("t").to_string()
+        ), name
 
 
 def test_pending_ops_tracks_busy_set_exactly():

@@ -58,18 +58,27 @@ def _fast_ext(**overrides):
 class _WedgeableStep:
     """Swappable step factory: pass-through until wedge() is called;
     wedged steps block on the gate, then run the real step — modeling a
-    hung device that later completes the in-flight launch. Covers BOTH
-    device entry points (the dense step and the sparse busy-doc step —
-    flushes and the canary dispatch through either)."""
+    hung device that later completes the in-flight launch. Covers ALL
+    THREE device entry points (the dense step, the sparse busy-doc step
+    and the run-merge append step — flushes and the canary dispatch
+    through one of them). `entered` latches once a dispatch is
+    physically blocked on the gate: its caller (timer flush, drain or
+    canary) holds the plane flush lock at that point, so tests can wait
+    on it before asserting wedge-dependent behavior. Call recover() in
+    the test's finally — a blocked executor thread outliving the test
+    deadlocks the event-loop teardown."""
 
     def __init__(self, plane) -> None:
         self.plane = plane
         self.real = plane._step_fn
         self.real_sparse = plane._sparse_step_fn
+        self.real_append = plane._append_step_fn
         self.gate = threading.Event()
+        self.entered = threading.Event()
         self.wedged = False
         plane._step_fn = self._factory
         plane._sparse_step_fn = self._sparse_factory
+        plane._append_step_fn = self._append_factory
 
     def _factory(self):
         real_step = self.real()
@@ -77,6 +86,7 @@ class _WedgeableStep:
             return real_step
 
         def blocked(state, ops):
+            self.entered.set()
             self.gate.wait()
             return real_step(state, ops)
 
@@ -88,8 +98,21 @@ class _WedgeableStep:
             return real_step
 
         def blocked(state, ops, slots):
+            self.entered.set()
             self.gate.wait()
             return real_step(state, ops, slots)
+
+        return blocked
+
+    def _append_factory(self):
+        real_step = self.real_append()
+        if not self.wedged:
+            return real_step
+
+        def blocked(state, *args):
+            self.entered.set()
+            self.gate.wait()
+            return real_step(state, *args)
 
         return blocked
 
@@ -274,6 +297,7 @@ async def test_midflight_wedge_trips_breaker_and_drains_to_cpu():
     a = new_provider(server, name="wedge-doc")
     b = new_provider(server, name="wedge-doc")
     joiners = []
+    wedge = None
     try:
         await wait_synced(a, b)
         await retryable_assertion(
@@ -314,8 +338,9 @@ async def test_midflight_wedge_trips_breaker_and_drains_to_cpu():
         await retryable_assertion(
             lambda: _assert(a.document.get_text("t").to_string() == "cpu;mid;pre;")
         )
-        wedge.recover()  # let the blocked device thread finish cleanly
     finally:
+        if wedge is not None:
+            wedge.recover()  # let the blocked device thread finish cleanly
         for c in joiners:
             c.destroy()
         a.destroy()
@@ -331,6 +356,7 @@ async def test_flapping_wedge_recover_wedge_is_accounted():
     server = await new_hocuspocus(extensions=[ext])
     a = new_provider(server, name="flap-doc")
     b = new_provider(server, name="flap-doc")
+    wedge = None
     try:
         await wait_synced(a, b)
         await retryable_assertion(
@@ -389,6 +415,8 @@ async def test_flapping_wedge_recover_wedge_is_accounted():
         finally:
             c.destroy()
     finally:
+        if wedge is not None:
+            wedge.recover()
         a.destroy()
         b.destroy()
         await server.destroy()
@@ -415,6 +443,7 @@ async def test_breaker_open_parks_lane_classes_and_resume_restores():
     server = await new_hocuspocus(extensions=[ext])
     a = new_provider(server, name="lane-park-doc")
     b = new_provider(server, name="lane-park-doc")
+    wedge = None
     try:
         await wait_synced(a, b)
         await retryable_assertion(
@@ -482,6 +511,8 @@ async def test_breaker_open_parks_lane_classes_and_resume_restores():
         ]
         assert "supervisor.transition" in events
     finally:
+        if wedge is not None:
+            wedge.recover()
         a.destroy()
         b.destroy()
         await server.destroy()
@@ -496,6 +527,7 @@ async def test_abort_pending_resolves_stranded_sync_waiters():
     ext = _fast_ext()
     server = await new_hocuspocus(extensions=[ext])
     a = new_provider(server, name="strand-doc")
+    wedge = None
     try:
         await wait_synced(a)
         await retryable_assertion(
@@ -504,11 +536,18 @@ async def test_abort_pending_resolves_stranded_sync_waiters():
                 and ext.runtime.is_served("strand-doc")
             )
         )
-        a.document.get_text("t").insert(0, "content")
         serving = ext.runtime.serving
-        # queue a batched sync, then wedge before its drain can flush
+        # wedge FIRST, then edit: the flush timer (or the canary) takes
+        # the dispatch into the gate while holding the plane flush lock,
+        # so the batched sync below deterministically strands behind it
+        # — editing before wedging races the 1ms timer, which can land
+        # the op pre-wedge and let the drain serve real bytes
         wedge = _WedgeableStep(ext.plane)
         wedge.wedge()
+        a.document.get_text("t").insert(0, "content")
+        await retryable_assertion(
+            lambda: _assert(wedge.entered.is_set()), timeout=15
+        )
         waiter = asyncio.ensure_future(
             serving.batched_sync("strand-doc", server.documents["strand-doc"], None)
         )
@@ -525,8 +564,9 @@ async def test_abort_pending_resolves_stranded_sync_waiters():
             )
             is None
         )
-        wedge.recover()
     finally:
+        if wedge is not None:
+            wedge.recover()
         a.destroy()
         await server.destroy()
 
@@ -538,6 +578,7 @@ async def test_healthz_endpoint_reports_plane_state():
 
     ext = _fast_ext()
     server = await new_hocuspocus(extensions=[ext])
+    wedge = None
     try:
         await retryable_assertion(lambda: _assert(ext.supervisor.state == STATE_READY))
         async with aiohttp.ClientSession() as session:
@@ -562,8 +603,9 @@ async def test_healthz_endpoint_reports_plane_state():
         assert body["extensions"]["SupervisedTpuMergeExtension"]["breaker"][
             "state"
         ] == "open"
-        wedge.recover()
     finally:
+        if wedge is not None:
+            wedge.recover()
         await server.destroy()
 
 
@@ -587,6 +629,7 @@ async def test_sharded_runtime_under_supervision():
     server = await new_hocuspocus(extensions=[ext])
     writers = []
     readers = []
+    wedge = None
     try:
         for d in range(4):
             writers.append(new_provider(server, name=f"shard-sup-{d}"))
@@ -628,8 +671,9 @@ async def test_sharded_runtime_under_supervision():
                 )
             )
         )
-        wedge.recover()
     finally:
+        if wedge is not None:
+            wedge.recover()
         for p in writers + readers:
             p.destroy()
         await server.destroy()

@@ -356,6 +356,34 @@ def main(argv: "list[str] | None" = None) -> int:
         for name, entry in suite["scenarios"].items()
         if isinstance(entry, dict) and entry.get("replica")
     }
+    # minimal-work merge evidence: how much of the round actually rode
+    # the fast paths — the run-merge append program (fast_path_fraction
+    # of integrated ops) and the on-device catch-up pack
+    # (device_encode_share of SyncStep2 delete-set reads). A capture
+    # whose shares are ~0 measured the classic paths, and its
+    # microbatch/cold-sync p99s must be read accordingly.
+    merge_path = None
+    if headline is not None:
+        h_extra = headline.get("extra") or {}
+        gov_on = (h_extra.get("mixed_load") or {}).get("governor_on") or {}
+        storm = h_extra.get("catchup_storm") or {}
+        merge_path = {
+            "mixed_load": {
+                "fast_path_fraction": gov_on.get("fast_path_fraction"),
+                "device_encode_share": gov_on.get("device_encode_share"),
+                "microbatch_p99_ms": gov_on.get("microbatch_p99_ms"),
+            }
+            if gov_on
+            else None,
+            "catchup_storm": {
+                "device_encode_share": storm.get("device_encode_share"),
+                "cold_sync_p99_ms": storm.get("cold_sync_p99_ms"),
+            }
+            if storm
+            else None,
+        }
+        if not any(merge_path.values()):
+            merge_path = None
     manifest = {
         "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "git_rev": _git_rev(),
@@ -369,6 +397,7 @@ def main(argv: "list[str] | None" = None) -> int:
         "multi_device": multi_device or None,
         "fleet_digest_peers": fleet_peers or None,
         "replica_fanout": replica_fanout or None,
+        "merge_path": merge_path,
         "stale_capture": stale,
         "fresh": bool(headline is not None and not stale),
         "scenario_suite": suite,

@@ -8,7 +8,12 @@ files) on their per-stage p99s — `extra.update_e2e.<stage>.p99_ms`,
 `extra.replica_storm.merge_to_remote_broadcast_p99_ms`, the adaptive
 scheduler's `extra.mixed_load.governor_on.interactive_p99_ms`
 (interactive merge→broadcast under concurrent hydration+compaction
-with the lane arbiter + governor on), the overload control plane's
+with the lane arbiter + governor on), the minimal-work merge's
+`extra.mixed_load.governor_on.microbatch_p99_ms` (per-flush wall time
+with the run-merge fast path engaged) and
+`extra.catchup_storm.cold_sync_p99_ms` (post-storm cold-joiner
+SyncStep2 through the on-device catch-up pack), the overload control
+plane's
 `extra.scenario_suite.scenarios.overload_storm.phase_p99_ms.storm`
 (gated as `overload_storm.interactive_p99`: interactive edit p99 while
 the brownout ladder is at RED and shedding), and the durability plane's
@@ -123,6 +128,21 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
             p99 = governor_on.get("interactive_p99_ms")
             if isinstance(p99, (int, float)) and not isinstance(p99, bool):
                 stages["mixed_load.interactive_p99"] = float(p99)
+            # per-microbatch flush wall time under the mixed storm: the
+            # minimal-work run merge keeps sequential columns off the
+            # full-row integrate, so a regression here means the fast
+            # path stopped engaging (or got slower than the scan)
+            p99 = governor_on.get("microbatch_p99_ms")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["mixed_load.microbatch_p99"] = float(p99)
+    storm = extra.get("catchup_storm")
+    if isinstance(storm, dict):
+        # post-storm cold-joiner SyncStep2 latency: the on-device
+        # catch-up pack replaces the host serve-log walk, so a
+        # regression here means cold joins fell back to host encodes
+        p99 = storm.get("cold_sync_p99_ms")
+        if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+            stages["catchup_storm.cold_sync_p99"] = float(p99)
     suite = extra.get("scenario_suite")
     if isinstance(suite, dict):
         # shed-mode interactive latency: the overload_storm scenario's
