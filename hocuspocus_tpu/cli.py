@@ -67,6 +67,74 @@ def build_parser() -> argparse.ArgumentParser:
         "buffer before the oldest is shed (accounted, healed by the "
         "rebind resync; default 1024)",
     )
+    # elastic fleet (docs/guides/elastic-fleet.md): cross-host cell
+    # admission + the autoscaling controller over warm-spare cells.
+    parser.add_argument(
+        "--host-id",
+        help="host identity on the relay bus: qualifies this process's "
+        "cell id as <host-id>/<cell-id> so cells from DIFFERENT hosts "
+        "can share one control channel, and (role=edge) marks which "
+        "cells are local — foreign cells are admitted only once their "
+        "clock offset resolves (docs/guides/elastic-fleet.md)",
+    )
+    parser.add_argument(
+        "--fleet-autoscale",
+        action="store_true",
+        help="run the fleet autoscaling controller over the multi-device "
+        "cell plane (requires --tpu-devices != 1): scale-up activates "
+        "warm-spare cells, scale-down drains the coldest cell over the "
+        "migration rail; all scaling parks while the overload ladder is "
+        "at BROWNOUT-1+ (docs/guides/elastic-fleet.md)",
+    )
+    parser.add_argument(
+        "--fleet-interval",
+        type=float,
+        default=2.0,
+        help="autoscaler decision cadence in seconds (default 2)",
+    )
+    parser.add_argument(
+        "--fleet-min-cells",
+        type=int,
+        default=1,
+        help="floor the autoscaler may never scale below (default 1)",
+    )
+    parser.add_argument(
+        "--fleet-warm-spares",
+        type=int,
+        default=0,
+        help="cells parked as pre-warmed spares at boot — arena and "
+        "registry stay built, so activation is one placement-epoch "
+        "bump (default 0 = start with every cell active)",
+    )
+    parser.add_argument(
+        "--fleet-up",
+        type=float,
+        default=0.75,
+        help="mean fleet-load signal that (held for --fleet-hold-ticks) "
+        "activates a warm spare (default 0.75)",
+    )
+    parser.add_argument(
+        "--fleet-down",
+        type=float,
+        default=0.35,
+        help="mean fleet-load signal that (held, and only when the "
+        "survivors' projected load stays in band) parks the coldest "
+        "cell (default 0.35)",
+    )
+    parser.add_argument(
+        "--fleet-hold-ticks",
+        type=int,
+        default=3,
+        help="consecutive out-of-band decision ticks before the "
+        "autoscaler acts — the anti-flap hysteresis hold (default 3)",
+    )
+    parser.add_argument(
+        "--fleet-work-target",
+        type=float,
+        default=150.0,
+        help="dispatched merge units/second that count as a fully "
+        "loaded cell in the fleet-load signal (default 150)",
+    )
     parser.add_argument("--webhook", "-w", help="webhook URL to POST document changes to")
     parser.add_argument(
         "--sqlite",
@@ -498,6 +566,7 @@ async def run(args: argparse.Namespace) -> None:
         extensions.append(
             CellIngressExtension(
                 cell_id=args.cell_id or f"cell-{args.port}",
+                host_id=args.host_id,
                 host=args.relay_redis_host,
                 port=args.relay_redis_port,
                 prefix=args.relay_prefix,
@@ -553,6 +622,27 @@ async def run(args: argparse.Namespace) -> None:
                 lane_promote_ms=args.tpu_lane_promote_ms,
             )
         )
+    if args.fleet_autoscale:
+        if args.tpu_devices == 1 or not (args.tpu_merge or args.tpu_serve):
+            print(
+                "--fleet-autoscale requires the multi-device cell plane "
+                "(--tpu-serve with --tpu-devices != 1)",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        from .fleet import FleetControllerExtension
+
+        extensions.append(
+            FleetControllerExtension(
+                interval_s=args.fleet_interval,
+                warm_spares=args.fleet_warm_spares,
+                min_cells=args.fleet_min_cells,
+                up_threshold=args.fleet_up,
+                down_threshold=args.fleet_down,
+                hold_ticks=args.fleet_hold_ticks,
+                work_target=args.fleet_work_target,
+            )
+        )
 
     configuration = Configuration(
         extensions=extensions,
@@ -572,6 +662,7 @@ async def run(args: argparse.Namespace) -> None:
         extensions.append(
             EdgeGatewayExtension(
                 edge_id=args.edge_id,
+                host_id=args.host_id,
                 host=args.relay_redis_host,
                 port=args.relay_redis_port,
                 prefix=args.relay_prefix,

@@ -40,6 +40,7 @@ from ..server import logger
 from ..server.hocuspocus import RequestInfo
 from ..server.transports import CallbackWebSocketTransport
 from ..server.types import Extension, Payload
+from ..fleet.roster import PeerRoster, qualify_cell_id
 from . import relay
 from .relay import DEFAULT_PREFIX
 from .replica import ReplicaManager
@@ -169,8 +170,14 @@ class CellIngressExtension(Extension):
         create_client: Optional[Any] = None,
         create_subscriber: Optional[Any] = None,
         announce_interval_s: float = 2.0,
+        host_id: Optional[str] = None,
     ) -> None:
-        self.cell_id = cell_id
+        # cross-host fleets (fleet/roster.py): a host qualifier turns
+        # the cell id into "host/cell" — rendezvous hashes the full
+        # string, so qualified cells are first-class placement targets
+        # and edges can tell foreign announcers from local ones
+        self.cell_id = qualify_cell_id(host_id, cell_id)
+        self.host_id = host_id
         self.prefix = prefix
         self.announce_interval_s = announce_interval_s
         self.instance = None
@@ -186,6 +193,11 @@ class CellIngressExtension(Extension):
             "trace_returns_sent": 0,
         }
         self._tasks: set = set()
+        # fleet-membership mirror: every control-channel lifecycle
+        # transition (our own announce echo included — all subscribers
+        # count the same stream) bumps roster.epoch, published in the
+        # digest so /debug/fleet can flag cell-vs-cell roster skew
+        self.roster = PeerRoster()
         # hot-doc replication roles (edge/replica.py): which docs this
         # cell owns (streams ticks for) vs follows (applies ticks for)
         self.replicas = ReplicaManager(self)
@@ -265,6 +277,10 @@ class CellIngressExtension(Extension):
                         "draining": self.draining,
                         "edge_sessions": len(self.sessions),
                     },
+                    # dynamic-roster epoch: cells that watched the same
+                    # control stream agree; divergence IS the skew
+                    # /debug/fleet flags for the cell role
+                    "roster_epoch": self.roster.epoch,
                     # replication topology: per-doc follower sets +
                     # tick seqs — edges harvest the seqs to pick the
                     # FRESHEST follower at promotion time, /debug/fleet
@@ -468,15 +484,24 @@ class CellIngressExtension(Extension):
                 except Exception:
                     pass
             return
-        if kind == relay.CELL_DOWN and session_id != self.cell_id:
-            get_fleet_view().mark_down(session_id)
-            self.replicas.on_peer_down(session_id)
+        if kind == relay.CELL_DOWN:
+            self.roster.note(session_id, "down")
+            if session_id != self.cell_id:
+                get_fleet_view().mark_down(session_id)
+                self.replicas.on_peer_down(session_id)
             return
         if kind in (relay.CELL_UP, relay.CELL_DRAINING):
+            # fold the membership transition into the roster mirror
+            # (heartbeat re-announces are no-ops; only real transitions
+            # bump the epoch) — routing stays the edges' job
+            self.roster.note(
+                session_id,
+                "healthy" if kind == relay.CELL_UP else "draining",
+            )
             if kind == relay.CELL_DRAINING and session_id != self.cell_id:
                 # a draining peer stops serving its follower role
                 self.replicas.on_peer_down(session_id)
-            return  # peer lifecycle: the router (on edges) owns this
+            return
         if kind in (
             relay.FOLLOW,
             relay.UNFOLLOW,

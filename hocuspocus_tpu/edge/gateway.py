@@ -60,6 +60,7 @@ from ..protocol.sync import (
     MESSAGE_YJS_UPDATE,
 )
 from ..crdt.encoding import Decoder
+from ..fleet.roster import AdmissionGate
 from ..server import logger
 from ..server.overload import RED, get_overload_controller, resolve_tenant
 from ..server.types import ConnectionConfiguration, Payload
@@ -605,9 +606,16 @@ class EdgeGateway:
         heartbeat_sweep_s: Optional[float] = None,
         digest_interval_s: float = 2.0,
         replica_watermark: int = DEFAULT_REPLICA_WATERMARK,
+        host_id: Optional[str] = None,
+        admission: Optional[AdmissionGate] = None,
     ) -> None:
         self.edge_id = edge_id or f"edge-{uuid.uuid4().hex[:8]}"
         self.prefix = prefix
+        # cross-host admission (fleet/roster.py): cells announcing with
+        # a foreign host qualifier stay PENDING — probed, not routable —
+        # until their clock offset resolves; local cells admit as before
+        self.host_id = host_id
+        self.admission = admission or AdmissionGate(local_host=host_id)
         if router is None:
             router = (
                 CellRouter()
@@ -654,6 +662,8 @@ class EdgeGateway:
             "digests_published": 0,
             "follow_hints": 0,
             "promotions": 0,
+            "admissions_pending": 0,
+            "admissions_foreign": 0,
         }
         # -- hot-doc replication (docs/guides/hot-doc-replication.md) ---
         # audience watermark (0 disables): per-doc ESTABLISHED channel
@@ -805,6 +815,17 @@ class EdgeGateway:
         timeout + sweep interval."""
         self._sweep_handle = None
         try:
+            # pending (never-admitted) cells that stopped announcing
+            # age out on the same liveness contract as routable ones
+            for cell_id in self.admission.expire(
+                self.router.heartbeat_timeout_s
+            ):
+                get_flight_recorder().record(
+                    "__autoscale__",
+                    "cell_pending_expired",
+                    cell=cell_id,
+                    edge=self.edge_id,
+                )
             # per-cell isolation: expire_stale reports each dead cell
             # exactly ONCE, so a handoff failure for cell A must not
             # strand cell B's sessions for good
@@ -875,6 +896,10 @@ class EdgeGateway:
                         cell_id,
                         relay.encode_envelope(relay.PING, self.edge_id, ping_aux),
                     )
+            # pending (cross-host) cells are ALWAYS probed: their
+            # admission is waiting on exactly these samples
+            for cell_id in list(self.admission.pending):
+                self._ping_cell(cell_id)
         finally:
             if self._started and self.digest_interval_s > 0:
                 try:
@@ -1190,17 +1215,64 @@ class EdgeGateway:
 
     # -- inbound dispatch ----------------------------------------------------
 
+    def _consider_cell(self, cell_id: str) -> None:
+        """CELL_UP admission (fleet/roster.py): local cells join the
+        router immediately; a FOREIGN cell holds in the pending table —
+        announced, clock-probed, but not routable — until its per-peer
+        ClockOffsetEstimator resolves. Every membership change still
+        rides `router.add_cell`'s epoch bump, so in-flight routes heal
+        through the usual stale-route/Step1-resync machinery."""
+        admit, reason = self.admission.evaluate(
+            cell_id, get_fleet_view().offsets.get(cell_id)
+        )
+        if not admit:
+            if self.admission.hold(cell_id, reason):
+                self.counters["admissions_pending"] += 1
+                get_flight_recorder().record(
+                    "__autoscale__",
+                    "cell_pending",
+                    cell=cell_id,
+                    edge=self.edge_id,
+                    reason=reason,
+                )
+            # probe the pending peer's clock NOW: admission is what
+            # needs the offset resolved, never gated on the tracer
+            self._ping_cell(cell_id)
+            return
+        if self.admission.admit(cell_id):
+            self.counters["admissions_foreign"] += 1
+            get_flight_recorder().record(
+                "__autoscale__",
+                "cell_admitted",
+                cell=cell_id,
+                edge=self.edge_id,
+                reason=reason,
+            )
+        if self.router.add_cell(cell_id):
+            if reason == "local":
+                self.admission.note_local(True)
+            get_flight_recorder().record(
+                "__edge__", "cell_up", cell=cell_id, edge=self.edge_id
+            )
+            self._rebind_parked()
+
+    def _ping_cell(self, cell_id: str) -> None:
+        self.publish_to_cell(
+            cell_id,
+            relay.encode_envelope(
+                relay.PING,
+                self.edge_id,
+                json.dumps({"t": time.perf_counter()}, separators=(",", ":")),
+            ),
+        )
+
     def _on_message(self, channel: bytes, data: bytes) -> None:
         try:
             kind, session_id, aux, payload = relay.decode_envelope(data)
         except Exception:
             return
         if kind == relay.CELL_UP:
-            if self.router.add_cell(session_id):
-                get_flight_recorder().record(
-                    "__edge__", "cell_up", cell=session_id, edge=self.edge_id
-                )
-                self._rebind_parked()
+            self._consider_cell(session_id)
             return
         if kind == relay.CELL_DRAINING:
             if self.router.mark_draining(session_id):
@@ -1211,6 +1283,7 @@ class EdgeGateway:
             return
         if kind == relay.CELL_DOWN:
             get_fleet_view().mark_down(session_id)
+            self.admission.pending.pop(session_id, None)
             if self.router.mark_dead(session_id):
                 get_flight_recorder().record(
                     "__edge__", "cell_down", cell=session_id, edge=self.edge_id
@@ -1248,6 +1321,10 @@ class EdgeGateway:
                 )
             except Exception:
                 pass
+            if session_id in self.admission.pending:
+                # a pending cell's probe landed: re-evaluate admission
+                # now instead of waiting out its next CELL_UP heartbeat
+                self._consider_cell(session_id)
             return
         if kind == relay.TRACE_RET:
             # cross-tier trace returns (session field = the cell's id):
@@ -1315,6 +1392,8 @@ class EdgeGateway:
         view = get_fleet_view()
         return {
             "edge_id": self.edge_id,
+            "host_id": self.host_id,
+            "admission": self.admission.status(),
             "router": self.router.table(),
             "sessions": {
                 session_id: {"cell": session.cell_id, "docs": sorted(session.docs)}

@@ -1,0 +1,27 @@
+"""Elastic fleet: the control-plane layer that closes the scaling loop.
+
+Every mechanism this package drives already exists lower in the stack —
+PR-15 fleet digests measure per-cell load, the PR-14 evict-snapshot →
+hydrate rail migrates docs under live edits, PR-13 drain handoff retires
+cells with zero acked loss, and the PR-12 brownout ladder says when the
+plane is too stressed to churn topology. `fleet/` is the part that was
+missing: a controller that *decides* (controller.py) and a roster that
+lets cells on OTHER hosts join the decision space (roster.py).
+
+CRDT convergence is placement-independent, so cells can be added,
+drained, and rehomed under live edits without coordinating on the data
+itself — the controller only ever moves *where* merges happen, never
+*what* they produce.
+"""
+
+from .controller import FleetController, FleetControllerExtension
+from .roster import AdmissionGate, PeerRoster, cell_host, qualify_cell_id
+
+__all__ = [
+    "AdmissionGate",
+    "FleetController",
+    "FleetControllerExtension",
+    "PeerRoster",
+    "cell_host",
+    "qualify_cell_id",
+]

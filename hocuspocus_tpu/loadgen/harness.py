@@ -69,6 +69,7 @@ class ServedLoadHarness:
         with_metrics: bool = False,
         seed: int = 0,
         overload: "Optional[dict]" = None,
+        autoscale: "Optional[dict]" = None,
         anti_entropy_s: "Optional[float]" = None,
         progress=None,
     ) -> None:
@@ -113,6 +114,11 @@ class ServedLoadHarness:
         # extension's anti-entropy cadence so partition-heal scenarios
         # reconverge inside CI-scale phases.
         self.overload = overload
+        # autoscale: FleetControllerExtension tuning per plane-holding
+        # instance (docs/guides/elastic-fleet.md) — only meaningful with
+        # devices > 1, where the controller can park/activate cells
+        self.autoscale = autoscale
+        self.fleet_controllers: list[Any] = []
         self.anti_entropy_s = anti_entropy_s
         # seed: every random choice the harness makes (timed edit sizes,
         # background payload widths) draws from a seeded generator, and
@@ -208,6 +214,12 @@ class ServedLoadHarness:
                 self.metrics.append(metrics)
                 extensions.append(metrics)
             extensions.append(plane_ext)
+            if self.autoscale is not None and self.devices > 1:
+                from ..fleet import FleetControllerExtension
+
+                fleet_ext = FleetControllerExtension(**self.autoscale)
+                self.fleet_controllers.append(fleet_ext)
+                extensions.append(fleet_ext)
             server = Server(Configuration(quiet=True, extensions=extensions))
             await server.listen(port=0)
             for plane in planes:
@@ -303,6 +315,12 @@ class ServedLoadHarness:
                 self.metrics.append(metrics)
                 extensions.append(metrics)
             extensions.append(ext)
+            if self.autoscale is not None and self.devices > 1:
+                from ..fleet import FleetControllerExtension
+
+                fleet_ext = FleetControllerExtension(**self.autoscale)
+                self.fleet_controllers.append(fleet_ext)
+                extensions.append(fleet_ext)
             server = Server(Configuration(quiet=True, extensions=extensions))
             await server.listen(port=0)
             for plane in planes:

@@ -74,6 +74,15 @@ class ScenarioRunner:
         # tuning (docs/guides/overload.md); the runner resets the
         # process-global controller at teardown
         self._overload_config = params.get("overload")
+        # elastic-fleet seam: params["autoscale"] installs a
+        # FleetControllerExtension next to each multi-device plane
+        # (docs/guides/elastic-fleet.md); params["autoscale_slo"] makes
+        # the steady-trough footprint a latched verdict input
+        self._autoscale_config = params.get("autoscale")
+        self._autoscale_slo = params.get("autoscale_slo") or {}
+        self._autoscale_samples: "dict[str, list[int]]" = {}
+        self._autoscale_evidence: "Optional[dict]" = None
+        self._current_phase: "Optional[str]" = None
         self._verify_convergence = bool(params.get("verify_convergence"))
         self._tracer_state = None  # (enabled, sample) to restore post-run
         self.harness = ServedLoadHarness(
@@ -93,6 +102,7 @@ class ScenarioRunner:
             with_metrics=with_metrics,
             seed=schedule.seed,
             overload=self._overload_config,
+            autoscale=self._autoscale_config,
             anti_entropy_s=params.get("anti_entropy_s"),
             progress=self._progress,
         )
@@ -155,6 +165,16 @@ class ScenarioRunner:
             self.engine.sample()
         elif not self.engine.maybe_sample():
             return
+        if self._current_phase and self.harness.fleet_controllers:
+            # footprint evidence rides the SLO cadence: per-phase active
+            # cell counts feed the steady-trough footprint verdict
+            active = sum(
+                len(ext.active_cells())
+                for ext in self.harness.fleet_controllers
+            )
+            self._autoscale_samples.setdefault(
+                self._current_phase, []
+            ).append(active)
         timeline = get_loadgen_timeline()
         for target in self.engine.targets:
             for window, _secs in self.engine.windows:
@@ -365,6 +385,7 @@ class ScenarioRunner:
         }
 
     def _start_phase(self, name: str) -> None:
+        self._current_phase = name
         get_loadgen_timeline().phase_start(name)
         get_flight_recorder().record(
             "__loadgen__", "phase_start", phase=name, scenario=self.schedule.scenario
@@ -464,6 +485,63 @@ class ScenarioRunner:
             "wait_ms": round((time.perf_counter() - t0) * 1000, 1),
         }
 
+    def _latch_autoscale_footprint(self) -> None:
+        """The elasticity acceptance (docs/guides/elastic-fleet.md):
+        mean active cells during the configured trough phase over the
+        static fleet size must stay <= max_ratio — a fleet that never
+        scales back down fails the run even with every latency SLO
+        green. Latched like any breach; the ratio lands in
+        ``extra.autoscale`` for the bench gate's
+        diurnal_autoscale.steady_footprint_ratio stage."""
+        controllers = self.harness.fleet_controllers
+        if not self._autoscale_config or not controllers:
+            return
+        total = sum(
+            ext.controller.num_cells if ext.controller else 0
+            for ext in controllers
+        )
+        phase_means = {
+            phase: round(sum(samples) / len(samples), 3)
+            for phase, samples in self._autoscale_samples.items()
+            if samples
+        }
+        evidence: dict = {
+            "fleet_cells": total,
+            "phase_active_cells": phase_means,
+            "controllers": [ext.status() for ext in controllers],
+        }
+        trough = self._autoscale_slo.get("trough_phase")
+        max_ratio = self._autoscale_slo.get("max_ratio")
+        if trough and max_ratio is not None and total:
+            samples = self._autoscale_samples.get(trough) or []
+            if samples:
+                ratio = (sum(samples) / len(samples)) / total
+                evidence["trough_phase"] = trough
+                evidence["max_ratio"] = float(max_ratio)
+                evidence["steady_footprint_ratio"] = round(ratio, 4)
+                if ratio > float(max_ratio):
+                    self._breached["autoscale_footprint"] = True
+                    get_loadgen_timeline().note_breach(
+                        trough, "autoscale_footprint"
+                    )
+                    get_flight_recorder().record(
+                        "__loadgen__",
+                        "autoscale_footprint_breach",
+                        phase=trough,
+                        ratio=round(ratio, 4),
+                        max_ratio=float(max_ratio),
+                    )
+                    self._progress(
+                        f"AUTOSCALE FOOTPRINT BREACH {ratio:.2f} > "
+                        f"{float(max_ratio):.2f}"
+                    )
+            else:
+                # no samples in the measured trough = the verdict input
+                # is missing, not vacuously green
+                self._breached["autoscale_footprint"] = True
+                evidence["steady_footprint_ratio"] = None
+        self._autoscale_evidence = evidence
+
     def _chaos_evidence(self) -> dict:
         """Overload/partition accounting attached to the artifact: the
         ladder's transition history + shed counters, mini_redis's
@@ -562,6 +640,11 @@ class ScenarioRunner:
                 }
         if multi:
             evidence["multi_device"] = multi
+        if self._autoscale_evidence is not None:
+            # elastic-fleet evidence: roster timeline, scale decisions,
+            # per-phase active-cell means and the steady-trough
+            # footprint ratio the bench gate reads
+            evidence["autoscale"] = self._autoscale_evidence
         publish = {}
         for i, server in enumerate(self.harness.servers):
             for ext in getattr(server.hocuspocus, "_extensions", []):
@@ -727,6 +810,8 @@ class ScenarioRunner:
                     self._progress(
                         f"CONVERGENCE FAILED: {convergence['diverged']}"
                     )
+
+            self._latch_autoscale_footprint()
 
             verdict = "fail" if any(self._breached.values()) else "pass"
             slo_status = self.engine.status()

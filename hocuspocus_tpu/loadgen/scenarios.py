@@ -28,6 +28,10 @@ workloads, Eg-walker arXiv:2409.14252 realistic-concurrency merges):
 - ``multi_device_storm`` — hot-doc skew on the per-chip cell plane: one
   mega-doc plus a small-doc population forces load-aware rebalancing
   mid-run (docs migrate between device cells with zero acked loss)
+- ``diurnal_autoscale`` — the diurnal ramp with the elastic-fleet
+  controller on: SLOs hold through the peak while the steady-trough
+  active-cell footprint drops to warm spares (ratio latched into the
+  verdict and gated)
 - ``mega_audience``    — one viral doc, few writers, a huge read
   audience through the edge tier: the replica watermark grows follower
   cells and the fan-out spreads across them (owner work stays bounded)
@@ -719,6 +723,77 @@ def mega_audience(
     )
 
 
+def diurnal_autoscale(
+    num_docs: int = 24,
+    phase_ms: int = 2500,
+    peak_rate: float = 96.0,
+    devices: int = 4,
+) -> Scenario:
+    """The diurnal ramp with the elastic-fleet controller ON
+    (docs/guides/elastic-fleet.md): the same trough → ramp → peak →
+    ramp-down shape over a multi-device cell plane, plus a long steady
+    `night` trough where the autoscaler must have parked the fleet back
+    down to warm spares. Two latched verdict inputs: the per-phase SLOs
+    (peak p99 is the `diurnal_autoscale.interactive_p99` gate stage —
+    elasticity must not cost the peak), and the **steady-trough
+    footprint ratio** — mean active cells during `night` over the
+    static fleet size — which must stay ≤ `max_ratio`
+    (`diurnal_autoscale.steady_footprint_ratio` in tools/bench_gate.py).
+    Scale-downs migrate docs over the evict-snapshot→hydrate rail with
+    zero acked loss; the runner attaches the roster timeline, scale
+    decisions and migration counts as ``extra.autoscale``."""
+    return Scenario(
+        name="diurnal_autoscale",
+        description="diurnal ramp under the elastic-fleet autoscaler: "
+        "SLOs hold while the trough footprint drops",
+        num_docs=num_docs,
+        sampled=min(8, num_docs),
+        shards=1,
+        devices=devices,
+        capacity=4096,
+        docs_per_socket=num_docs,
+        params={
+            # FleetControllerExtension tuning (loadgen/harness.py):
+            # CI-scale cadence so a 2.5s trough fits several decisions
+            "autoscale": {
+                "interval_s": 0.1,
+                "hold_ticks": 2,
+                "cooldown_ticks": 3,
+                "min_cells": 1,
+                "up_threshold": 0.75,
+                "down_threshold": 0.35,
+                # normalized so the trough (peak/8 edit units/s spread
+                # over the fleet) reads well below down_threshold while
+                # the peak saturates past up_threshold
+                "work_target": 600.0,
+                "lane_target": 64.0,
+            },
+            # runner-side verdict latch: mean active cells over the
+            # `night` phase vs. the static fleet, latched like an SLO
+            "autoscale_slo": {"trough_phase": "night", "max_ratio": 0.6},
+            "multi_device": {
+                # the rebalancer stays on (it coexists with the
+                # controller) but sweeps slowly — scale decisions own
+                # topology here, the rebalancer only polishes
+                "rebalance_interval_s": 1.0,
+                "rebalance_ratio": 2.0,
+                "rebalance_min_units": 256.0,
+            },
+        },
+        phases=[
+            PhaseSpec("trough", phase_ms, _edit_gen(peak_rate / 8)),
+            PhaseSpec("ramp_up", phase_ms, _edit_gen(peak_rate / 2)),
+            PhaseSpec(
+                "peak", phase_ms, _edit_gen(peak_rate), slo_e2e_ms=1000.0
+            ),
+            PhaseSpec("ramp_down", phase_ms, _edit_gen(peak_rate / 4)),
+            # the measured steady trough: long enough for hold_ticks +
+            # cooldown + the scale-down migrations to fully settle
+            PhaseSpec("night", phase_ms, _edit_gen(peak_rate / 8)),
+        ],
+    )
+
+
 def edge_handoff(
     num_docs: int = 8,
     phase_ms: int = 1500,
@@ -782,6 +857,7 @@ SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "overload_storm": overload_storm,
     "partition_heal": partition_heal,
     "multi_device_storm": multi_device_storm,
+    "diurnal_autoscale": diurnal_autoscale,
     "edge_fanout": edge_fanout,
     "edge_handoff": edge_handoff,
     "mega_audience": mega_audience,
@@ -797,6 +873,7 @@ BENCH_SUITE = (
     "overload_storm",
     "partition_heal",
     "multi_device_storm",
+    "diurnal_autoscale",
     "edge_fanout",
     "edge_handoff",
     "mega_audience",

@@ -366,6 +366,8 @@ class FleetView:
         }
         self.trace_returns = TraceReturnOutbox()
         self.offsets: "dict[str, ClockOffsetEstimator]" = {}
+        # set via attach_autoscale by the elastic-fleet controller
+        self.autoscale_status = None
         # cross-tier e2e: the edge-to-edge latency series the fleet SLO
         # targets (stage="total"), plus the four edge-side stages
         self.e2e_histogram = Histogram(
@@ -423,6 +425,12 @@ class FleetView:
             estimator = self.offsets[peer_id] = ClockOffsetEstimator()
         return estimator
 
+    def attach_autoscale(self, status_fn) -> None:
+        """The elastic-fleet controller (fleet/controller.py) hangs its
+        live status here; `/debug/fleet` renders it as the `autoscale`
+        section. Pass None to detach (controller teardown)."""
+        self.autoscale_status = status_fn
+
     def reset(self) -> None:
         """Back to a cold state (tests / scenario-runner isolation):
         peers, counters, offsets, identity, the e2e histogram and the
@@ -430,6 +438,7 @@ class FleetView:
         configure claims the identity again."""
         self.role = None
         self.node_id = None
+        self.autoscale_status = None
         self.peers.clear()
         self._peer_state.clear()
         self._skew_roles.clear()
@@ -635,28 +644,44 @@ class FleetView:
         return total
 
     def _epoch_skew(self) -> "dict[str, dict]":
-        """Per-role placement-epoch agreement over fresh (up) peers that
-        REPORT an epoch. Skew is only meaningful where peers derive the
-        epoch from a shared event stream — the edge role's router epochs
-        ride the same control channel; cell placement epochs are local
-        bookkeeping and are reported but never flagged."""
-        by_role: "dict[str, dict[str, int]]" = {}
+        """Per-role epoch agreement over fresh (up) peers. Skew is only
+        meaningful where peers derive an epoch from a SHARED event
+        stream, and each role now has one: edge router epochs ride the
+        control channel (as before), and — since the roster went
+        dynamic (fleet/roster.py) — cells fold the same control-channel
+        membership transitions into a `roster_epoch` published in their
+        digests. Cell *placement* epochs remain local per-instance
+        bookkeeping: reported, never flagged."""
+        placement_by_role: "dict[str, dict[str, int]]" = {}
+        roster_by_role: "dict[str, dict[str, int]]" = {}
         for node_id, state in self._peer_state.items():
             if state["state"] != "up":
                 continue
             digest = self._latest(node_id)
-            if digest is None or digest.get("placement_epoch") is None:
+            if digest is None:
                 continue
-            by_role.setdefault(str(digest["role"]), {})[node_id] = int(
-                digest["placement_epoch"]
-            )
-        return {
-            role: {
+            role = str(digest["role"])
+            if digest.get("placement_epoch") is not None:
+                placement_by_role.setdefault(role, {})[node_id] = int(
+                    digest["placement_epoch"]
+                )
+            if digest.get("roster_epoch") is not None:
+                roster_by_role.setdefault(role, {})[node_id] = int(
+                    digest["roster_epoch"]
+                )
+        result: "dict[str, dict]" = {}
+        for role in set(placement_by_role) | set(roster_by_role):
+            epochs = placement_by_role.get(role, {})
+            rosters = roster_by_role.get(role, {})
+            skew = (
+                role == "edge" and len(set(epochs.values())) > 1
+            ) or len(set(rosters.values())) > 1
+            result[role] = {
                 "epochs": epochs,
-                "skew": role == "edge" and len(set(epochs.values())) > 1,
+                "roster_epochs": rosters,
+                "skew": skew,
             }
-            for role, epochs in by_role.items()
-        }
+        return result
 
     # -- cross-tier latency --------------------------------------------------
 
@@ -740,6 +765,7 @@ class FleetView:
                 "sessions",
                 "docs",
                 "placement_epoch",
+                "roster_epoch",
                 "slo_burn",
                 "slo_breaching",
                 "queues",
@@ -783,6 +809,14 @@ class FleetView:
             "cross_tier_e2e_ms": self.cross_tier_quantiles(),
             "counters": dict(self.counters),
         }
+        if self.autoscale_status is not None:
+            # live controller state (roster, last decision, park
+            # reason) — attached by FleetControllerExtension, and a
+            # status read must never take /debug/fleet down with it
+            try:
+                payload["autoscale"] = self.autoscale_status()
+            except Exception:
+                payload["autoscale"] = {"error": "unavailable"}
         return stamp_header(payload)
 
 

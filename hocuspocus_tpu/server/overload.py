@@ -492,6 +492,18 @@ class OverloadController:
             return False
         return True
 
+    def scaling_allowed(self) -> bool:
+        """BROWNOUT-1+: hard-park fleet autoscaling
+        (fleet/controller.py). Topology churn — migrations, drains,
+        placement epochs — is deferrable background work exactly like
+        maintenance, and worse: a controller acting on brownout-shaped
+        load signals (shedding flattens them) would scale DOWN into an
+        overload, fighting the ladder's own recovery."""
+        if self.enabled and self.rung >= BROWNOUT1:
+            self.shed("autoscale_parked")
+            return False
+        return True
+
     def awareness_delay_s(self) -> float:
         """BROWNOUT-1+: stretch awareness-only broadcast ticks."""
         if self.enabled and self.rung >= BROWNOUT1:

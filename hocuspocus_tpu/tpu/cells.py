@@ -268,6 +268,8 @@ class MultiDeviceMergeExtension(Extension):
             "rebalance_ticks": 0,
             "cell_degrades": 0,
             "cell_recoveries": 0,
+            "cells_parked": 0,
+            "cells_activated": 0,
         }
         self._rebalance_handle: Optional[asyncio.TimerHandle] = None
         self._rebalance_inflight = False
@@ -461,6 +463,81 @@ class MultiDeviceMergeExtension(Extension):
                     "doc stays on the CPU path"
                 )
 
+    # -- elastic-fleet warm-spare lifecycle (fleet/controller.py) ------------
+
+    async def park_cell(self, index: int) -> dict:
+        """Scale-down to a WARM SPARE: migrate every served doc off the
+        cell over the evict-snapshot→hydrate rail, then drop it out of
+        placement. Ordering is the placement-epoch-safety contract:
+        each migration lands its override (its own epoch bump) while
+        the source is still healthy, so no epoch ever routes a doc at a
+        cell that still owns it. Unlike `degrade_cell` (the sick-chip
+        path), nothing is torn down — the arena stays allocated, the
+        registry warm, the lane merely quiesced — so `activate_cell`
+        rejoins in one epoch bump with zero rebuild cost."""
+        cell = self.cells[index]
+        migrated = declined = 0
+        for name in list(cell._docs):
+            survivors = sorted(self.placement.healthy - {index})
+            if not survivors:
+                declined += len(cell._docs)
+                break
+            # rendezvous over the survivors — the same score the map
+            # will compute once this cell is gone, minus the override
+            dst = max(
+                survivors,
+                key=lambda i: (self.placement._score(name, i), -i),
+            )
+            if await self.migrate_doc(name, index, dst):
+                migrated += 1
+            else:
+                declined += 1
+        self.placement.mark_down(index)
+        drained = not cell._docs
+        if drained:
+            # fully drained: quiesce the serving loop — a warm spare
+            # burns no flush ticks. Stragglers (declined migrations)
+            # keep their serving live; owner-first routing still finds
+            # them and the controller can retry the park next tick.
+            for serving in cell.servings():
+                serving.paused = True
+        if cell.residency is not None:
+            # warm-spare residency path: drop queued background work
+            # (hydrations/compactions for docs that just left) so the
+            # spare holds nothing but its warm arena
+            quiesce = getattr(cell.residency, "quiesce", None)
+            if quiesce is not None:
+                quiesce()
+        self.migration_stats["cells_parked"] += 1
+        get_flight_recorder().record(
+            "__plane__",
+            "cell_parked",
+            cell=index,
+            device=self.device_label(index),
+            migrated=migrated,
+            declined=declined,
+        )
+        return {
+            "cell": index,
+            "migrated": migrated,
+            "declined": declined,
+            "drained": drained,
+        }
+
+    async def activate_cell(self, index: int, instance=None) -> None:
+        """Scale-up from a warm spare: rejoin placement (one epoch
+        bump — rendezvous immediately routes ~1/N of new loads here)
+        and resume the quiesced serving/lane. Existing docs stay where
+        they are; the rebalancer drifts them over as load justifies."""
+        await self.restore_cell(index, instance)
+        self.migration_stats["cells_activated"] += 1
+        get_flight_recorder().record(
+            "__plane__",
+            "cell_activated",
+            cell=index,
+            device=self.device_label(index),
+        )
+
     def device_label(self, index: int) -> str:
         device = self.devices[index]
         return str(getattr(device, "id", index))
@@ -551,6 +628,9 @@ class MultiDeviceMergeExtension(Extension):
                 "pending_ops": pending,
                 "lane_queue_depth": lane_depth,
                 "work_units": work,
+                # monotonic, migration-invariant (hydration never
+                # credits it): what the autoscaler diffs for a rate
+                "dispatched_total": int(getattr(plane, "dispatched_total", 0)),
                 "hbm_bytes": self._cell_hbm_bytes(index),
             }
             if include_doc_loads:

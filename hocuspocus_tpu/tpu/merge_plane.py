@@ -311,6 +311,12 @@ class MergePlane:
         # over every slot instead of a Python loop over every doc.
         self.dispatched_units = np.zeros(num_docs, np.int64)
         self.validated_units = np.zeros(num_docs, np.int64)
+        # monotonic plane-wide dispatch tally, bumped ONLY at the two
+        # dispatch sites below — never by slot rebinds or residency
+        # rebuilds (hydration credits per-slot counters wholesale). The
+        # fleet autoscaler (fleet/controller.py) diffs this for a load
+        # RATE that stays honest while docs migrate between cells.
+        self.dispatched_total = 0
         # minimal-work run merge (the sequential fast path): the flush
         # classifier routes a drained column to the O(new ops) append
         # program only when every op chains off the column's RANK TAIL
@@ -1757,6 +1763,7 @@ class MergePlane:
             if len(take) > depth:
                 depth = len(take)
             self.dispatched_units[slot] += dispatched
+            self.dispatched_total += dispatched
         lane = None
         if self._lane is not None:
             # native lane drain: one C call pops up to k ops per lane
@@ -1766,7 +1773,9 @@ class MergePlane:
             if drained[0]:
                 lane = drained
                 ds = np.frombuffer(drained[11], np.int64)
-                self.dispatched_units[ds] += np.frombuffer(drained[12], np.int64)
+                lane_units = np.frombuffer(drained[12], np.int64)
+                self.dispatched_units[ds] += lane_units
+                self.dispatched_total += int(lane_units.sum())
                 built += drained[0]
                 lane_rows = np.frombuffer(drained[1], np.int64)
                 depth = max(depth, int(lane_rows.max()) + 1)

@@ -505,6 +505,24 @@ class ResidencyManager:
             self._drain_running = True
             self._spawn(self._drain_hydrations())
 
+    def quiesce(self) -> int:
+        """Warm-spare park (tpu/cells.py `park_cell`): drop every
+        QUEUED hydration. A parked cell serves nothing, so re-admitting
+        docs that just migrated away would only re-warm rows the spare
+        exists to keep free; the evicted-snapshot store is untouched —
+        any doc that genuinely comes back re-queues on activate and
+        replays its tail exactly as before. Returns the drop count."""
+        dropped = len(self._queue)
+        self._queue.clear()
+        self._queued.clear()
+        self.plane.residency_stats["hydration_queue_depth"] = 0
+        if dropped:
+            self.plane.residency_stats["hydrations_quiesced"] = (
+                self.plane.residency_stats.get("hydrations_quiesced", 0)
+                + dropped
+            )
+        return dropped
+
     async def _drain_hydrations(self) -> None:
         from .scheduler import CLASS_CATCHUP, LaneDeferred
 

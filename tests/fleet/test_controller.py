@@ -1,0 +1,297 @@
+"""Elastic-fleet decision core (fleet/controller.py) + admission policy
+(fleet/roster.py): the scale-up/scale-down decision table, hysteresis
+no-flap under an oscillating digest, brownout park/unpark, the
+projection guard, the clock-gated cross-host AdmissionGate and the
+cell-side PeerRoster epoch. All controller tests drive `observe` /
+`tick_once` with injected digest-shaped stats — no wall-clock waits."""
+
+import pytest
+
+from hocuspocus_tpu.fleet import (
+    AdmissionGate,
+    FleetController,
+    FleetControllerExtension,
+    PeerRoster,
+    cell_host,
+    qualify_cell_id,
+)
+from hocuspocus_tpu.server.overload import get_overload_controller
+
+
+@pytest.fixture(autouse=True)
+def _reset_overload():
+    controller = get_overload_controller()
+    controller.reset()
+    yield
+    controller.reset()
+
+
+def _cell(i, healthy=True, work=0.0, lane=0.0, occ=0.0):
+    """One digest-shaped per-cell stats entry (the tpu/cells.py
+    cell_stats fields the controller reads, plus the sampled rate)."""
+    return {
+        "cell": i,
+        "healthy": healthy,
+        "work_rate": work,
+        "lane_queue_depth": lane,
+        "occupancy": occ,
+    }
+
+
+def _fleet(total, active, work=0.0):
+    return [
+        _cell(i, healthy=i < active, work=work if i < active else 0.0)
+        for i in range(total)
+    ]
+
+
+def _controller(**kwargs):
+    kwargs.setdefault("num_cells", 4)
+    kwargs.setdefault("work_target", 100.0)
+    kwargs.setdefault("lane_target", 10.0)
+    kwargs.setdefault("occupancy_target", 0.8)
+    return FleetController(**kwargs)
+
+
+# -- signal --------------------------------------------------------------------
+
+
+def test_cell_load_takes_the_hottest_signal_not_the_mean():
+    ctl = _controller()
+    # a saturated lane on an otherwise idle cell still counts as hot
+    load = ctl.cell_load(_cell(0, work=50.0, lane=8.0, occ=0.2))
+    assert load == pytest.approx(0.8)  # lane 8/10, not work 0.5 or occ 0.25
+    assert ctl.cell_load(_cell(0)) == 0.0
+
+
+# -- decision table --------------------------------------------------------------
+
+
+def test_scale_up_needs_hold_ticks_then_targets_the_first_spare():
+    ctl = _controller(hold_ticks=2, cooldown_ticks=2)
+    hot = _fleet(4, active=2, work=90.0)  # signal 0.9 >= 0.75
+    assert ctl.observe(hot)["reason"] == "up_streak_building"
+    decision = ctl.observe(hot)
+    assert decision["action"] == "scale_up"
+    assert decision["cell"] == 2  # min-index spare
+    assert ctl.counters["scale_ups"] == 1
+    # the action bought a cooldown: the same hot signal now holds
+    for _ in range(2):
+        assert ctl.observe(hot)["reason"] == "cooldown"
+    # cooldown spent: the streak must REBUILD from zero
+    assert ctl.observe(hot)["reason"] == "up_streak_building"
+
+
+def test_scale_up_holds_without_spare_capacity():
+    ctl = _controller(hold_ticks=1, cooldown_ticks=0)
+    hot = _fleet(4, active=4, work=90.0)
+    assert ctl.observe(hot)["reason"] == "no_spare_capacity"
+    assert ctl.counters["scale_ups"] == 0
+
+
+def test_scale_down_targets_the_coldest_cell():
+    ctl = _controller(hold_ticks=2, cooldown_ticks=0)
+    cold = [
+        _cell(0, work=30.0),
+        _cell(1, work=10.0),
+        _cell(2, work=20.0),
+        _cell(3, healthy=False),
+    ]  # signal 0.2 <= 0.35
+    assert ctl.observe(cold)["reason"] == "down_streak_building"
+    decision = ctl.observe(cold)
+    assert decision["action"] == "scale_down"
+    assert decision["cell"] == 1  # the coldest, not the lowest index
+    assert ctl.counters["scale_downs"] == 1
+
+
+def test_scale_down_projection_guard_keeps_survivors_in_band():
+    # signal 0.3 is below the 0.35 threshold, but ONE fewer cell would
+    # carry 0.3 * 2/1 = 0.6 > projected_max 0.55 — removing the cell
+    # would land the fleet straight back in scale-up territory
+    ctl = _controller(hold_ticks=1, cooldown_ticks=0)
+    cells = [_cell(0, work=30.0), _cell(1, work=30.0), _cell(2, healthy=False)]
+    assert ctl.observe(cells)["reason"] == "survivors_too_hot"
+    assert ctl.counters["scale_downs"] == 0
+
+
+def test_scale_down_respects_min_cells():
+    ctl = _controller(hold_ticks=1, cooldown_ticks=0, min_cells=1)
+    lone = _fleet(4, active=1, work=5.0)
+    assert ctl.observe(lone)["reason"] == "at_min_cells"
+
+
+def test_oscillating_signal_never_flaps():
+    """The anti-flap acceptance: a digest oscillating across the
+    thresholds every tick resets the streaks and never scales
+    anything, exactly like the PR-12 brownout ladder's hold."""
+    ctl = _controller(hold_ticks=3, cooldown_ticks=0)
+    hot = _fleet(4, active=2, work=90.0)  # 0.9: above up
+    cold = _fleet(4, active=2, work=10.0)  # 0.1: below down
+    mid = _fleet(4, active=2, work=55.0)  # 0.55: in band
+    for _ in range(10):
+        assert ctl.observe(hot)["action"] == "hold"
+        assert ctl.observe(cold)["action"] == "hold"
+    for _ in range(10):
+        assert ctl.observe(hot)["action"] == "hold"
+        assert ctl.observe(mid)["action"] == "hold"
+    assert ctl.counters["scale_ups"] == 0
+    assert ctl.counters["scale_downs"] == 0
+    assert not ctl.decisions  # the history keeps transitions only
+
+
+def test_brownout_parks_scaling_and_unpark_rearms_cooldown():
+    ctl = _controller(hold_ticks=1, cooldown_ticks=2)
+    hot = _fleet(4, active=2, work=90.0)
+    parked = ctl.observe(hot, scaling_allowed=False, park_reason="brownout:red")
+    assert parked["action"] == "park"
+    assert ctl.parked and ctl.park_reason == "brownout:red"
+    assert ctl.counters["parks"] == 1
+    assert len(ctl.decisions) == 1  # the transition tick only
+    for _ in range(5):
+        ctl.observe(hot, scaling_allowed=False, park_reason="brownout:red")
+    assert ctl.counters["parks"] == 1
+    assert len(ctl.decisions) == 1  # steady parked ticks aren't history
+    # brownout over: unpark is recorded, then a FULL cooldown runs
+    # before the first post-brownout action
+    assert ctl.observe(hot)["reason"] == "cooldown"
+    assert not ctl.parked
+    assert ctl.counters["unparks"] == 1
+    assert [d["action"] for d in ctl.decisions] == ["park", "unpark"]
+    assert ctl.observe(hot)["reason"] == "cooldown"
+    # cooldown spent; hold_ticks=1 means the next hot tick may act
+    assert ctl.observe(hot)["action"] == "scale_up"
+    assert ctl.counters["scale_ups"] == 1
+
+
+# -- the extension's tick loop (injected digests, no plane) ---------------------
+
+
+async def test_extension_tick_actuates_through_the_overrides():
+    ups, downs = [], []
+
+    async def scale_up(index):
+        ups.append(index)
+
+    async def scale_down(index):
+        downs.append(index)
+
+    ext = FleetControllerExtension(
+        interval_s=0.01, scale_up=scale_up, scale_down=scale_down
+    )
+    ext.controller = _controller(hold_ticks=1, cooldown_ticks=0)
+    decision = await ext.tick_once(cells=_fleet(4, active=2, work=90.0))
+    assert decision["action"] == "scale_up"
+    assert ups == [2]
+    cold = _fleet(4, active=3, work=10.0)
+    decision = await ext.tick_once(cells=cold)
+    assert decision["action"] == "scale_down"
+    assert downs == [0]
+    assert ext.actuation == {
+        "activations": 1,
+        "parks": 1,
+        "docs_migrated": 0,
+        "failures": 0,
+    }
+    assert [entry["action"] for entry in ext.timeline] == [
+        "scale_up",
+        "scale_down",
+    ]
+    status = ext.status()
+    assert status["enabled"] and status["counters"]["scale_ups"] == 1
+
+
+async def test_extension_parks_while_the_ladder_is_at_brownout():
+    ext = FleetControllerExtension(interval_s=0.01)
+    ext.controller = _controller(hold_ticks=1, cooldown_ticks=0)
+    overload = get_overload_controller()
+    overload.enable()
+    overload.inject_pressure(1)  # BROWNOUT-1
+    hot = _fleet(4, active=2, work=90.0)
+    decision = await ext.tick_once(cells=hot)
+    assert decision["action"] == "park"
+    assert decision["reason"] == "brownout:brownout1"
+    # parking is accounted as shed deferrable work, like maintenance
+    assert overload.shed_total.value(reason="autoscale_parked") >= 1
+    overload.reset()  # ladder back to cold GREEN
+    decision = await ext.tick_once(cells=hot)
+    assert decision["action"] != "park"
+    assert ext.controller.counters["unparks"] == 1
+
+
+# -- cross-host admission policy -------------------------------------------------
+
+
+class _FakeEstimator:
+    def __init__(self, samples=0, rtt_s=None):
+        self.samples = samples
+        self.rtt_s = rtt_s
+
+
+def test_cell_id_qualification_roundtrip():
+    assert qualify_cell_id("host-b", "cell-0") == "host-b/cell-0"
+    assert qualify_cell_id(None, "cell-0") == "cell-0"
+    assert qualify_cell_id("host-b", "host-a/cell-0") == "host-a/cell-0"
+    assert cell_host("host-b/cell-0") == "host-b"
+    assert cell_host("cell-0") is None
+
+
+def test_admission_gate_local_cells_admit_immediately():
+    gate = AdmissionGate(local_host="host-a")
+    assert gate.evaluate("cell-0") == (True, "local")  # bare legacy id
+    assert gate.evaluate("host-a/cell-1") == (True, "local")
+    gate.note_local(True)
+    gate.note_local(False)  # heartbeat: no-op
+    assert gate.counters["admitted_local"] == 1
+
+
+def test_admission_gate_holds_foreign_cells_until_clock_resolves():
+    gate = AdmissionGate(local_host="host-a", min_samples=2, max_rtt_s=0.5)
+    cell = "host-b/cell-0"
+    admit, reason = gate.evaluate(cell)
+    assert (admit, reason) == (False, "clock_unresolved:0/2")
+    admit, reason = gate.evaluate(cell, _FakeEstimator(samples=1, rtt_s=0.01))
+    assert (admit, reason) == (False, "clock_unresolved:1/2")
+    # resolution QUALITY gates admission, never offset magnitude: a
+    # wide RTT means the estimate (and staleness math) is garbage
+    admit, reason = gate.evaluate(cell, _FakeEstimator(samples=3, rtt_s=0.9))
+    assert (admit, reason) == (False, "rtt_unbounded:0.900s")
+    admit, reason = gate.evaluate(cell, _FakeEstimator(samples=3, rtt_s=None))
+    assert (admit, reason) == (False, "rtt_unbounded:none")
+    admit, reason = gate.evaluate(cell, _FakeEstimator(samples=2, rtt_s=0.01))
+    assert (admit, reason) == (True, "clock_resolved")
+
+
+def test_admission_gate_pending_lifecycle_and_expiry():
+    gate = AdmissionGate(local_host="host-a")
+    cell = "host-b/cell-0"
+    assert gate.hold(cell, "clock_unresolved:0/2") is True
+    assert gate.hold(cell, "clock_unresolved:1/2") is False  # heartbeat
+    assert gate.counters["held_pending"] == 1
+    assert gate.status()["pending"] == {cell: "clock_unresolved:1/2"}
+    assert gate.admit(cell) is True  # foreign join completing
+    assert gate.admit(cell) is False  # heartbeat after admission
+    assert gate.counters["admitted_foreign"] == 1
+    # expiry keys off the LAST announce, not pending age: a re-held
+    # (still-announcing) cell survives, a silent one expires
+    gate.hold(cell, "clock_unresolved:0/2")
+    gate.pending[cell]["last_seen"] -= 10.0
+    assert gate.expire(timeout_s=5.0) == [cell]
+    assert not gate.pending
+    assert gate.counters["pending_expired"] == 1
+    gate.hold(cell, "clock_unresolved:0/2")
+    assert gate.expire(timeout_s=5.0) == []
+
+
+def test_peer_roster_epoch_counts_transitions_not_heartbeats():
+    roster = PeerRoster()
+    assert roster.note("cell-0", "healthy") is True
+    assert roster.note("cell-0", "healthy") is False  # heartbeat no-op
+    assert roster.note("host-b/cell-1", "healthy") is True
+    assert roster.note("cell-0", "draining") is True
+    assert roster.note("cell-0", "down") is True
+    assert roster.note("cell-0", "down") is False  # unknown: no-op
+    assert roster.epoch == 4
+    assert roster.table() == {
+        "epoch": 4,
+        "peers": {"host-b/cell-1": "healthy"},
+    }

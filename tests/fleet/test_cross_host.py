@@ -1,0 +1,283 @@
+"""Cross-host cell admission end-to-end (fleet/roster.py + edge tier):
+two in-process "hosts" share one MiniRedis relay bus. A cell announcing
+with a foreign host qualifier is HELD pending until its clock offset
+resolves over PING/PONG probes, joins through the normal epoch-bump
+machinery, serves placement-routed docs, and hands its docs off with
+zero acked-update loss when it scales back down (the PR-13 drain is the
+cross-host scale-down actuation)."""
+
+import asyncio
+
+import pytest
+
+from hocuspocus_tpu.crdt import encode_state_as_update
+from hocuspocus_tpu.edge import (
+    CellIngressExtension,
+    EdgeGatewayExtension,
+    EdgeServer,
+)
+from hocuspocus_tpu.fleet import AdmissionGate
+from hocuspocus_tpu.net.mini_redis import MiniRedis
+from hocuspocus_tpu.observability.fleet import get_fleet_view
+from hocuspocus_tpu.provider import HocuspocusProvider
+from hocuspocus_tpu.provider.inprocess import InProcessProviderSocket
+from hocuspocus_tpu.server import Configuration, Server
+from hocuspocus_tpu.server.overload import get_overload_controller
+
+from tests.utils import wait_for, wait_synced
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    get_overload_controller().reset()
+    get_fleet_view().reset()
+    yield
+    get_overload_controller().reset()
+    get_fleet_view().reset()
+
+
+class TwoHostTopology:
+    """One relay bus, cells tagged per 'host', edges on host-a."""
+
+    def __init__(self) -> None:
+        self.redis = None
+        self.cells = []  # (Server, CellIngressExtension)
+        self.edges = []  # (EdgeServer, EdgeGatewayExtension)
+        self.sockets = []
+        self.providers = []
+
+    async def start_bus(self):
+        self.redis = await MiniRedis().start()
+        return self
+
+    async def add_cell(self, cell_id, host_id):
+        ext = CellIngressExtension(
+            cell_id=cell_id,
+            host_id=host_id,
+            host="127.0.0.1",
+            port=self.redis.port,
+            announce_interval_s=0.2,
+        )
+        server = Server(Configuration(quiet=True, extensions=[ext]))
+        await server.listen(port=0)
+        self.cells.append((server, ext))
+        return server, ext
+
+    async def add_edge(self, edge_id, **kwargs):
+        gx = EdgeGatewayExtension(
+            edge_id=edge_id,
+            host="127.0.0.1",
+            port=self.redis.port,
+            host_id="host-a",
+            **kwargs,
+        )
+        server = EdgeServer(Configuration(quiet=True, extensions=[gx]))
+        await server.listen(port=0)
+        self.edges.append((server, gx))
+        return server, gx
+
+    def provider(self, edge_index, name):
+        socket = InProcessProviderSocket(self.edges[edge_index][0])
+        self.sockets.append(socket)
+        provider = HocuspocusProvider(name=name, websocket_provider=socket)
+        provider.attach()
+        self.providers.append(provider)
+        return provider
+
+    def cell_owning(self, name):
+        for server, ext in self.cells:
+            if name in server.hocuspocus.documents:
+                return server, ext
+        return None, None
+
+    async def close(self):
+        for provider in self.providers:
+            provider.destroy()
+        for socket in self.sockets:
+            socket.destroy()
+        await asyncio.sleep(0)
+        for server, _ in self.edges + self.cells:
+            await server.destroy()
+        if self.redis is not None:
+            await self.redis.stop()
+
+
+async def test_foreign_cell_pends_then_joins_epoch_safe_and_serves():
+    """The admission acceptance: a second-host cell's first CELL_UP is
+    deterministically HELD (no routable membership), the PING/PONG
+    probe chain resolves its clock, and the join rides a router epoch
+    bump — after which placement-routed docs are served by the foreign
+    cell and converge across edges byte-identically."""
+    topo = await TwoHostTopology().start_bus()
+    try:
+        await topo.add_cell("cell-0", "host-a")
+        _, gx = await topo.add_edge("edge-0")
+        gateway = gx.gateway
+        await wait_for(
+            lambda: gateway.router.healthy_cells() == ["host-a/cell-0"]
+        )
+        epoch_before = gateway.router.epoch
+        foreign_server, foreign_ext = await topo.add_cell("cell-0", "host-b")
+        assert foreign_ext.cell_id == "host-b/cell-0"
+        # held first: the gate needs min_samples probe replies, and the
+        # first CELL_UP is evaluated before any probe ever went out
+        await wait_for(lambda: gateway.counters["admissions_pending"] >= 1)
+        # ... then admitted once the offset estimator resolves
+        await wait_for(
+            lambda: "host-b/cell-0" in gateway.router.healthy_cells(),
+            timeout=15,
+        )
+        assert gateway.counters["admissions_foreign"] == 1
+        assert not gateway.admission.pending
+        assert gateway.router.epoch > epoch_before  # the epoch-bump join
+        estimator = get_fleet_view().offsets["host-b/cell-0"]
+        assert estimator.samples >= gateway.admission.min_samples
+
+        # the foreign cell is a first-class rendezvous target: find a
+        # doc the router places THERE and drive it from two edges
+        await topo.add_edge("edge-1")
+        await wait_for(
+            lambda: "host-b/cell-0"
+            in topo.edges[1][1].gateway.router.healthy_cells(),
+            timeout=15,
+        )
+        name = next(
+            f"xh-{i}"
+            for i in range(128)
+            if gateway.router.route(f"xh-{i}") == "host-b/cell-0"
+        )
+        writer = topo.provider(0, name)
+        reader = topo.provider(1, name)
+        await wait_synced(writer, reader)
+        assert name in foreign_server.hocuspocus.documents
+        writer.document.get_text("body").insert(0, "from-host-a ")
+        await wait_for(
+            lambda: "from-host-a" in str(reader.document.get_text("body"))
+        )
+        await wait_for(
+            lambda: encode_state_as_update(writer.document)
+            == encode_state_as_update(reader.document)
+        )
+        # both cells watched the same control stream: equal roster epochs
+        await wait_for(
+            lambda: topo.cells[0][1].roster.table()
+            == topo.cells[1][1].roster.table()
+        )
+    finally:
+        await topo.close()
+
+
+async def test_unresolved_clock_skew_keeps_the_cell_pending():
+    """A peer whose probes never resolve (RTT above the bound — the
+    unresolved-skew stand-in) stays announced-but-unroutable for as
+    long as it keeps announcing; the local fleet serves on."""
+    topo = await TwoHostTopology().start_bus()
+    try:
+        await topo.add_cell("cell-0", "host-a")
+        _, gx = await topo.add_edge(
+            "edge-0",
+            admission=AdmissionGate(local_host="host-a", max_rtt_s=-1.0),
+        )
+        gateway = gx.gateway
+        await wait_for(
+            lambda: gateway.router.healthy_cells() == ["host-a/cell-0"]
+        )
+        await topo.add_cell("cell-0", "host-b")
+        await wait_for(lambda: "host-b/cell-0" in gateway.admission.pending)
+        # probes flow (liveness is fine) yet admission never completes
+        await wait_for(
+            lambda: getattr(
+                get_fleet_view().offsets.get("host-b/cell-0"), "samples", 0
+            )
+            >= 2,
+            timeout=15,
+        )
+        assert gateway.router.healthy_cells() == ["host-a/cell-0"]
+        reason = gateway.admission.pending["host-b/cell-0"]["reason"]
+        assert reason.startswith("rtt_unbounded")
+        assert gateway.counters["admissions_foreign"] == 0
+        # the held cell costs nothing: local docs still admit + serve
+        provider = topo.provider(0, "local-doc")
+        await wait_synced(provider)
+        assert "local-doc" in topo.cells[0][0].hocuspocus.documents
+    finally:
+        await topo.close()
+
+
+async def test_cross_host_scale_down_drain_loses_nothing_acked():
+    """The scale-down acceptance against the surviving reference
+    client: drain the FOREIGN cell mid-edit (the autoscaler's
+    cross-host actuation is exactly the PR-13 drain handoff) — no
+    client-visible disconnect, everything acknowledged survives, and
+    the post-drain state converges byte-identically on the survivor."""
+    topo = await TwoHostTopology().start_bus()
+    try:
+        await topo.add_cell("cell-0", "host-a")
+        _, gx = await topo.add_edge("edge-0")
+        gateway = gx.gateway
+        await wait_for(
+            lambda: gateway.router.healthy_cells() == ["host-a/cell-0"]
+        )
+        foreign_server, foreign_ext = await topo.add_cell("cell-0", "host-b")
+        await topo.add_edge("edge-1")
+        for _, edge_gx in topo.edges:
+            await wait_for(
+                lambda g=edge_gx.gateway: len(g.router.healthy_cells()) == 2,
+                timeout=15,
+            )
+        name = next(
+            f"sd-{i}"
+            for i in range(128)
+            if gateway.router.route(f"sd-{i}") == "host-b/cell-0"
+        )
+        writer = topo.provider(0, name)
+        reader = topo.provider(1, name)
+        await wait_synced(writer, reader)
+        assert name in foreign_server.hocuspocus.documents
+        writer.document.get_text("body").insert(0, "acked-before-scale-down ")
+        await wait_for(
+            lambda: "acked-before-scale-down"
+            in str(reader.document.get_text("body"))
+        )
+        closes = []
+        for provider in (writer, reader):
+            provider.on("close", lambda *a, **k: closes.append("close"))
+            provider.on(
+                "authentication_failed", lambda *a, **k: closes.append("denied")
+            )
+
+        async def live_edits():
+            for i in range(15):
+                writer.document.get_text("body").insert(0, f"live{i};")
+                await asyncio.sleep(0.01)
+
+        edit_task = asyncio.ensure_future(live_edits())
+        await foreign_server.drain(timeout_secs=5)
+        await edit_task
+        # both directions flow through the survivor after the handoff
+        writer.document.get_text("body").insert(0, "post-scale-down-w ")
+        await wait_for(
+            lambda: "post-scale-down-w"
+            in str(reader.document.get_text("body")),
+            timeout=15,
+        )
+        reader.document.get_text("body").insert(0, "post-scale-down-r ")
+        await wait_for(
+            lambda: "post-scale-down-r"
+            in str(writer.document.get_text("body")),
+            timeout=15,
+        )
+        await wait_for(
+            lambda: encode_state_as_update(writer.document)
+            == encode_state_as_update(reader.document)
+        )
+        text = str(reader.document.get_text("body"))
+        assert "acked-before-scale-down" in text
+        for i in range(15):
+            assert f"live{i};" in text, f"acked edit live{i} lost in drain"
+        assert not closes, f"client-visible disconnect in scale-down: {closes}"
+        survivor, _ = topo.cell_owning(name)
+        assert survivor is not None and survivor is not foreign_server
+        assert gateway.router.state_of("host-b/cell-0") == "draining"
+    finally:
+        await topo.close()

@@ -254,7 +254,7 @@ def test_fleet_view_stale_down_transitions_hit_fleet_ring():
     assert view.status()["totals"]["fresh"] == 0
 
 
-def test_fleet_view_epoch_skew_flags_edges_not_cells():
+def test_fleet_view_epoch_skew_flags_shared_stream_epochs_per_role():
     recorder = get_flight_recorder()
     recorder.forget("__fleet__")
     view = FleetView()
@@ -280,7 +280,7 @@ def test_fleet_view_epoch_skew_flags_edges_not_cells():
     assert "epoch_skew_detected" in [
         e["event"] for e in recorder.events("__fleet__")
     ]
-    # cell placement epochs are local bookkeeping: reported, never flagged
+    # cell PLACEMENT epochs are local bookkeeping: reported, never flagged
     view.ingest(
         build_digest(role="cell", node_id="cell-0", extra={"placement_epoch": 1})
     )
@@ -291,6 +291,58 @@ def test_fleet_view_epoch_skew_flags_edges_not_cells():
     view.refresh_gauges()
     assert view.epoch_skew_gauge.value(role="edge") == 1.0
     assert view.epoch_skew_gauge.value(role="cell") == 0.0
+    # cell ROSTER epochs derive from the shared control stream
+    # (fleet/roster.py PeerRoster) — divergence there IS the skew
+    view.ingest(
+        build_digest(
+            role="cell",
+            node_id="cell-0",
+            extra={"placement_epoch": 1, "roster_epoch": 3},
+        )
+    )
+    view.ingest(
+        build_digest(
+            role="cell",
+            node_id="cell-1",
+            extra={"placement_epoch": 7, "roster_epoch": 3},
+        )
+    )
+    cell_skew = view._epoch_skew()["cell"]
+    assert not cell_skew["skew"]
+    assert cell_skew["roster_epochs"] == {"cell-0": 3, "cell-1": 3}
+    view.ingest(
+        build_digest(
+            role="cell",
+            node_id="cell-1",
+            extra={"placement_epoch": 7, "roster_epoch": 5},
+        )
+    )
+    cell_skew = view._epoch_skew()["cell"]
+    assert cell_skew["skew"]  # a missed membership transition
+    assert cell_skew["epochs"] == {"cell-0": 1, "cell-1": 7}  # still reported
+    view.refresh_gauges()
+    assert view.epoch_skew_gauge.value(role="cell") == 1.0
+
+
+def test_fleet_view_autoscale_section_reflects_the_attached_controller():
+    """`/debug/fleet` gains an `autoscale` section fed through the
+    attach seam; a crashing status callback degrades to an error stub
+    instead of taking the whole debug payload down."""
+    view = FleetView()
+    assert "autoscale" not in view.status()
+    view.attach_autoscale(
+        lambda: {"enabled": True, "roster": {"active": [0, 1], "total": 4}}
+    )
+    section = view.status()["autoscale"]
+    assert section["roster"] == {"active": [0, 1], "total": 4}
+
+    def _boom():
+        raise RuntimeError("controller mid-teardown")
+
+    view.attach_autoscale(_boom)
+    assert view.status()["autoscale"] == {"error": "unavailable"}
+    view.attach_autoscale(None)  # controller teardown detaches
+    assert "autoscale" not in view.status()
 
 
 def test_fleet_rollups_skip_empty_peers():

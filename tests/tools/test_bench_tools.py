@@ -330,3 +330,64 @@ def test_gate_extracts_edge_fanout_cross_tier_e2e_p99():
         "scenarios": {"edge_fanout": {"verdict": "pass", "phase_p99_ms": {}}},
     }
     assert "edge_fanout.cross_tier_e2e_p99" not in bench_gate.stage_p99s(old)
+
+
+def test_gate_extracts_diurnal_autoscale_stages():
+    """diurnal_autoscale gates TWO stages: the peak-phase p99 (latency
+    while the controller scales the fleet under load) and the
+    steady-trough footprint ratio (mean active cells over `night` /
+    static fleet — dimensionless, but a fleet that stops scaling back
+    down regresses it through the same relative compare). Rounds
+    predating the autoscale evidence simply lack the ratio stage."""
+    payload = _artifact()
+    payload["extra"]["scenario_suite"] = {
+        "verdict": "pass",
+        "scenarios": {
+            "diurnal_autoscale": {
+                "verdict": "pass",
+                "breached": [],
+                "phase_p99_ms": {"trough": 2.0, "peak": 12.0, "night": 2.0},
+                "autoscale": {
+                    "fleet_cells": 4,
+                    "steady_footprint_ratio": 0.25,
+                    "scale_ups": 3,
+                    "scale_downs": 3,
+                },
+            }
+        },
+    }
+    stages = bench_gate.stage_p99s(payload)
+    assert stages["diurnal_autoscale.interactive_p99"] == 12.0
+    assert stages["diurnal_autoscale.steady_footprint_ratio"] == 0.25
+    # peak p99 regression fails the round
+    current = json.loads(json.dumps(payload))
+    current["extra"]["scenario_suite"]["scenarios"]["diurnal_autoscale"][
+        "phase_p99_ms"
+    ]["peak"] = 120.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert any("diurnal_autoscale.interactive_p99" in r for r in regressions)
+    # a fleet that stopped scaling down fails even with latency green
+    current = json.loads(json.dumps(payload))
+    current["extra"]["scenario_suite"]["scenarios"]["diurnal_autoscale"][
+        "autoscale"
+    ]["steady_footprint_ratio"] = 1.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert any(
+        "diurnal_autoscale.steady_footprint_ratio" in r for r in regressions
+    )
+    # pre-autoscale rounds: no ratio stage, no false alarm
+    old = _artifact()
+    old["extra"]["scenario_suite"] = {
+        "verdict": "pass",
+        "scenarios": {
+            "diurnal_autoscale": {"verdict": "pass", "phase_p99_ms": {}}
+        },
+    }
+    assert (
+        "diurnal_autoscale.steady_footprint_ratio"
+        not in bench_gate.stage_p99s(old)
+    )

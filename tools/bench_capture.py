@@ -226,6 +226,39 @@ def run_scenarios(
                 }
                 for instance, info in multi.items()
             }
+        autoscale = (result.get("extra") or {}).get("autoscale")
+        if autoscale:
+            # elasticity evidence: the steady-trough footprint ratio is
+            # the diurnal_autoscale.steady_footprint_ratio gate stage;
+            # the per-phase active-cell means + decision/migration
+            # accounting make "the fleet breathed with the load" (and
+            # scaled back down) checkable from the manifest alone
+            controllers = autoscale.get("controllers") or []
+            entry["autoscale"] = {
+                "fleet_cells": autoscale.get("fleet_cells"),
+                "steady_footprint_ratio": autoscale.get(
+                    "steady_footprint_ratio"
+                ),
+                "phase_active_cells": autoscale.get("phase_active_cells"),
+                "scale_ups": sum(
+                    int(
+                        ((c.get("counters") or {}).get("scale_ups", 0))
+                    )
+                    for c in controllers
+                ),
+                "scale_downs": sum(
+                    int(
+                        ((c.get("counters") or {}).get("scale_downs", 0))
+                    )
+                    for c in controllers
+                ),
+                "docs_migrated": sum(
+                    int(
+                        ((c.get("actuation") or {}).get("docs_migrated", 0))
+                    )
+                    for c in controllers
+                ),
+            }
         suite["scenarios"][name] = entry
         _log(f"scenario {name}: {result.get('verdict')}")
         if result.get("verdict") != "pass":

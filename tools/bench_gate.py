@@ -16,7 +16,14 @@ SyncStep2 through the on-device catch-up pack), the overload control
 plane's
 `extra.scenario_suite.scenarios.overload_storm.phase_p99_ms.storm`
 (gated as `overload_storm.interactive_p99`: interactive edit p99 while
-the brownout ladder is at RED and shedding), and the durability plane's
+the brownout ladder is at RED and shedding), the elastic fleet's
+`...scenarios.diurnal_autoscale.phase_p99_ms.peak` (gated as
+`diurnal_autoscale.interactive_p99`: peak-phase p99 while the
+autoscaler scales the cell fleet under the load) and
+`...diurnal_autoscale.autoscale.steady_footprint_ratio` (gated as
+`diurnal_autoscale.steady_footprint_ratio`: mean active cells over the
+steady trough / static fleet — a fleet that stops scaling back down
+regresses this even with latency green), and the durability plane's
 `extra.wal_load.append_p99_ms` +
 `extra.wal_load.wal_on.merge_to_last_write_p99_ms` — and exits nonzero
 when any stage regressed beyond the tolerance. Wired as an OPT-IN CI/verify step
@@ -179,6 +186,28 @@ def stage_p99s(payload: dict) -> "dict[str, float]":
         # fanout-phase p99 is measured writer->edge->cell->edge->reader
         # under a door-admitted join storm — a regression here means
         # the split front door stopped being a constant tax
+        # elastic-fleet stages (docs/guides/elastic-fleet.md): the
+        # diurnal_autoscale peak-phase p99 is measured while the
+        # controller scales the cell fleet under it — a regression
+        # means elasticity started taxing the interactive path — and
+        # the steady-trough footprint ratio (mean active cells during
+        # `night` / static fleet, dimensionless but gated through the
+        # same relative check) catches a fleet that stopped scaling
+        # back down
+        diurnal = (suite.get("scenarios") or {}).get("diurnal_autoscale")
+        if isinstance(diurnal, dict):
+            p99 = (diurnal.get("phase_p99_ms") or {}).get("peak")
+            if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+                stages["diurnal_autoscale.interactive_p99"] = float(p99)
+            autoscale = diurnal.get("autoscale")
+            if isinstance(autoscale, dict):
+                ratio = autoscale.get("steady_footprint_ratio")
+                if isinstance(ratio, (int, float)) and not isinstance(
+                    ratio, bool
+                ):
+                    stages["diurnal_autoscale.steady_footprint_ratio"] = float(
+                        ratio
+                    )
         edge = (suite.get("scenarios") or {}).get("edge_fanout")
         if isinstance(edge, dict):
             p99 = (edge.get("phase_p99_ms") or {}).get("fanout")
