@@ -280,6 +280,12 @@ def main() -> None:
             suite = cpu_smoke.get("extra", {}).get("scenario_suite")
             if suite is not None:
                 capture["extra"]["scenario_suite"] = suite
+            # same: the saturation ramp + headroom model ran against the
+            # CURRENT tree — hoist it so the gate's higher-is-better
+            # wire_saturation stages see fresh numbers on stale rounds
+            wire_sat = cpu_smoke.get("extra", {}).get("wire_saturation")
+            if wire_sat is not None:
+                capture["extra"]["wire_saturation"] = wire_sat
         else:
             # a broken build must NOT read as a passing bench: surface
             # the smoke failure prominently and in the note itself
@@ -674,6 +680,18 @@ def run_bench() -> None:
         except Exception as error:
             wire_load = {"error": repr(error)[:300]}
 
+    # wire-saturation + headroom-model closure (observability/costs.py):
+    # direct-drive ingress ramp to the loop thread's measured wall, with
+    # the per-frame cost ledger on — the headroom model's predicted
+    # sustainable rate must land within 2x of the measured saturation
+    wire_saturation = None
+    if os.environ.get("BENCH_WIRE_SATURATION", "1") != "0":
+        _log("inner: wire-saturation pass ...")
+        try:
+            wire_saturation = _measure_wire_saturation()
+        except Exception as error:
+            wire_saturation = {"error": repr(error)[:300]}
+
     # broadcast fan-out storm (server/fanout.py): frames saved by
     # per-tick coalescing, catch-up tiering, join-storm cache hit rate
     fanout = None
@@ -772,6 +790,8 @@ def run_bench() -> None:
         result["extra"]["catchup_storm"] = storm
     if wire_load is not None:
         result["extra"]["wire_load"] = wire_load
+    if wire_saturation is not None:
+        result["extra"]["wire_saturation"] = wire_saturation
     if wal_load is not None:
         result["extra"]["wal_load"] = wal_load
     if fanout is not None:
@@ -839,6 +859,11 @@ def _measure_scenario_suite() -> dict:
                 "ops_measured": result["extra"]["ops_measured"],
                 "ops_failed": result["extra"]["ops_failed"],
             }
+            wire_sat = result["extra"].get("wire_saturation")
+            if wire_sat is not None:
+                # headroom evidence (wire_saturation scenario): per-rung
+                # offered vs achieved frames/s + the cost attribution
+                suite["scenarios"][name]["wire_saturation"] = wire_sat
             fleet = result["extra"].get("fleet")
             if fleet is not None:
                 # fleet plane evidence (edge topologies): digest counts,
@@ -1142,6 +1167,135 @@ def _measure_wire_load() -> dict:
         },
         "served_p99_ms": served["value"],
         "elapsed_s": round(elapsed, 1),
+    }
+
+
+def _measure_wire_saturation() -> dict:
+    """Wire-saturation + headroom-model closure (docs/guides/load-testing.md
+    "profiling & cost attribution"): a direct-drive micro-harness —
+    real Document, Connection and CallbackWebSocketTransport, frames
+    through the full ingress decode/apply/fan-out pipeline — ramps the
+    offered ingress rate rung by rung until the loop thread can no
+    longer keep up (achieved < ``sat_ratio`` x offered). The per-frame
+    cost ledger is on for the ramp, so the same run yields BOTH the
+    measured saturation point and the headroom model's predicted
+    sustainable rate — acceptance is the model landing within 2x of
+    the measurement, plus a non-empty top-5 cost attribution."""
+    import asyncio
+
+    from hocuspocus_tpu.crdt import Doc
+    from hocuspocus_tpu.observability.costs import get_cost_ledger
+    from hocuspocus_tpu.protocol.frames import build_update_frame
+    from hocuspocus_tpu.server.connection import Connection
+    from hocuspocus_tpu.server.document import Document
+    from hocuspocus_tpu.server.transports import CallbackWebSocketTransport
+
+    writers = int(os.environ.get("BENCH_WIRE_SAT_WRITERS", 4))
+    pool_frames = int(os.environ.get("BENCH_WIRE_SAT_POOL", 2048))
+    rung_s = float(os.environ.get("BENCH_WIRE_SAT_RUNG_S", 0.4))
+    start_rate = float(os.environ.get("BENCH_WIRE_SAT_START", 500.0))
+    max_rate = float(os.environ.get("BENCH_WIRE_SAT_MAX", 64000.0))
+    sat_ratio = float(os.environ.get("BENCH_WIRE_SAT_RATIO", 0.85))
+
+    # pre-generate the ingress frames OUTSIDE the measured ramp: one
+    # client Doc per writer, small concurrent inserts, each transaction's
+    # v1 wire delta framed exactly as a provider would send it
+    doc_name = "wire-sat"
+    pool: "list[bytes]" = []
+    for w in range(writers):
+        client = Doc()
+        client.on("update", lambda update, *rest: pool.append(
+            build_update_frame(doc_name, update)
+        ))
+        text = client.get_text("t")
+        for i in range(pool_frames // writers):
+            text.insert(len(text) % 64, f"w{w}:{i} ")
+
+    ledger = get_cost_ledger()
+    ledger.reset()
+    ledger.enable()
+
+    async def ramp() -> "tuple[list[dict], float]":
+        document = Document(doc_name)
+        sends = {"count": 0}
+
+        async def send_async(data: bytes) -> None:
+            sends["count"] += 1
+
+        async def close_async(code: int, reason: str) -> None:
+            pass
+
+        writer_transport = CallbackWebSocketTransport(send_async, close_async)
+        writer = Connection(writer_transport, None, document, "w0", {})
+        # one reader so every applied update pays the real fan-out
+        # (coalesce + frame_encode + socket write), not just the decode
+        reader_transport = CallbackWebSocketTransport(send_async, close_async)
+        Connection(reader_transport, None, document, "r0", {})
+
+        rungs = []
+        sustained = 0.0
+        rate = start_rate
+        idx = 0
+        while rate <= max_rate:
+            target = max(int(rate * rung_s), 1)
+            interval = 1.0 / rate
+            sent = 0
+            t0 = time.perf_counter()
+            while sent < target:
+                due = int((time.perf_counter() - t0) / interval) + 1
+                while sent < min(due, target):
+                    await writer.handle_message(pool[idx % len(pool)])
+                    idx += 1
+                    sent += 1
+                if sent < target:
+                    await asyncio.sleep(max(interval * 8, 0.001))
+            elapsed = max(time.perf_counter() - t0, 1e-9)
+            achieved = sent / elapsed
+            rungs.append(
+                {
+                    "offered_frames_per_s": round(rate, 1),
+                    "achieved_frames_per_s": round(achieved, 1),
+                    "frames": sent,
+                    "fanout_frames": sends["count"],
+                }
+            )
+            sustained = max(sustained, achieved)
+            if achieved < sat_ratio * rate:
+                break  # the loop thread saturated: this rung is the wall
+            rate *= 2
+        # let the trailing fan-out ticks drain before reading the ledger
+        await asyncio.sleep(0.05)
+        writer_transport.abort()
+        reader_transport.abort()
+        return rungs, sustained
+
+    try:
+        rungs, sustained = asyncio.run(ramp())
+        headroom = ledger.headroom_frames_per_s()
+        top = ledger.top_costs(5)
+        loop_ns = ledger.loop_ns_per_frame()
+    finally:
+        ledger.disable()
+
+    ratio = round(headroom / sustained, 3) if sustained else None
+    return {
+        "writers": writers,
+        "pool_frames": len(pool),
+        "rung_s": rung_s,
+        "sat_ratio": sat_ratio,
+        "rungs": rungs,
+        "saturated": rungs[-1]["achieved_frames_per_s"]
+        < sat_ratio * rungs[-1]["offered_frames_per_s"]
+        if rungs
+        else False,
+        # the gated headlines: measured saturation + model prediction
+        "frames_per_s": round(sustained, 1),
+        "headroom_frames_per_s": round(headroom, 1),
+        "headroom_ratio": ratio,
+        "headroom_within_2x": bool(ratio is not None and 0.5 <= ratio <= 2.0),
+        "loop_ns_per_frame": round(loop_ns, 1),
+        "ingress_frames": ledger.ingress_frames(),
+        "top_costs": top,
     }
 
 

@@ -84,6 +84,11 @@ class ScenarioRunner:
         self._autoscale_evidence: "Optional[dict]" = None
         self._current_phase: "Optional[str]" = None
         self._verify_convergence = bool(params.get("verify_convergence"))
+        # wire-saturation seam: params["wire_saturation"] turns the
+        # per-frame cost ledger on for the run and attaches offered vs.
+        # achieved frames/s per rung plus the headroom model's verdict
+        # inputs as extra.wire_saturation (docs/guides/load-testing.md)
+        self._wire_sat_config = params.get("wire_saturation")
         self._tracer_state = None  # (enabled, sample) to restore post-run
         self.harness = ServedLoadHarness(
             num_docs=pop["num_docs"],
@@ -393,10 +398,16 @@ class ScenarioRunner:
         self._progress(f"phase {name} start")
         self._wire_before = get_wire_telemetry().totals()
         self._lane_before = self._lane_counters() or {}
+        self._phase_wall_started = time.perf_counter()
 
     def _end_phase(self, spec: dict, summaries: "list[dict]") -> None:
         name = spec["name"]
         summary = self._phase_summary(spec)
+        summary["wall_s"] = round(
+            time.perf_counter()
+            - getattr(self, "_phase_wall_started", time.perf_counter()),
+            3,
+        )
         after = get_wire_telemetry().totals()
         summary["wire"] = {
             key: int(after[key] - self._wire_before.get(key, 0))
@@ -656,6 +667,70 @@ class ScenarioRunner:
             evidence["publish_lane"] = publish
         return evidence
 
+    def _wire_saturation_evidence(self, summaries: "list[dict]") -> dict:
+        """The wire-saturation verdict inputs: per-rung offered ops/s
+        vs. achieved ingress frames/s (phase wire deltas over measured
+        wall time), the headroom model's sustainable rate and the top-5
+        per-frame cost attribution. Two latched checks keep the verdict
+        non-vacuous: the FIRST rung must achieve at least
+        ``min_achieved_ratio`` ingress frames per offered op (later
+        rungs are allowed — expected — to saturate), and the cost
+        ledger must have produced a non-empty attribution."""
+        from ..observability.costs import get_cost_ledger
+
+        ledger = get_cost_ledger()
+        config = self._wire_sat_config or {}
+        offered_by_phase: "dict[str, int]" = {}
+        for op in self.schedule.ops:
+            offered_by_phase[op.phase] = offered_by_phase.get(op.phase, 0) + 1
+        rungs = []
+        for summary in summaries:
+            wall_s = summary.get("wall_s") or (
+                summary["planned_ms"] / 1000.0 / self.time_scale
+            )
+            wall_s = max(float(wall_s), 1e-6)
+            wire = summary.get("wire") or {}
+            offered = offered_by_phase.get(summary["name"], 0) / wall_s
+            achieved = wire.get("messages_in", 0) / wall_s
+            rungs.append(
+                {
+                    "phase": summary["name"],
+                    "wall_s": round(wall_s, 3),
+                    "offered_ops_per_s": round(offered, 1),
+                    "achieved_frames_per_s": round(achieved, 1),
+                    "bytes_in_per_s": round(
+                        wire.get("bytes_in", 0) / wall_s, 1
+                    ),
+                    "p99_ms": summary["latency_p99_ms"],
+                }
+            )
+        sustained = max(
+            (rung["achieved_frames_per_s"] for rung in rungs), default=0.0
+        )
+        headroom = ledger.headroom_frames_per_s()
+        top = ledger.top_costs(5)
+        min_ratio = float(config.get("min_achieved_ratio", 0.5))
+        if rungs:
+            first = rungs[0]
+            if first["achieved_frames_per_s"] < (
+                min_ratio * first["offered_ops_per_s"]
+            ):
+                self._breached["wire_saturation_floor"] = True
+        if not top or headroom <= 0.0:
+            # the whole point of the scenario: evidence, not vacuity
+            self._breached["wire_saturation_attribution"] = True
+        return {
+            "rungs": rungs,
+            "sustained_frames_per_s": sustained,
+            "headroom_frames_per_s": round(headroom, 1),
+            "headroom_ratio": round(headroom / sustained, 3)
+            if sustained
+            else None,
+            "loop_ns_per_frame": round(ledger.loop_ns_per_frame(), 1),
+            "ingress_frames": ledger.ingress_frames(),
+            "top_costs": top,
+        }
+
     def _lane_counters(self) -> "Optional[dict]":
         total: "dict[str, int]" = {}
         found = False
@@ -682,6 +757,15 @@ class ScenarioRunner:
         recorder = get_flight_recorder()
         get_wire_telemetry().enable()
         wire_run_before = get_wire_telemetry().totals()
+        if self._wire_sat_config:
+            # the ledger is process-global like the overload controller:
+            # reset to this run so the headroom model reads THIS
+            # scenario's loop-thread costs, not a previous run's
+            from ..observability.costs import get_cost_ledger
+
+            ledger = get_cost_ledger()
+            ledger.reset()
+            ledger.enable()
         t_setup = time.perf_counter()
         summaries: "list[dict]" = []
         timeline.begin_run(
@@ -813,6 +897,10 @@ class ScenarioRunner:
 
             self._latch_autoscale_footprint()
 
+            wire_sat = None
+            if self._wire_sat_config:
+                wire_sat = self._wire_saturation_evidence(summaries)
+
             verdict = "fail" if any(self._breached.values()) else "pass"
             slo_status = self.engine.status()
             result = {
@@ -871,6 +959,8 @@ class ScenarioRunner:
             }
             if convergence is not None:
                 result["extra"]["convergence"] = convergence
+            if wire_sat is not None:
+                result["extra"]["wire_saturation"] = wire_sat
             chaos = self._chaos_evidence()
             if chaos:
                 result["extra"].update(chaos)
@@ -904,6 +994,12 @@ class ScenarioRunner:
             from ..server.overload import get_overload_controller
 
             get_overload_controller().reset()
+        if self._wire_sat_config:
+            # same process-global discipline for the cost ledger: the
+            # next scenario must not pay this run's per-frame timers
+            from ..observability.costs import get_cost_ledger
+
+            get_cost_ledger().disable()
 
 
 async def run_scenario(

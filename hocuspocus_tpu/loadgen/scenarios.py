@@ -35,6 +35,10 @@ workloads, Eg-walker arXiv:2409.14252 realistic-concurrency merges):
 - ``mega_audience``    — one viral doc, few writers, a huge read
   audience through the edge tier: the replica watermark grows follower
   cells and the fan-out spreads across them (owner work stays bounded)
+- ``wire_saturation`` — ramping ingress edit rate with the per-frame
+  cost ledger on: the runner attaches offered vs. achieved frames/s per
+  rung, the headroom model's sustainable rate and the top-5 cost
+  attribution as ``extra.wire_saturation``
 """
 
 from __future__ import annotations
@@ -846,6 +850,73 @@ def edge_handoff(
     )
 
 
+def wire_saturation(
+    num_docs: int = 8,
+    phase_ms: int = 900,
+    base_rate: float = 30.0,
+) -> Scenario:
+    """Ramping ingress rate with the per-frame cost ledger ON
+    (docs/guides/observability.md "profiling & cost attribution"): four rungs
+    doubling the offered edit rate. The runner enables the
+    :mod:`~..observability.costs` ledger for the run and attaches
+    ``extra.wire_saturation`` — per-rung offered vs. achieved frames/s
+    (from the phase wire deltas), the headroom model's sustainable
+    rate (``hocuspocus_profile_headroom_frames_per_s``) and the top-5
+    per-frame cost attribution. tools/bench_gate.py gates
+    ``wire_saturation.frames_per_s`` and
+    ``wire_saturation.headroom_frames_per_s`` as higher-is-better
+    stages. SLOs are deliberately generous — the verdict input here is
+    throughput and attribution, not interactive latency."""
+    return Scenario(
+        name="wire_saturation",
+        description="ramping ingress rate: cost-ledger attribution + "
+        "headroom model vs. achieved frames/s",
+        num_docs=num_docs,
+        sampled=min(4, num_docs),
+        shards=1,
+        capacity=1024,
+        docs_per_socket=num_docs,
+        params={
+            # runner-side: enable the cost ledger, attach the evidence.
+            # min_achieved_ratio is a soft floor on achieved/offered for
+            # the *first* rung only (the others are allowed to saturate
+            # — that is the point of the ramp)
+            "wire_saturation": {"min_achieved_ratio": 0.5},
+        },
+        phases=[
+            PhaseSpec(
+                "rung_1x",
+                phase_ms,
+                _edit_gen(base_rate),
+                slo_e2e_ms=5000.0,
+                slo_objective=0.80,
+            ),
+            PhaseSpec(
+                "rung_2x",
+                phase_ms,
+                _edit_gen(base_rate * 2),
+                slo_e2e_ms=5000.0,
+                slo_objective=0.80,
+            ),
+            PhaseSpec(
+                "rung_4x",
+                phase_ms,
+                _edit_gen(base_rate * 4),
+                slo_e2e_ms=5000.0,
+                slo_objective=0.80,
+            ),
+            PhaseSpec(
+                "rung_8x",
+                phase_ms,
+                _edit_gen(base_rate * 8),
+                slo_e2e_ms=5000.0,
+                slo_objective=0.70,
+                error_objective=0.90,
+            ),
+        ],
+    )
+
+
 SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "smoke": smoke,
     "diurnal": diurnal,
@@ -861,6 +932,7 @@ SCENARIOS: "dict[str, Callable[..., Scenario]]" = {
     "edge_fanout": edge_fanout,
     "edge_handoff": edge_handoff,
     "mega_audience": mega_audience,
+    "wire_saturation": wire_saturation,
 }
 
 # the default suite bench.py / bench_capture run: fast enough for every
@@ -877,6 +949,7 @@ BENCH_SUITE = (
     "edge_fanout",
     "edge_handoff",
     "mega_audience",
+    "wire_saturation",
 )
 
 

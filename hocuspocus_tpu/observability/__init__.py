@@ -5,8 +5,10 @@ New capability beyond the reference (SURVEY.md §5.1/§5.5 record that the
 reference ships no tracing and no metrics exporter).
 """
 
+from .costs import CostLedger, get_cost_ledger
 from .device_watch import CompileTracker
 from .extension import Metrics
+from .profiler import SamplingProfiler, get_profiler
 from .fleet import (
     ClockOffsetEstimator,
     FleetView,
@@ -29,6 +31,7 @@ from .wire import WireTelemetry, get_wire_telemetry
 __all__ = [
     "ClockOffsetEstimator",
     "CompileTracker",
+    "CostLedger",
     "Counter",
     "FleetView",
     "FlightRecorder",
@@ -36,6 +39,7 @@ __all__ = [
     "Histogram",
     "Metrics",
     "MetricsRegistry",
+    "SamplingProfiler",
     "SloEngine",
     "SloTarget",
     "Tracer",
@@ -46,8 +50,10 @@ __all__ = [
     "disable_tracing",
     "enable_tracing",
     "fraction_slo",
+    "get_cost_ledger",
     "get_fleet_view",
     "get_flight_recorder",
+    "get_profiler",
     "get_tracer",
     "get_wire_telemetry",
     "latency_slo",

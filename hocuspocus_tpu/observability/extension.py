@@ -22,10 +22,12 @@ import time
 from typing import Optional
 
 from ..server.types import Extension, Payload
+from .costs import get_cost_ledger
 from .device_watch import compile_metrics
 from .fleet import build_digest, get_fleet_view, stamp_header
 from .flight_recorder import get_flight_recorder
 from .metrics import MetricsRegistry
+from .profiler import get_profiler
 from .slo import SloEngine, counter_ratio_slo, fraction_slo, latency_slo
 from .tracing import get_tracer
 from .wire import get_wire_telemetry
@@ -123,6 +125,24 @@ class Metrics(Extension):
                 reg.register(metric)
             except ValueError:
                 pass  # already adopted (shared registry, repeat bind)
+        # per-frame cost ledger + sampling CPU profiler (observability/
+        # costs.py, observability/profiler.py): process-global collectors
+        # adopted like the wire telemetry — the ledger's site counters,
+        # the derived headroom gauge and the profiler's overhead/burst
+        # series all render on this server's /metrics in deterministic
+        # (sorted) order
+        self.costs = get_cost_ledger()
+        for metric in self.costs.metrics():
+            try:
+                reg.register(metric)
+            except ValueError:
+                pass  # already adopted (shared registry, repeat bind)
+        self.profiler = get_profiler()
+        for metric in self.profiler.metrics():
+            try:
+                reg.register(metric)
+            except ValueError:
+                pass  # already adopted (shared registry, repeat bind)
         # compile tracker exposition (observability/device_watch.py):
         # shared by every plane/shard in the process
         for metric in compile_metrics():
@@ -187,6 +207,19 @@ class Metrics(Extension):
         # light the socket edge: wire-telemetry sites cost one attribute
         # read until this flips
         self.wire.enable()
+        # light the per-frame cost ledger and start the always-on
+        # sampling profiler (hz<=0, e.g. --profile-hz=0, keeps it off);
+        # the burst trigger rides the overload controller's loop-lag
+        # sampler — membership-checked so repeat configures (and the
+        # singleton profiler across test servers) install it once, and
+        # re-installed here after every OverloadController.reset()
+        self.costs.enable()
+        self.profiler.ensure_started()
+        from ..server.overload import get_overload_controller
+
+        controller = get_overload_controller()
+        if self.profiler.note_loop_lag not in controller.on_loop_lag:
+            controller.on_loop_lag.append(self.profiler.note_loop_lag)
         # default fleet identity (role extensions force their own later:
         # CellIngress at configure, EdgeGateway at listen)
         self.fleet.set_identity("monolith", f"monolith-{os.getpid()}", force=False)
@@ -817,8 +850,16 @@ class Metrics(Extension):
                     data,
                     {"doc": name, "events": get_flight_recorder().events(name)},
                 )
-            if path == "/debug/profile":
+            if path == "/debug/costs":
+                # per-frame cost ledger table + headroom model
+                # (docs/guides/observability.md "profiling & cost attribution")
+                self._serve_json(data, self.costs.table(wire=self.wire))
+            if path in ("/debug/profile", "/debug/profile/device"):
+                # one /debug/profile/{device,cpu} namespace; the bare
+                # path stays a device alias for existing tooling
                 self._serve_json(data, await self._run_profile(request))
+            if path == "/debug/profile/cpu":
+                self._serve_cpu_profile(data, request)
         self.http_requests.inc()
 
     def _serve_json(self, data: Payload, payload: dict, stamp: bool = True) -> None:
@@ -837,6 +878,39 @@ class Metrics(Extension):
         error = _ServeMetrics()
         error.response = data.response
         raise error
+
+    def _serve_cpu_profile(self, data: Payload, request) -> None:
+        """`GET /debug/profile/cpu`: the sampling profiler's folded-stack
+        table. Default JSON `{stats, collapsed}` with the standard
+        stamped debug header; `?format=collapsed` returns the raw
+        collapsed-stack text for flamegraph.pl / speedscope (every line
+        stays `stack count`-parseable, so the stamp rides in X- headers
+        instead)."""
+        query = getattr(getattr(request, "rel_url", None), "query", None)
+        if query is None:
+            query = getattr(request, "query", None) or {}
+        fmt = str(query.get("format", "json"))
+        profiler = self.profiler
+        if fmt in ("collapsed", "folded", "raw"):
+            from aiohttp import web
+
+            stamp = stamp_header({})
+            data.response = web.Response(
+                text=profiler.collapsed() + "\n",
+                content_type="text/plain",
+                headers={
+                    "X-Generated-Utc": str(stamp["generated_utc"]),
+                    "X-Role": str(stamp["role"]),
+                    "X-Node-Id": str(stamp["node_id"]),
+                },
+            )
+            error = _ServeMetrics()
+            error.response = data.response
+            raise error
+        self._serve_json(
+            data,
+            {"stats": profiler.stats(), "collapsed": profiler.collapsed()},
+        )
 
     async def _run_profile(self, request) -> dict:
         """On-demand `jax.profiler` capture: `GET /debug/profile?secs=N`

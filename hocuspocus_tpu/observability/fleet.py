@@ -153,6 +153,19 @@ def build_digest(
             }
     except Exception:
         pass
+    try:
+        # per-node wire-saturation headroom (observability/costs.py):
+        # every role publishes through build_digest, so /debug/fleet
+        # shows the whole fleet's modeled frames/s budget in one table
+        from .costs import get_cost_ledger
+
+        ledger = get_cost_ledger()
+        if ledger.enabled:
+            headroom = ledger.headroom_frames_per_s()
+            if headroom > 0:
+                digest["headroom_frames_per_s"] = round(headroom, 1)
+    except Exception:
+        pass
     if instance is not None:
         _fold_instance(digest, instance)
     if extra:
@@ -769,6 +782,7 @@ class FleetView:
                 "slo_burn",
                 "slo_breaching",
                 "queues",
+                "headroom_frames_per_s",
                 "edge",
                 "cell",
                 "replica",

@@ -354,6 +354,15 @@ class Tracer:
                 base["ph"] = "i"
                 base["s"] = "t"
             events.append(base)
+        try:
+            # merge the sampling profiler's recent-stack ring as instant
+            # events on the same clock, so flamegraph samples line up
+            # with the lifecycle spans in one Perfetto view
+            from .profiler import get_profiler
+
+            events.extend(get_profiler().chrome_events(origin, pid))
+        except Exception:
+            pass
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def clear(self) -> None:

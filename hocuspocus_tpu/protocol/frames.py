@@ -10,35 +10,68 @@ native functions are byte-identical (tests/protocol/test_frames.py).
 
 from __future__ import annotations
 
+import time
+
 from ..crdt.encoding import Decoder, Encoder
 from ..native import get_codec
+from ..observability.costs import get_cost_ledger
 from .sync import MESSAGE_YJS_UPDATE
+
+
+def _type_name(message_type: int) -> str:
+    from ..observability.wire import message_type_name
+
+    return message_type_name(message_type)
 
 
 def parse_frame_header(data: bytes) -> tuple[str, int, int]:
     """[varString name][varUint type] -> (name, type, payload offset)."""
+    ledger = get_cost_ledger()
+    t0 = time.perf_counter_ns() if ledger.enabled else 0
     codec = get_codec()
     if codec is not None:
-        return codec.parse_frame_header(data)
-    decoder = Decoder(data)
-    name = decoder.read_var_string()
-    msg_type = decoder.read_var_uint()
-    return name, msg_type, decoder.pos
+        parsed = codec.parse_frame_header(data)
+    else:
+        decoder = Decoder(data)
+        name = decoder.read_var_string()
+        msg_type = decoder.read_var_uint()
+        parsed = (name, msg_type, decoder.pos)
+    if ledger.enabled:
+        # varint_header: attribution detail inside frame_decode (the
+        # header's share of the per-frame budget); bytes = header bytes
+        ledger.record(
+            "varint_header",
+            _type_name(parsed[1]),
+            time.perf_counter_ns() - t0,
+            parsed[2],
+        )
+    return parsed
 
 
 def build_update_frame(name: str, update: bytes, reply: bool = False) -> bytes:
     """[name][Sync|SyncReply][yjsUpdate][update] — the broadcast frame."""
+    ledger = get_cost_ledger()
+    t0 = time.perf_counter_ns() if ledger.enabled else 0
     codec = get_codec()
     if codec is not None:
-        return codec.build_update_frame(name, update, reply)
-    from .message import MessageType
+        frame = codec.build_update_frame(name, update, reply)
+    else:
+        from .message import MessageType
 
-    encoder = Encoder()
-    encoder.write_var_string(name)
-    encoder.write_var_uint(MessageType.SyncReply if reply else MessageType.Sync)
-    encoder.write_var_uint(MESSAGE_YJS_UPDATE)
-    encoder.write_var_uint8_array(update)
-    return encoder.to_bytes()
+        encoder = Encoder()
+        encoder.write_var_string(name)
+        encoder.write_var_uint(MessageType.SyncReply if reply else MessageType.Sync)
+        encoder.write_var_uint(MESSAGE_YJS_UPDATE)
+        encoder.write_var_uint8_array(update)
+        frame = encoder.to_bytes()
+    if ledger.enabled:
+        ledger.record(
+            "frame_encode",
+            "SyncReply" if reply else "Sync",
+            time.perf_counter_ns() - t0,
+            len(frame),
+        )
+    return frame
 
 
 def build_sync_status_frame(name: str, ok: bool) -> bytes:

@@ -61,6 +61,7 @@ import time
 from typing import Any, Callable, Iterable, Optional
 
 from ..crdt import encode_state_as_update
+from ..observability.costs import get_cost_ledger
 from ..observability.wire import get_wire_telemetry
 from ..protocol.frames import build_update_frame
 from ..protocol.message import OutgoingMessage
@@ -359,10 +360,22 @@ class DocumentFanout:
         # WAL group commit running on the executor; only DELIVERY (the
         # first moment a client could see the update) waits for the
         # durability gates
+        ledger = get_cost_ledger()
         frame = None
         per_update_frames = None
         if pending:
+            t0 = time.perf_counter_ns() if ledger.enabled else 0
             update = coalesce_updates(pending)
+            if ledger.enabled:
+                # coalesce: the per-tick merge only — the frame build
+                # below accounts itself as frame_encode, keeping the
+                # ledger's loop sites non-overlapping
+                ledger.record(
+                    "coalesce",
+                    "Sync",
+                    time.perf_counter_ns() - t0,
+                    0 if update is None else len(update),
+                )
             if update is None:
                 # merge failure must not lose updates: per-update frames
                 per_update_frames = [
@@ -371,7 +384,7 @@ class DocumentFanout:
             else:
                 frame = build_update_frame(document.name, update)
 
-        def deliver_tick() -> None:
+        def _deliver_tick() -> None:
             if document.is_destroyed:
                 return
             # audience snapshot: ONE registry copy serves the update
@@ -456,6 +469,21 @@ class DocumentFanout:
                         callback(t_last)
                     except Exception:
                         pass
+
+        def deliver_tick() -> None:
+            # fanout_tick: one broadcast tick's delivery work (audience
+            # snapshot + per-socket enqueues), the loop-thread cost the
+            # headroom model charges per ingress frame
+            if not ledger.enabled:
+                _deliver_tick()
+                return
+            t0 = time.perf_counter_ns()
+            try:
+                _deliver_tick()
+            finally:
+                ledger.record(
+                    "fanout_tick", "Sync", time.perf_counter_ns() - t0
+                )
 
         waiting = [gate for gate in gates if not gate.done()]
         if not waiting:

@@ -23,8 +23,9 @@ from ..protocol.sync import (
     read_update,
     write_sync_step2,
 )
+from ..observability.costs import get_cost_ledger
 from ..observability.tracing import get_tracer
-from ..observability.wire import get_wire_telemetry
+from ..observability.wire import get_wire_telemetry, message_type_name
 from .document import Document
 from . import logger as _logger_mod
 
@@ -68,16 +69,27 @@ class MessageReceiver:
         # (extensions/redis.py, connection=None) but can never produce
         # a wire error, so counting them would dilute the error-rate
         # SLO's denominator and hide real client-facing breaches
-        if wire.enabled and connection is not None:
+        ledger = get_cost_ledger()
+        if (wire.enabled or ledger.enabled) and connection is not None:
             started = time.perf_counter()
             try:
                 await self._dispatch(message, message_type, document, connection, reply)
             finally:
-                wire.record_ingress(
-                    int(message_type),
-                    len(message.decoder.buf),
-                    time.perf_counter() - started,
-                )
+                elapsed = time.perf_counter() - started
+                nbytes = len(message.decoder.buf)
+                if wire.enabled:
+                    wire.record_ingress(int(message_type), nbytes, elapsed)
+                if ledger.enabled:
+                    # frame_decode: the full inbound dispatch window —
+                    # same window + byte count as record_ingress, so the
+                    # ledger's byte sums reconcile against the wire
+                    # counters (tests/observability/test_profiler_costs)
+                    ledger.record(
+                        "frame_decode",
+                        message_type_name(int(message_type)),
+                        int(elapsed * 1e9),
+                        nbytes,
+                    )
         else:
             await self._dispatch(message, message_type, document, connection, reply)
 
@@ -248,11 +260,15 @@ class MessageReceiver:
                     build_sync_status_frame(document.name, contains)
                 )
                 return sync_type
+            ledger = get_cost_ledger()
+            t0 = time.perf_counter_ns() if ledger.enabled else 0
             read_sync_step2(
                 message.decoder,
                 document,
                 connection if connection is not None else self.default_transaction_origin,
             )
+            if ledger.enabled:
+                ledger.record("apply_update", "Sync", time.perf_counter_ns() - t0)
             if connection is not None:
                 connection.send(
                     build_sync_status_frame(document.name, True)
@@ -267,6 +283,8 @@ class MessageReceiver:
                 connection if connection is not None else self.default_transaction_origin
             )
             tracer = get_tracer()
+            ledger = get_cost_ledger()
+            t0 = time.perf_counter_ns() if ledger.enabled else 0
             if tracer.enabled:
                 # the CPU-side apply that precedes the capture seam: a
                 # lifecycle trace's host prologue is visible next to its
@@ -275,6 +293,8 @@ class MessageReceiver:
                     read_update(message.decoder, document, origin)
             else:
                 read_update(message.decoder, document, origin)
+            if ledger.enabled:
+                ledger.record("apply_update", "Sync", time.perf_counter_ns() - t0)
             if connection is not None:
                 connection.send(
                     build_sync_status_frame(document.name, True)

@@ -214,6 +214,11 @@ class OverloadController:
         self._last_sample_at = 0.0
         self._last_backpressure_total = 0.0
         self._sampler_task: Optional[asyncio.Task] = None
+        # loop-lag listeners: the sampling profiler's burst trigger
+        # (observability/profiler.py) registers here — invoked with the
+        # smoothed lag each sampler tick; exceptions are the listener's
+        # problem, never the ladder's
+        self.on_loop_lag: "list" = []
         self.last_signals: "dict[str, dict]" = {}
         self.transitions: "deque[dict]" = deque(maxlen=256)
         self._shed_counts: "dict[str, int]" = {}
@@ -360,6 +365,7 @@ class OverloadController:
         self._shed_ts.clear()
         self._connect_buckets.clear()
         self._message_buckets.clear()
+        self.on_loop_lag = []
 
     # -- signal reads --------------------------------------------------------
 
@@ -634,6 +640,11 @@ class OverloadController:
                 # recovery needs sustained healthy wakes (smooths the
                 # signal without hiding a spike from the ladder)
                 self._loop_lag_ms = max(lag_ms, self._loop_lag_ms * 0.5)
+                for listener in self.on_loop_lag:
+                    try:
+                        listener(self._loop_lag_ms)
+                    except Exception:
+                        pass
                 self.sample()
         except asyncio.CancelledError:
             pass

@@ -661,9 +661,21 @@ class WalManager:
                 self._journal_rotate()
         self.stats["commit_batches"] += 1
         self.stats["commit_batch_records_last"] = batch_records
-        self.stats["commit_last_ms"] = round(
-            (time.perf_counter() - commit_started) * 1000, 3
-        )
+        commit_s = time.perf_counter() - commit_started
+        self.stats["commit_last_ms"] = round(commit_s * 1000, 3)
+        from ..observability.costs import get_cost_ledger
+
+        ledger = get_cost_ledger()
+        if ledger.enabled and batch_records:
+            # wal_append: group-commit cost on the EXECUTOR thread —
+            # visible in /debug/costs attribution but excluded from the
+            # loop-thread headroom sum (OFF_LOOP_SITES)
+            ledger.record(
+                "wal_append",
+                "Sync",
+                int(commit_s * 1e9),
+                sum(len(e) for e in journal_entries),
+            )
 
     # -- commit journal (executor thread) ----------------------------------
 

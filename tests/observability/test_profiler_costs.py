@@ -1,0 +1,365 @@
+"""Sampling CPU profiler + per-frame cost ledger (ISSUE 19).
+
+Covers the continuous host-path profiler (folded-stack determinism,
+the one-burst-per-lag-episode latch, the <1% overhead guard), the cost
+ledger's reconciliation against wire telemetry byte counters, the
+`/debug/costs` + `/debug/profile/cpu` endpoints over real HTTP with the
+PR-15 stamped header, the PR-6 deterministic metric registration pin,
+and the headroom number riding on fleet digests.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+import aiohttp
+import pytest
+
+from hocuspocus_tpu.observability import Metrics, get_cost_ledger, get_profiler
+from hocuspocus_tpu.observability.costs import CostLedger, LOOP_SITES
+from hocuspocus_tpu.observability.flight_recorder import get_flight_recorder
+from hocuspocus_tpu.observability.profiler import SamplingProfiler
+from hocuspocus_tpu.observability.wire import get_wire_telemetry
+
+from tests.utils import new_hocuspocus, new_provider, retryable_assertion, wait_synced
+
+_FOLDED_LINE = re.compile(r"^\S+ \d+$")
+
+
+@pytest.fixture(autouse=True)
+def _quiesce_profiler():
+    """Metrics.on_configure starts the process-wide 99 Hz sampler and
+    enables the cost ledger; quiesce both after each test here so
+    perf-sensitive suites that run later (tracer overhead budgets)
+    aren't sharing their GIL with the sampler or paying ledger
+    record() on every frame."""
+    yield
+    get_profiler().stop()
+    ledger = get_cost_ledger()
+    ledger.disable()
+    ledger.reset()
+
+
+# -- profiler core -------------------------------------------------------------
+
+
+def test_folded_stacks_deterministic_under_thread_churn():
+    """Worker pools churn through numbered thread names; the folded
+    table must aggregate them under digit-normalized roots, every line
+    must stay `stack count`-parseable, and two reads of a quiesced
+    profiler must be byte-identical (sorted output)."""
+    profiler = SamplingProfiler(hz=500.0, ring_size=64)
+    stop = threading.Event()
+
+    def churn() -> None:
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+            time.sleep(0.001)
+
+    threads = [
+        threading.Thread(target=churn, name=f"Thread-{i}", daemon=True)
+        for i in range(7, 12)
+    ]
+    for t in threads:
+        t.start()
+    profiler.start()
+    try:
+        deadline = time.time() + 5.0
+        while profiler.stats()["samples"] < 20 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        profiler.stop()
+        stop.set()
+        for t in threads:
+            t.join(timeout=2.0)
+
+    text = profiler.collapsed()
+    assert text, "no samples folded"
+    lines = text.splitlines()
+    assert all(_FOLDED_LINE.match(line) for line in lines), lines[:5]
+    roots = {line.split(" ")[0].split(";")[0] for line in lines}
+    # churn threads folded into ONE normalized root, not one per thread
+    assert "Thread-N" in roots
+    assert not any(re.search(r"\d", root) for root in roots), roots
+    # deterministic: a quiesced profiler reads back byte-identical
+    assert profiler.collapsed() == text
+    assert profiler.stats()["samples"] >= 20
+
+
+def test_burst_capture_fires_once_per_lag_episode():
+    """The episode latch: repeated over-threshold lag readings produce
+    ONE burst; re-arm happens only below half the threshold (the
+    brownout ladder's hysteresis shape); each burst lands a
+    `__profiler__` flight-recorder event with the top culprit stack."""
+    profiler = SamplingProfiler(hz=0)  # steady sampler off; bursts only
+    profiler.burst_s = 0.02
+    profiler.burst_hz = 500.0
+    profiler.burst_trigger_ms = 200.0
+    recorder = get_flight_recorder()
+    before = len(recorder.events("__profiler__"))
+
+    def wait_burst_done() -> None:
+        thread = profiler._burst_thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    for _ in range(5):  # a whole episode of over-threshold ticks
+        profiler.note_loop_lag(350.0)
+    assert profiler.stats()["bursts_triggered"] == 1
+    profiler.note_loop_lag(150.0)  # above half: still latched
+    assert profiler.stats()["bursts_triggered"] == 1
+    wait_burst_done()
+    profiler.note_loop_lag(50.0)  # below half: re-armed
+    profiler.note_loop_lag(400.0)  # next episode
+    assert profiler.stats()["bursts_triggered"] == 2
+    wait_burst_done()
+
+    assert profiler.bursts_counter.value() == 2.0
+    events = recorder.events("__profiler__")[before:]
+    bursts = [e for e in events if e.get("event") == "lag_burst"]
+    assert len(bursts) == 2
+    assert bursts[0]["lag_ms"] == 350.0
+    assert bursts[0]["samples"] > 0
+    assert bursts[0]["top_stack"]  # the culprit stack rode along
+    last = profiler.stats()["last_burst"]
+    assert last is not None and last["lag_ms"] == 400.0
+
+
+@pytest.mark.slow
+def test_profiler_overhead_under_one_percent():
+    """The always-on guard: at the default 99 Hz the measured sampling
+    overhead (walk time / wall time) stays under 1% while threads are
+    actually running."""
+    profiler = SamplingProfiler(hz=99.0)
+    stop = threading.Event()
+
+    def busy() -> None:
+        while not stop.is_set():
+            sum(i for i in range(500))
+            time.sleep(0.002)
+
+    threads = [
+        threading.Thread(target=busy, name=f"busy-{i}", daemon=True)
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    profiler.start()
+    try:
+        time.sleep(2.0)
+    finally:
+        profiler.stop()
+        stop.set()
+        for t in threads:
+            t.join(timeout=2.0)
+    overhead = profiler.overhead_fraction()
+    assert profiler.stats()["samples"] > 50
+    assert overhead < 0.01, f"profiler overhead {overhead:.4f} >= 1%"
+
+
+# -- cost ledger ---------------------------------------------------------------
+
+
+def test_headroom_model_sums_only_loop_sites():
+    """Detail sites are slices INSIDE frame_decode and off-loop work
+    runs on executor threads — neither may enter the headroom sum, or
+    the model double-charges the frame."""
+    ledger = CostLedger().enable()
+    for site in LOOP_SITES:
+        ledger.record(site, "Sync", 250_000)  # 0.25ms each -> 1ms/frame
+    ledger.record("apply_update", "Sync", 10_000_000)  # inside decode
+    ledger.record("wal_append", "Sync", 50_000_000)  # executor thread
+    assert ledger.ingress_frames() == 1
+    assert ledger.loop_ns_per_frame() == pytest.approx(1_000_000)
+    assert ledger.headroom_frames_per_s() == pytest.approx(1000.0)
+    table = ledger.table(wire=None)
+    assert table["headroom_frames_per_s"] == 1000.0
+    assert {row["site"] for row in table["rows"]} >= set(LOOP_SITES)
+
+
+async def test_cost_ledger_bytes_reconcile_with_wire_counters():
+    """Both frame_decode and wire ingress account THE SAME window and
+    byte count in server/message_receiver.py — their per-type byte
+    deltas over a live-traffic window must agree exactly."""
+    ledger = get_cost_ledger()
+    wire = get_wire_telemetry()
+    metrics = Metrics()  # on_configure enables both
+    server = await new_hocuspocus(extensions=[metrics])
+    ledger_before = wire.bytes_in.value(type="Sync"), ledger.bytes.value(
+        site="frame_decode", type="Sync"
+    )
+    provider = new_provider(server, name="cost-doc")
+    try:
+        await wait_synced(provider)
+        for i in range(8):
+            provider.document.get_text("t").insert(0, f"edit {i} ")
+
+        def reconciles() -> None:
+            wire_delta = wire.bytes_in.value(type="Sync") - ledger_before[0]
+            ledger_delta = (
+                ledger.bytes.value(site="frame_decode", type="Sync")
+                - ledger_before[1]
+            )
+            assert wire_delta > 0
+            assert ledger_delta == wire_delta
+            # and the ledger attributed work below the decode (the
+            # edits land as Update frames, so wait for the applies too)
+            assert ledger.frames.value(site="apply_update", type="Sync") > 0
+
+        await retryable_assertion(reconciles)
+    finally:
+        provider.destroy()
+        await server.destroy()
+        get_profiler().stop()  # don't leave the sampler on for later tests
+
+
+async def test_debug_costs_and_cpu_profile_over_http():
+    """`/debug/costs` and `/debug/profile/cpu` over real HTTP: stamped
+    JSON payloads ({generated_utc, role, node_id} — the PR-15 header on
+    the unified /debug/profile/{device,cpu} namespace), a populated cost
+    table with positive headroom after traffic, and valid collapsed
+    text under ?format=collapsed with the stamp in X- headers."""
+    metrics = Metrics()
+    server = await new_hocuspocus(extensions=[metrics])
+    provider = new_provider(server, name="profiled-doc")
+    try:
+        await wait_synced(provider)
+        for i in range(6):
+            provider.document.get_text("t").insert(0, f"probe {i} ")
+        await retryable_assertion(
+            lambda: _assert_positive(
+                get_cost_ledger().frames.value(site="frame_decode", type="Sync")
+            )
+        )
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{server.http_url}/debug/costs") as response:
+                assert response.status == 200
+                costs = await response.json()
+            async with session.get(
+                f"{server.http_url}/debug/profile/cpu"
+            ) as response:
+                assert response.status == 200
+                cpu = await response.json()
+            async with session.get(
+                f"{server.http_url}/debug/profile/cpu",
+                params={"format": "collapsed"},
+            ) as response:
+                assert response.status == 200
+                assert response.content_type == "text/plain"
+                folded_headers = dict(response.headers)
+                folded = await response.text()
+
+        for payload in (costs, cpu):
+            for key in ("generated_utc", "role", "node_id"):
+                assert key in payload, (key, sorted(payload))
+        assert costs["enabled"] is True
+        sites = {row["site"] for row in costs["rows"]}
+        assert "frame_decode" in sites
+        assert costs["headroom_frames_per_s"] > 0
+        assert costs["top_costs"], "empty attribution after live traffic"
+        # quantiles only for types with observed series (sentinel guard)
+        assert "Sync" in costs["wire_handle_quantiles_ms"]
+
+        assert cpu["stats"]["running"] is True
+        for line in folded.strip().splitlines():
+            assert _FOLDED_LINE.match(line), line
+        assert "X-Generated-Utc" in folded_headers
+        assert "X-Node-Id" in folded_headers
+    finally:
+        provider.destroy()
+        await server.destroy()
+        get_profiler().stop()  # don't leave the sampler on for later tests
+
+
+def _assert_positive(value: float) -> None:
+    assert value > 0
+
+
+# -- registration + fleet ------------------------------------------------------
+
+
+def test_profiler_and_ledger_metrics_register_deterministically():
+    """PR-6 pin: the profiler/ledger series adopt into the registry via
+    register() and expose in sorted-name order; re-instantiating the
+    extension (same process singletons) must not raise on the name
+    collision."""
+    metrics = Metrics()
+    metrics2 = Metrics()  # adoption is idempotent across instances
+    text = metrics.registry.expose()
+    for name in (
+        "hocuspocus_profile_frame_cost_ns",
+        "hocuspocus_profile_frames_total",
+        "hocuspocus_profile_frame_bytes_total",
+        "hocuspocus_profile_headroom_frames_per_s",
+        "hocuspocus_profile_overhead_fraction",
+        "hocuspocus_profile_samples_total",
+        "hocuspocus_profile_lag_bursts_total",
+    ):
+        assert f"# TYPE {name}" in text, name
+    # deterministic series ordering: HELP headers appear sorted by name
+    names = [
+        line.split(" ")[2]
+        for line in text.splitlines()
+        if line.startswith("# TYPE ")
+    ]
+    assert names == sorted(names)
+    assert metrics2.registry is not metrics.registry or True  # both valid
+
+
+def test_fleet_digest_carries_headroom():
+    """The headroom number rides on fleet digests so /debug/fleet shows
+    per-node sustainable frames/s (the ISSUE's fleet acceptance)."""
+    from hocuspocus_tpu.observability.fleet import FleetView, build_digest
+
+    ledger = get_cost_ledger()
+    ledger.reset()
+    ledger.enable()
+    try:
+        for site in LOOP_SITES:
+            ledger.record(site, "Sync", 500_000)
+        digest = build_digest(role="cell", node_id="cell-9", interval_s=0.1)
+        assert digest["headroom_frames_per_s"] == pytest.approx(500.0)
+        view = FleetView()
+        view.enable()
+        view.ingest(digest)
+        peers = view.status()["peers"]
+        entry = peers["cell-9"]
+        assert entry["headroom_frames_per_s"] == pytest.approx(500.0)
+    finally:
+        ledger.disable()
+        ledger.reset()
+
+
+async def test_wire_saturation_scenario_attaches_evidence():
+    """The wire_saturation scenario (BENCH_SUITE member) lands
+    extra.wire_saturation: per-rung offered vs achieved frames/s, the
+    headroom model's rate and a non-empty attribution — and passes on
+    CPU at CI scale."""
+    from hocuspocus_tpu.loadgen.runner import run_scenario
+    from hocuspocus_tpu.loadgen.scenarios import BENCH_SUITE, get_scenario
+
+    assert "wire_saturation" in BENCH_SUITE
+    scenario = get_scenario("wire_saturation", num_docs=4, phase_ms=400)
+    result = await run_scenario(scenario, seed=3, time_scale=4.0)
+    assert result["verdict"] == "pass", result["slo"]["breached_targets"]
+    evidence = result["extra"]["wire_saturation"]
+    assert len(evidence["rungs"]) == 4
+    for rung in evidence["rungs"]:
+        assert rung["achieved_frames_per_s"] > 0
+    assert evidence["sustained_frames_per_s"] > 0
+    assert evidence["headroom_frames_per_s"] > 0
+    assert evidence["top_costs"], "empty cost attribution"
+    assert {c["site"] for c in evidence["top_costs"]} <= {
+        "frame_decode",
+        "frame_encode",
+        "coalesce",
+        "fanout_tick",
+        "varint_header",
+        "apply_update",
+        "wal_append",
+    }
+    # the scenario hands the next run a cold ledger (teardown contract)
+    assert get_cost_ledger().enabled is False

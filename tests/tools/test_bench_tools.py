@@ -391,3 +391,93 @@ def test_gate_extracts_diurnal_autoscale_stages():
         "diurnal_autoscale.steady_footprint_ratio"
         not in bench_gate.stage_p99s(old)
     )
+
+
+def test_gate_wire_saturation_stages_are_higher_is_better():
+    """The wire-saturation throughput stages gate in the OPPOSITE
+    direction from every latency stage: a frames/s DROP beyond
+    tolerance fails the round, growth never does, and the ms jitter
+    floor does not apply to frames/s."""
+    payload = _artifact()
+    payload["extra"]["wire_saturation"] = {
+        "frames_per_s": 8000.0,
+        "headroom_frames_per_s": 9000.0,
+        "headroom_ratio": 1.125,
+        "headroom_within_2x": True,
+        "top_costs": [{"site": "frame_decode", "type": "Sync"}],
+    }
+    stages = bench_gate.stage_p99s(payload)
+    assert stages["wire_saturation.frames_per_s"] == 8000.0
+    assert stages["wire_saturation.headroom_frames_per_s"] == 9000.0
+    assert "wire_saturation.frames_per_s" in bench_gate.HIGHER_IS_BETTER
+
+    # a throughput DROP beyond tolerance regresses
+    current = json.loads(json.dumps(payload))
+    current["extra"]["wire_saturation"]["frames_per_s"] = 4000.0
+    regressions, notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert any("wire_saturation.frames_per_s" in r for r in regressions)
+    assert any("frames/s" in r for r in regressions)  # unit-aware note
+    # headroom did not drop — it must not be flagged
+    assert not any(
+        "wire_saturation.headroom_frames_per_s" in r for r in regressions
+    )
+
+    # throughput GROWTH (which would fail a lower-is-better compare at
+    # the same tolerance) passes clean
+    current = json.loads(json.dumps(payload))
+    current["extra"]["wire_saturation"]["frames_per_s"] = 16000.0
+    current["extra"]["wire_saturation"]["headroom_frames_per_s"] = 18000.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert not any("wire_saturation" in r for r in regressions)
+
+    # a drop INSIDE tolerance stays green (no ms floor shenanigans)
+    current = json.loads(json.dumps(payload))
+    current["extra"]["wire_saturation"]["frames_per_s"] = 7000.0
+    regressions, _notes = bench_gate.compare(
+        payload, current, tolerance=0.25, floor_ms=0.25
+    )
+    assert not any("wire_saturation.frames_per_s" in r for r in regressions)
+
+
+def test_gate_wire_saturation_headroom_band_note(capsys, tmp_path):
+    """The 2x headroom-band check is informational on the current round:
+    inside the band notes OK, outside warns — never a gate failure (the
+    band is owned by the bench pass + its tests; shared-runner noise
+    must not become a false alarm)."""
+    payload = _artifact(suite_verdict="pass")
+    payload["extra"]["wire_saturation"] = {
+        "frames_per_s": 8000.0,
+        "headroom_frames_per_s": 9000.0,
+        "headroom_ratio": 1.125,
+        "headroom_within_2x": True,
+    }
+    failures, notes = bench_gate.current_round_checks(payload, fail_stale=False)
+    assert not failures
+    assert any("within 2x" in note for note in notes)
+
+    payload["extra"]["wire_saturation"]["headroom_ratio"] = 5.0
+    payload["extra"]["wire_saturation"]["headroom_within_2x"] = False
+    failures, notes = bench_gate.current_round_checks(payload, fail_stale=False)
+    assert not failures
+    assert any("OUTSIDE the 2x" in note for note in notes)
+
+
+def test_capture_stale_summary_is_one_loud_line(tmp_path, monkeypatch):
+    """bench_capture's stale-headline summary: one line naming every
+    stale_capture round in the trajectory; silent when none are."""
+    bench_capture = _load("_test_bench_capture", "tools/bench_capture.py")
+    monkeypatch.setattr(bench_capture, "_REPO_DIR", str(tmp_path))
+    assert bench_capture.summarize_stale_rounds() is None
+    _write(tmp_path / "BENCH_r01.json", _artifact())
+    _write(tmp_path / "BENCH_r02.json", _artifact(stale=True))
+    _write(tmp_path / "BENCH_r03.json", _artifact(stale=True))
+    line = bench_capture.summarize_stale_rounds()
+    assert line is not None and line.count("\n") == 0
+    assert line.startswith("!!! STALE HEADLINES")
+    assert "2 of 3" in line
+    assert "BENCH_r02.json" in line and "BENCH_r03.json" in line
+    assert "BENCH_r01.json" not in line
