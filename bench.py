@@ -1170,6 +1170,17 @@ def _measure_wire_load() -> dict:
     }
 
 
+def _native_codec_active() -> bool:
+    """Whether the wire path ran the C++ codec this round (a silent
+    fallback to Python invalidates throughput comparisons)."""
+    try:
+        from hocuspocus_tpu.native import get_codec
+
+        return get_codec() is not None
+    except Exception:
+        return False
+
+
 def _measure_wire_saturation() -> dict:
     """Wire-saturation + headroom-model closure (docs/guides/load-testing.md
     "profiling & cost attribution"): a direct-drive micro-harness —
@@ -1289,7 +1300,11 @@ def _measure_wire_saturation() -> dict:
         if rungs
         else False,
         # the gated headlines: measured saturation + model prediction
+        # (sustained_frames_per_s is the canonical gate key; frames_per_s
+        # stays for older rounds' artifacts)
         "frames_per_s": round(sustained, 1),
+        "sustained_frames_per_s": round(sustained, 1),
+        "codec_path": "native" if _native_codec_active() else "fallback",
         "headroom_frames_per_s": round(headroom, 1),
         "headroom_ratio": ratio,
         "headroom_within_2x": bool(ratio is not None and 0.5 <= ratio <= 2.0),

@@ -53,16 +53,20 @@ class DeleteSet:
                 yield client, clock, length
 
     def write(self, encoder: Encoder) -> None:
-        encoder.write_var_uint(len(self.clients))
-        # decreasing client order, matching yjs writeDeleteSet iteration of
-        # its struct-store-derived maps; readers are order-independent.
+        # flattened into ONE bulk varint write (native when available):
+        # [numClients] then per client [client][numRanges][clock len]*
+        # in decreasing client order, matching yjs writeDeleteSet
+        # iteration of its struct-store-derived maps; readers are
+        # order-independent.
+        values = [len(self.clients)]
         for client in sorted(self.clients, reverse=True):
             ranges = self.clients[client]
-            encoder.write_var_uint(client)
-            encoder.write_var_uint(len(ranges))
+            values.append(client)
+            values.append(len(ranges))
             for clock, length in ranges:
-                encoder.write_var_uint(clock)
-                encoder.write_var_uint(length)
+                values.append(clock)
+                values.append(length)
+        encoder.write_var_uints(values)
 
     @staticmethod
     def read(decoder: Decoder) -> "DeleteSet":
@@ -72,10 +76,10 @@ class DeleteSet:
             client = decoder.read_var_uint()
             num_ranges = decoder.read_var_uint()
             if num_ranges > 0:
+                # one bulk read for the whole (clock, len) run
+                flat = decoder.read_var_uints(num_ranges * 2)
                 ranges = ds.clients.setdefault(client, [])
-                for _ in range(num_ranges):
-                    clock = decoder.read_var_uint()
-                    ranges.append((clock, decoder.read_var_uint()))
+                ranges.extend(zip(flat[0::2], flat[1::2]))
         return ds
 
     def encode(self) -> bytes:

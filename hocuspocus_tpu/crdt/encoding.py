@@ -21,6 +21,14 @@ from typing import Any
 BITS31 = 0x7FFFFFFF
 
 
+def _bulk_codec():
+    """The native codec when its bulk varint helpers are available, else
+    None (import deferred: `native` builds the extension on first use)."""
+    from ..native import get_codec
+
+    return get_codec()
+
+
 class Encoder:
     """Append-only binary encoder, byte-compatible with lib0's Encoder."""
 
@@ -83,6 +91,16 @@ class Encoder:
     def write_var_uint8_array(self, data: bytes | bytearray | memoryview) -> None:
         self.write_var_uint(len(data))
         self.buf += data
+
+    def write_var_uints(self, values) -> None:
+        """Bulk varint write: one native call for a whole struct-run /
+        state-vector / delete-range sequence instead of a Python loop."""
+        codec = _bulk_codec()
+        if codec is not None:
+            self.buf += codec.encode_var_uints(values)
+            return
+        for v in values:
+            self.write_var_uint(v)
 
     def write_float32(self, num: float) -> None:
         self.buf += struct.pack(">f", num)
@@ -207,6 +225,20 @@ class Decoder:
             if b < 0x80:
                 return sign * num
             shift += 7
+
+    def read_var_uints(self, count: int) -> tuple:
+        """Bulk varint read — the mirror of Encoder.write_var_uints.
+        Truncation raises ValueError on both paths (the native call and
+        this fallback), unlike scalar read_var_uint's IndexError."""
+        codec = _bulk_codec()
+        if codec is not None:
+            values, self.pos = codec.read_var_uints(self.buf, self.pos, count)
+            return values
+        read = self.read_var_uint
+        try:
+            return tuple(read() for _ in range(count))
+        except IndexError:
+            raise ValueError("unexpected end of buffer") from None
 
     def read_var_string(self) -> str:
         length = self.read_var_uint()

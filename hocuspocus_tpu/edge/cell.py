@@ -33,6 +33,7 @@ import time
 
 from ..aio import spawn_tracked
 from ..net.resp import PipelinedRedisClient, RedisSubscriber
+from ..observability.costs import get_cost_ledger
 from ..observability.fleet import build_digest, get_fleet_view
 from ..observability.flight_recorder import get_flight_recorder
 from ..observability.tracing import get_tracer
@@ -118,9 +119,13 @@ class _CellEdgeSession:
     # -- outbound (cell -> edge) -------------------------------------------
 
     async def _send_to_edge(self, data: bytes) -> None:
+        # zero-copy: the broadcast frame (encode-once, shared by the
+        # whole audience) rides as a memoryview segment — the pipelined
+        # publish lane joins header+payload straight into the socket
+        # write, so the frame bytes are copied exactly once
         self.ext.publish_to_edge(
             self.edge_id,
-            relay.encode_envelope(relay.FRAME, self.session_id, "", data),
+            relay.encode_envelope_view(relay.FRAME, self.session_id, "", data),
         )
         self.ext.counters["frames_out"] += 1
 
@@ -218,19 +223,23 @@ class CellIngressExtension(Extension):
 
     # -- wiring -------------------------------------------------------------
 
-    def _publish(self, channel: str, envelope: bytes) -> None:
-        """Publish one envelope, preferring the pipelined enqueue-only
-        path (per-tick coalesced lane) over a spawned await."""
+    def _publish(self, channel: str, envelope) -> None:
+        """Publish one envelope (bytes, or a zero-copy segment list from
+        `relay.encode_envelope_view`), preferring the pipelined
+        enqueue-only path (per-tick coalesced lane) over a spawned
+        await."""
         nowait = getattr(self.pub, "publish_nowait", None)
         if nowait is not None:
             nowait(channel, envelope)
         else:
+            if isinstance(envelope, (list, tuple)):
+                envelope = b"".join(envelope)
             spawn_tracked(self._tasks, self.pub.publish(channel, envelope))
 
-    def publish_to_edge(self, edge_id: str, envelope: bytes) -> None:
+    def publish_to_edge(self, edge_id: str, envelope) -> None:
         self._publish(relay.edge_channel(self.prefix, edge_id), envelope)
 
-    def publish_to_cell(self, cell_id: str, envelope: bytes) -> None:
+    def publish_to_cell(self, cell_id: str, envelope) -> None:
         """Cell → cell (the replica lane: FOLLOW/REPLICA_TICK/…)."""
         self._publish(relay.cell_channel(self.prefix, cell_id), envelope)
 
@@ -452,9 +461,15 @@ class CellIngressExtension(Extension):
 
     def _on_message(self, channel: bytes, data: bytes) -> None:
         try:
+            t0 = time.perf_counter_ns()
             kind, session_id, aux, payload = relay.decode_envelope(data)
         except Exception:
             return  # malformed envelope: nothing safe to act on
+        ledger = get_cost_ledger()
+        if ledger.enabled:
+            ledger.record(
+                "envelope_decode", "Relay", time.perf_counter_ns() - t0, len(data)
+            )
         if kind == relay.PING:
             # clock-offset probe (cross-tier tracing): echo the edge's
             # stamp plus our own clock, immediately — any queueing here

@@ -46,6 +46,7 @@ from typing import Any, Optional
 
 from ..aio import spawn_tracked
 from ..net.resp import PipelinedRedisClient, RedisSubscriber
+from ..observability.costs import get_cost_ledger
 from ..observability.fleet import build_digest, get_fleet_view
 from ..observability.flight_recorder import get_flight_recorder
 from ..observability.metrics import Counter, Gauge
@@ -98,9 +99,13 @@ class RelaySession:
     def send(self, frame: bytes, aux: str = "") -> None:
         if self.closed:
             return
+        # zero-copy: the client frame rides as a memoryview segment
+        # through the pipelined publish lane (joined once, straight
+        # into the socket write) instead of being re-copied into a
+        # fresh envelope buffer per publish
         self.gateway.publish_to_cell(
             self.cell_id,
-            relay.encode_envelope(relay.FRAME, self.session_id, aux, frame),
+            relay.encode_envelope_view(relay.FRAME, self.session_id, aux, frame),
         )
         self.gateway.counters["frames_to_cell"] += 1
         self.gateway.frames_total.inc(direction="to_cell")
@@ -1013,11 +1018,15 @@ class EdgeGateway:
 
     # -- relay plumbing ------------------------------------------------------
 
-    def publish_to_cell(self, cell_id: str, envelope: bytes) -> None:
+    def publish_to_cell(self, cell_id: str, envelope) -> None:
+        """Publish one envelope (bytes, or a zero-copy segment list from
+        `relay.encode_envelope_view`)."""
         nowait = getattr(self.pub, "publish_nowait", None)
         if nowait is not None:
             nowait(relay.cell_channel(self.prefix, cell_id), envelope)
         else:
+            if isinstance(envelope, (list, tuple)):
+                envelope = b"".join(envelope)
             spawn_tracked(
                 self._tasks,
                 self.pub.publish(relay.cell_channel(self.prefix, cell_id), envelope),
@@ -1268,9 +1277,15 @@ class EdgeGateway:
 
     def _on_message(self, channel: bytes, data: bytes) -> None:
         try:
+            t0 = time.perf_counter_ns()
             kind, session_id, aux, payload = relay.decode_envelope(data)
         except Exception:
             return
+        ledger = get_cost_ledger()
+        if ledger.enabled:
+            ledger.record(
+                "envelope_decode", "Relay", time.perf_counter_ns() - t0, len(data)
+            )
         if kind == relay.CELL_UP:
             self._consider_cell(session_id)
             return

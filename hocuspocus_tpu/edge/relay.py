@@ -140,13 +140,67 @@ def encode_envelope(
     return encoder.to_bytes()
 
 
+def encode_envelope_view(
+    kind: int, session: str, aux: str = "", payload: bytes = b""
+) -> "list[bytes | memoryview]":
+    """Zero-copy envelope: the header is encoded fresh (it is tiny) but
+    the payload — the encode-once broadcast frame shared by the whole
+    audience — is wrapped as a memoryview, never copied. The result is a
+    segment list for the pipelined publish lane (`net/resp.py
+    publish_nowait` accepts segment lists and `b"".join`s them straight
+    into the socket write, so the frame bytes are copied exactly once,
+    INTO the kernel).
+
+    Lifetime rule (docs/guides/native-codec.md): the segments alias the
+    caller's buffer — they must be handed to the transport synchronously
+    and never mutated before the flush; holders that outlive the call
+    must `bytes()` them first.
+    """
+    encoder = Encoder()
+    encoder.write_var_uint(kind)
+    encoder.write_var_string(session)
+    encoder.write_var_string(aux)
+    encoder.write_var_uint(len(payload))
+    return [encoder.to_bytes(), memoryview(payload)]
+
+
 def decode_envelope(data: bytes) -> "tuple[int, str, str, bytes]":
+    from ..native import get_codec
+
+    codec = get_codec()
+    if codec is not None:
+        return codec.parse_envelope(data)
     decoder = Decoder(data)
     kind = decoder.read_var_uint()
     session = decoder.read_var_string()
     aux = decoder.read_var_string()
     payload = decoder.read_var_uint8_array()
     return kind, session, aux, payload
+
+
+def decode_envelopes_batch(
+    raws: "list[bytes]", skip_malformed: bool = False
+) -> "list[tuple[int, str, str, bytes] | None]":
+    """Decode a drained batch of envelopes in ONE native call
+    (consecutive envelopes of the same session share one str object).
+    ``skip_malformed=True`` yields None slots for undecodable entries —
+    the relay's drop-and-resync contract — instead of raising."""
+    codec = None
+    if raws:
+        from ..native import get_codec
+
+        codec = get_codec()
+    if codec is not None:
+        return codec.parse_envelopes_batch(raws, skip_malformed)
+    out: "list[tuple[int, str, str, bytes] | None]" = []
+    for raw in raws:
+        try:
+            out.append(decode_envelope(raw))
+        except Exception:
+            if not skip_malformed:
+                raise
+            out.append(None)
+    return out
 
 
 def encode_open_aux(edge_id: str, tenant: Optional[str] = None) -> str:

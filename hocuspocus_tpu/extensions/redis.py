@@ -46,7 +46,11 @@ from ..net.resp import (
     RedisSubscriber,
 )
 from ..observability.wire import get_wire_telemetry
-from ..protocol.frames import build_update_frame, parse_frame_header
+from ..protocol.frames import (
+    build_update_frame,
+    parse_frame_header,
+    parse_frame_headers_batch,
+)
 from ..protocol.message import IncomingMessage, MessageType, OutgoingMessage
 from ..protocol.sync import MESSAGE_YJS_UPDATE, coalesce_updates
 from ..aio import spawn_tracked
@@ -185,6 +189,10 @@ class Redis(Extension):
         # inbound: doc -> bounded deque of (msg_type, payload_offset,
         # raw frame); drained once per tick, serialized by _drain_lock
         self._inboxes: dict[str, deque] = {}
+        # raw frames awaiting header parse: the subscriber callback only
+        # stages — headers for the whole backlog are parsed in ONE
+        # native batch call when the drain routes them (_route_staged)
+        self._inbox_staging: list = []
         self._inbox_scheduled = False
         self._drain_lock = asyncio.Lock()
         self._overflowed: set[str] = set()
@@ -276,23 +284,26 @@ class Redis(Extension):
         client (the ack is consumed by its reply reader), awaited
         round-trip otherwise."""
         channel = self.get_key(document_name)
-        data = self.encode_message(payload)
         nowait = getattr(self.pub, "publish_nowait", None)
         if nowait is not None:
-            nowait(channel, data)
+            # zero-copy: prefix + frame ride as segments; the pipelined
+            # lane joins them straight into the socket write
+            nowait(channel, (self.message_prefix, payload))
         else:
-            await self.pub.publish(channel, data)
+            await self.pub.publish(channel, self.encode_message(payload))
 
     def _publish_nowait(self, document_name: str, payload: bytes) -> None:
         """Sync-context publish: enqueue on the pipelined client, else a
         tracked fire-and-forget task."""
         channel = self.get_key(document_name)
-        data = self.encode_message(payload)
         nowait = getattr(self.pub, "publish_nowait", None)
         if nowait is not None:
-            nowait(channel, data)
+            # zero-copy segment publish (see _publish)
+            nowait(channel, (self.message_prefix, payload))
         else:
-            spawn_tracked(self._tasks, self.pub.publish(channel, data))
+            spawn_tracked(
+                self._tasks, self.pub.publish(channel, self.encode_message(payload))
+            )
 
     async def _publish_batch(self, document_name: str, payloads: list) -> None:
         """Ship several messages for one doc in ONE round trip."""
@@ -300,7 +311,8 @@ class Redis(Extension):
         nowait = getattr(self.pub, "publish_nowait", None)
         if nowait is not None:
             for payload in payloads:
-                nowait(channel, self.encode_message(payload))
+                # zero-copy segment publish (see _publish)
+                nowait(channel, (self.message_prefix, payload))
             return
         execute_many = getattr(self.pub, "execute_many", None)
         if execute_many is not None:
@@ -544,28 +556,44 @@ class Redis(Extension):
                 receiver.apply(document, None, self._make_reply(document.name)),
             )
             return
-        try:
-            document_name, message_type, offset = parse_frame_header(message_data)
-        except Exception:
-            return  # malformed frame: nothing safe to enqueue
-        if document_name not in self.instance.documents:
-            return
-        inbox = self._inboxes.setdefault(document_name, deque())
-        self.replication_stats["frames_received"] += 1
-        if len(inbox) >= self.inbox_limit:
-            # bounded inbox: the frame is DROPPED, but never silently —
-            # the drain publishes an anti-entropy SyncStep1 for the doc,
-            # and the resulting state exchange carries everything the
-            # dropped frames did (sync is state-based)
-            self._overflowed.add(document_name)
-            self.replication_stats["inbox_overflows"] += 1
-            wire = get_wire_telemetry()
-            if wire.enabled:
-                wire.record_redis_inbox_overflow()
-            self._schedule_inbox_drain()
-            return
-        inbox.append((message_type, offset, message_data))
+        # stage only: the header parse for the whole backlog happens in
+        # ONE native batch call when the drain routes it (_route_staged)
+        self._inbox_staging.append(message_data)
         self._schedule_inbox_drain()
+
+    def _route_staged(self) -> None:
+        """Route staged raw frames into per-doc inboxes. Headers for the
+        whole backlog are parsed in one native batch call (malformed
+        frames yield None slots and are dropped — nothing safe to
+        enqueue)."""
+        staged = self._inbox_staging
+        if not staged or self.instance is None:
+            return
+        self._inbox_staging = []
+        headers = parse_frame_headers_batch(staged, skip_malformed=True)
+        documents = self.instance.documents
+        stats = self.replication_stats
+        wire = get_wire_telemetry()
+        for raw, header in zip(staged, headers):
+            if header is None:
+                continue  # malformed frame
+            document_name, message_type, offset = header
+            if document_name not in documents:
+                continue
+            inbox = self._inboxes.setdefault(document_name, deque())
+            stats["frames_received"] += 1
+            if len(inbox) >= self.inbox_limit:
+                # bounded inbox: the frame is DROPPED, but never
+                # silently — the drain publishes an anti-entropy
+                # SyncStep1 for the doc, and the resulting state
+                # exchange carries everything the dropped frames did
+                # (sync is state-based)
+                self._overflowed.add(document_name)
+                stats["inbox_overflows"] += 1
+                if wire.enabled:
+                    wire.record_redis_inbox_overflow()
+                continue
+            inbox.append((message_type, offset, raw))
 
     def _make_reply(self, document_name: str) -> Callable[[bytes], None]:
         def reply(response: bytes) -> None:
@@ -574,8 +602,11 @@ class Redis(Extension):
         return reply
 
     def inbox_depth(self) -> int:
-        """Queued inbound frames (the wire-telemetry depth gauge)."""
-        return sum(len(inbox) for inbox in self._inboxes.values())
+        """Queued inbound frames (the wire-telemetry depth gauge),
+        staged-but-unrouted frames included."""
+        return len(self._inbox_staging) + sum(
+            len(inbox) for inbox in self._inboxes.values()
+        )
 
     def _schedule_inbox_drain(self) -> None:
         if self._inbox_scheduled:
@@ -589,6 +620,10 @@ class Redis(Extension):
 
     def _start_inbox_drain(self) -> None:
         self._inbox_scheduled = False
+        # route BEFORE the drain task (which serializes on _drain_lock):
+        # frames keep flowing into the bounded inboxes — and overflow is
+        # counted — even while a slow drain holds the lock
+        self._route_staged()
         if not self._inboxes and not self._overflowed:
             return
         spawn_tracked(self._tasks, self._drain_inboxes())
@@ -600,7 +635,8 @@ class Redis(Extension):
         the normal receiver. Serialized: two drains must not interleave
         one doc's frames."""
         async with self._drain_lock:
-            while self._inboxes or self._overflowed:
+            while self._inbox_staging or self._inboxes or self._overflowed:
+                self._route_staged()
                 inboxes = self._inboxes
                 overflowed = self._overflowed
                 self._inboxes = {}
@@ -831,6 +867,7 @@ class Redis(Extension):
         except Exception:
             pass
         self._pending_pub.clear()
+        self._inbox_staging.clear()
         self._inboxes.clear()
         self._overflowed.clear()
         self.pub.close()

@@ -3,10 +3,28 @@
 `get_codec()` returns the compiled `_codec` module, building it with
 g++ on first use if needed, or None when no toolchain is available —
 callers fall back to the pure-Python decoder in hocuspocus_tpu.crdt.
+
+Falling back is ALWAYS safe (byte-identical results) but never silent:
+the first resolution emits one structured warning carrying the tail of
+the compiler error, sets the `hocuspocus_native_codec_info` gauge
+(status=native|fallback, rendered on /metrics once the Metrics
+extension adopts it), and records a `__plane__` flight event so the
+fallback shows up on /debug/docs/__plane__ and the fleet view next to
+the other plane-level degradations.
+
+Stale-.so hazard: mtime comparison alone cannot tell an .so compiled
+from yesterday's sources apart from today's when a checkout rewrites
+timestamps, and a batch API added to codec.cpp would then be silently
+missing at runtime. A version stamp written at build time is compared
+against EXPECTED_API_VERSION *before* the first import (an extension
+module already imported in-process cannot be reliably reloaded —
+CPython caches single-phase-init modules), forcing a rebuild while a
+clean import is still possible.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import sys
@@ -16,13 +34,97 @@ from typing import Optional
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "codec.cpp"), os.path.join(_DIR, "text_lane.cpp")]
 _SO = os.path.join(_DIR, f"_codec{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
+_STAMP = os.path.join(_DIR, "_codec.apiver")
+
+# Bump IN LOCKSTEP with NATIVE_API_VERSION in codec.cpp whenever the
+# module's API surface grows: the stamp check below rebuilds a stale
+# .so before the first import can cache it.
+EXPECTED_API_VERSION = 2
+
+_logger = logging.getLogger("hocuspocus_tpu")
 
 _codec = None
 _build_attempted = False
+_resolved = False
+_last_build_error: Optional[str] = None
+_status: Optional[str] = None  # "native" | "fallback" once resolved
+_info_gauge = None
+
+
+def _get_info_gauge():
+    global _info_gauge
+    if _info_gauge is None:
+        from ..observability.metrics import Gauge
+
+        _info_gauge = Gauge(
+            "hocuspocus_native_codec_info",
+            "Native codec availability: 1 on the active status series "
+            "(status=native|fallback)",
+        )
+    return _info_gauge
+
+
+def codec_info_metrics() -> list:
+    """The process-global status gauge, for registry adoption (the
+    Metrics extension calls this like the other global collectors)."""
+    return [_get_info_gauge()]
+
+
+def codec_status() -> "tuple[Optional[str], Optional[str]]":
+    """(status, reason) — status is None until the first get_codec()
+    resolves; reason carries the compiler error tail on fallback."""
+    return _status, _last_build_error
+
+
+def _note_status(status: str, reason: Optional[str]) -> None:
+    """First-resolution bookkeeping: gauge, flight event, and (on
+    fallback) ONE structured warning — never one per call site."""
+    global _status
+    if _status == status:
+        return
+    _status = status
+    try:
+        gauge = _get_info_gauge()
+        gauge.set(1.0 if status == "native" else 0.0, status="native")
+        gauge.set(1.0 if status == "fallback" else 0.0, status="fallback")
+    except Exception:
+        pass
+    try:
+        from ..observability.flight_recorder import get_flight_recorder
+
+        attrs = {"status": status}
+        if reason:
+            attrs["reason"] = reason[:200]
+        get_flight_recorder().record("__plane__", "native_codec", **attrs)
+    except Exception:
+        pass
+    if status == "fallback":
+        _logger.warning(
+            "[native] codec unavailable, using the pure-Python fallback "
+            "(byte-identical, slower). reason: %s",
+            reason or "unknown",
+        )
+
+
+def _read_stamp() -> Optional[int]:
+    try:
+        with open(_STAMP, "r", encoding="ascii") as fh:
+            return int(fh.read().strip())
+    except Exception:
+        return None
+
+
+def _write_stamp() -> None:
+    try:
+        with open(_STAMP, "w", encoding="ascii") as fh:
+            fh.write(str(EXPECTED_API_VERSION))
+    except Exception:
+        pass
 
 
 def build(force: bool = False) -> bool:
     """Compile the C++ sources into an extension module. Returns success."""
+    global _last_build_error
     if (
         not force
         and os.path.exists(_SO)
@@ -43,31 +145,76 @@ def build(force: bool = False) -> bool:
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        _write_stamp()
         return True
-    except Exception:
+    except subprocess.CalledProcessError as exc:
+        stderr = exc.stderr or b""
+        tail = stderr.decode("utf-8", "replace").strip()[-400:]
+        _last_build_error = f"compiler failed: ...{tail}" if tail else "compiler failed"
         return False
+    except FileNotFoundError:
+        _last_build_error = f"no C++ toolchain ({cmd[0]} not found)"
+        return False
+    except Exception as exc:
+        _last_build_error = f"build error: {exc!r}"
+        return False
+
+
+def _import_codec():
+    """Import (or re-import) the extension module; None on failure."""
+    try:
+        if _DIR not in sys.path:
+            sys.path.insert(0, _DIR)
+        sys.modules.pop("_codec", None)
+        import _codec as codec_module  # type: ignore[import-not-found]
+
+        return codec_module
+    except Exception:
+        return None
 
 
 def get_codec():
     """The compiled codec module, or None if unavailable."""
-    global _codec, _build_attempted
-    if _codec is not None:
-        return _codec
+    global _codec, _build_attempted, _resolved, _last_build_error
     if os.environ.get("HOCUSPOCUS_TPU_NO_NATIVE"):
+        if _status is None:
+            _note_status("fallback", "disabled by HOCUSPOCUS_TPU_NO_NATIVE")
         return None
+    if _resolved:
+        # hot path: one env read + one flag check per call — a broken
+        # .so must not cost an import attempt per frame
+        return _codec
     if not _build_attempted:
-        # build() no-ops when the .so is newer than every source; a
-        # stale .so (new source file added) must be rebuilt or the
-        # module silently misses the new API
         _build_attempted = True
-        build()
+        if os.path.exists(_SO) and _read_stamp() != EXPECTED_API_VERSION:
+            # the .so predates the current API surface (or has no
+            # stamp): rebuild BEFORE the first import caches it
+            build(force=True)
+        else:
+            build()
     if os.path.exists(_SO):
-        try:
-            if _DIR not in sys.path:
-                sys.path.insert(0, _DIR)
-            import _codec as codec_module  # type: ignore[import-not-found]
-
-            _codec = codec_module
-        except Exception:
-            _codec = None
+        module = _import_codec()
+        if module is not None and (
+            getattr(module, "NATIVE_API_VERSION", 0) < EXPECTED_API_VERSION
+        ):
+            # stale module despite the mtime check (e.g. a pre-stamp
+            # .so imported by an older process image): rebuild once and
+            # retry — if the cached copy survives the re-import, fall
+            # back rather than return a module missing the new API
+            build(force=True)
+            module = _import_codec()
+            if module is not None and (
+                getattr(module, "NATIVE_API_VERSION", 0) < EXPECTED_API_VERSION
+            ):
+                _last_build_error = (
+                    "stale native module cached in-process "
+                    "(restart to pick up the rebuilt codec)"
+                )
+                module = None
+        _codec = module
+    _resolved = True
+    if _codec is not None:
+        _note_status("native", None)
+    else:
+        _note_status("fallback", _last_build_error or "native codec unavailable")
     return _codec

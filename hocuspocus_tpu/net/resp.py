@@ -68,6 +68,26 @@ def encode_command(*args: Union[bytes, str, int, float]) -> bytes:
     return bytes(out)
 
 
+def encode_publish_segments(
+    channel: str, segments: "list[bytes | memoryview]"
+) -> "tuple[bytes | memoryview, ...]":
+    """Zero-copy PUBLISH: the RESP framing is built fresh but the payload
+    segments (e.g. an envelope header + a memoryview of the shared
+    broadcast frame — edge/relay.py encode_envelope_view) ride through
+    to the socket write untouched; the flush's ``b"".join`` is the one
+    and only copy. Segments must alias immutable buffers: they sit in
+    the outbox until the flush (and through one resend on transport
+    failure)."""
+    total = sum(len(s) for s in segments)
+    head = bytearray(b"*3\r\n$7\r\nPUBLISH\r\n")
+    ch = channel.encode() if isinstance(channel, str) else channel
+    head += b"$%d\r\n" % len(ch)
+    head += ch
+    head += CRLF
+    head += b"$%d\r\n" % total
+    return (bytes(head), *segments, CRLF)
+
+
 class RespError(Exception):
     pass
 
@@ -127,7 +147,13 @@ class RedisCommands:
     async def delete(self, *keys: str) -> int:
         return await self.execute("DEL", *keys, key=keys[0] if keys else None)
 
-    async def publish(self, channel: str, data: Union[bytes, str]) -> int:
+    async def publish(
+        self, channel: str, data: "Union[bytes, str, list, tuple]"
+    ) -> int:
+        if isinstance(data, (list, tuple)):
+            # segment-list callers (zero-copy publish lane) degrade to a
+            # joined payload on the plain per-RTT client
+            data = b"".join(data)
         return await self.execute("PUBLISH", channel, data)
 
     async def eval(self, script: str, keys: list[str], args: list) -> Any:
@@ -282,15 +308,30 @@ class RedisClient(RedisCommands):
 
 
 class _PipelinedCommand:
-    __slots__ = ("encoded", "future", "attempts", "enqueued_at", "is_publish")
+    __slots__ = (
+        "encoded",
+        "nbytes",
+        "future",
+        "attempts",
+        "enqueued_at",
+        "is_publish",
+    )
 
     def __init__(
         self,
-        encoded: bytes,
+        encoded: "bytes | tuple",
         future: Optional[asyncio.Future],
         is_publish: bool = False,
     ) -> None:
+        # bytes, or a tuple of (bytes | memoryview) segments for the
+        # zero-copy publish path (encode_publish_segments) — flattened
+        # by the flush's b"".join, never concatenated earlier
         self.encoded = encoded
+        self.nbytes = (
+            sum(len(s) for s in encoded)
+            if isinstance(encoded, tuple)
+            else len(encoded)
+        )
         self.future = future
         self.attempts = 0
         self.enqueued_at = time.perf_counter()
@@ -386,10 +427,17 @@ class PipelinedRedisClient(RedisClient):
         """Commands buffered or awaiting their ack (the depth gauge)."""
         return len(self._outbox) + len(self._inflight)
 
-    def publish_nowait(self, channel: str, data: Union[bytes, str]) -> None:
+    def publish_nowait(
+        self, channel: str, data: "Union[bytes, str, list, tuple]"
+    ) -> None:
         """Enqueue one PUBLISH; returns immediately. The ack is consumed
         by the background reply reader. Overflow past `max_pending` is
-        counted dropped (at-most-once — anti-entropy heals)."""
+        counted dropped (at-most-once — anti-entropy heals).
+
+        ``data`` may be a list/tuple of (bytes | memoryview) segments —
+        the zero-copy path: they are framed by reference
+        (encode_publish_segments) and first materialize inside the
+        flush's socket write."""
         if self._closed:
             raise ConnectionError("redis client closed")
         if self.pending >= self.max_pending:
@@ -397,9 +445,11 @@ class PipelinedRedisClient(RedisClient):
             self._needs_resync = True
             return
         self.counters["publishes"] += 1
-        self._enqueue(
-            encode_command("PUBLISH", channel, data), None, is_publish=True
-        )
+        if isinstance(data, (list, tuple)):
+            encoded: "bytes | tuple" = encode_publish_segments(channel, data)
+        else:
+            encoded = encode_command("PUBLISH", channel, data)
+        self._enqueue(encoded, None, is_publish=True)
 
     async def execute(self, *args: Union[bytes, str, int, float], key=None) -> Any:
         if self._closed:
@@ -430,8 +480,9 @@ class PipelinedRedisClient(RedisClient):
         future: Optional[asyncio.Future],
         is_publish: bool = False,
     ) -> None:
-        self._outbox.append(_PipelinedCommand(encoded, future, is_publish))
-        self._outbox_bytes += len(encoded)
+        command = _PipelinedCommand(encoded, future, is_publish)
+        self._outbox.append(command)
+        self._outbox_bytes += command.nbytes
         if self._outbox_bytes > self.max_outbox_bytes:
             self._shed_outbox_overflow()
         self._schedule_flush()
@@ -452,9 +503,9 @@ class PipelinedRedisClient(RedisClient):
         while len(self._outbox) > 1 and self._outbox_bytes > self.max_outbox_bytes:
             command = self._outbox.popleft()
             if command.is_publish and command.future is None:
-                self._outbox_bytes -= len(command.encoded)
+                self._outbox_bytes -= command.nbytes
                 self.counters["dropped"] += 1
-                self.counters["shed_bytes"] += len(command.encoded)
+                self.counters["shed_bytes"] += command.nbytes
                 shed += 1
             else:
                 kept.append(command)
@@ -496,8 +547,16 @@ class PipelinedRedisClient(RedisClient):
                 oldest_wait = time.perf_counter() - batch[0].enqueued_at
                 try:
                     # ONE write + drain for the whole batch: the
-                    # concatenation is the entire point of the lane
-                    self.writer.write(b"".join(c.encoded for c in batch))
+                    # concatenation is the entire point of the lane.
+                    # Segment tuples (zero-copy publishes) flatten here —
+                    # this join is the single copy their payloads pay.
+                    parts: list = []
+                    for c in batch:
+                        if isinstance(c.encoded, tuple):
+                            parts.extend(c.encoded)
+                        else:
+                            parts.append(c.encoded)
+                    self.writer.write(b"".join(parts))
                     await self.writer.drain()
                 except (OSError, ConnectionError):
                     self._resync()
@@ -601,7 +660,7 @@ class PipelinedRedisClient(RedisClient):
                     self._needs_resync = True
             else:
                 requeue.append(command)
-                self._outbox_bytes += len(command.encoded)
+                self._outbox_bytes += command.nbytes
         self._outbox.extendleft(reversed(requeue))
 
     # -- the reply reader --------------------------------------------------

@@ -23,7 +23,19 @@ Site catalogue (docs/guides/observability.md "profiling & cost attribution"):
 - ``fanout_tick``    loop  — one broadcast tick's socket writes
 - ``varint_header``  detail— header parse inside frame_decode
 - ``apply_update``   detail— CRDT apply inside frame_decode
+- ``envelope_decode`` detail— relay envelope decode (edge gateway/cell
+                             loops — separate processes, so kept out of
+                             the server headroom sum)
 - ``wal_append``     off   — WAL group commit (executor thread)
+
+**Batch amortization** (``record_batch``): a batched codec call (one
+Python->C++ crossing for N frames — parse_frame_headers_batch,
+build_update_frames_batch, native coalesce) records its TOTAL ns once
+with ``count=N``, so the per-(site,type) ``frames`` counter advances by
+N and every derived ns/frame figure is the *amortized* per-frame cost.
+The headroom model needs no special casing: loop-site totals are summed
+and divided by ingress frames exactly as before, which is precisely the
+amortized accounting a batched wire path should report.
 
 **Headroom model**: sustainable frames/s per process =
 1 / Σ(per-frame cost on the event-loop thread). Only the non-
@@ -47,8 +59,9 @@ from .metrics import Counter, Gauge
 # loop cost the headroom model divides into
 LOOP_SITES = ("frame_decode", "frame_encode", "coalesce", "fanout_tick")
 # attribution detail measured INSIDE frame_decode (excluded from the
-# headroom sum — counting them again would double-charge the frame)
-DETAIL_SITES = ("varint_header", "apply_update")
+# headroom sum — counting them again would double-charge the frame);
+# envelope_decode runs on edge gateway/cell loops (separate processes)
+DETAIL_SITES = ("varint_header", "apply_update", "envelope_decode")
 # off-loop work (executor threads): visible in the table, not in headroom
 OFF_LOOP_SITES = ("wal_append",)
 SITES = LOOP_SITES + DETAIL_SITES + OFF_LOOP_SITES
@@ -98,6 +111,19 @@ class CostLedger:
     def record(self, site: str, type_name: str, ns: int, nbytes: int = 0) -> None:
         self.cost_ns.inc(ns, site=site, type=type_name)
         self.frames.inc(site=site, type=type_name)
+        if nbytes:
+            self.bytes.inc(nbytes, site=site, type=type_name)
+
+    def record_batch(
+        self, site: str, type_name: str, ns: int, count: int, nbytes: int = 0
+    ) -> None:
+        """One batched codec call covering ``count`` frames: total ``ns``
+        recorded once, frame counter advanced by ``count`` so every
+        derived ns/frame figure is the amortized per-frame cost."""
+        if count <= 0:
+            return
+        self.cost_ns.inc(ns, site=site, type=type_name)
+        self.frames.inc(count, site=site, type=type_name)
         if nbytes:
             self.bytes.inc(nbytes, site=site, type=type_name)
 

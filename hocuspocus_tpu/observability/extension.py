@@ -147,6 +147,16 @@ class Metrics(Extension):
         # shared by every plane/shard in the process
         for metric in compile_metrics():
             reg.register(metric)
+        # native codec availability (native/__init__.py): status gauge
+        # set at first get_codec() resolution — a silent fallback to the
+        # slow Python codec must be visible on /metrics
+        from ..native import codec_info_metrics
+
+        for metric in codec_info_metrics():
+            try:
+                reg.register(metric)
+            except ValueError:
+                pass  # already adopted (shared registry, repeat bind)
         # SLO engine (observability/slo.py): e2e latency + wire error
         # rate by default; the breaker-open fraction target joins when a
         # supervised plane binds. Thresholds snap to histogram bucket

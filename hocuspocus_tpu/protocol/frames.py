@@ -74,6 +74,103 @@ def build_update_frame(name: str, update: bytes, reply: bool = False) -> bytes:
     return frame
 
 
+def parse_frame_headers_batch(
+    frames: "list[bytes]", skip_malformed: bool = False
+) -> "list[tuple[str, int, int] | None]":
+    """Parse N frame headers in ONE native call (GIL released during the
+    byte scan; consecutive frames for the same document share one str).
+
+    Strict mode (default) raises ValueError on the first malformed
+    header, matching :func:`parse_frame_header`. ``skip_malformed=True``
+    yields ``None`` slots instead — the replication-inbox contract where
+    a bad frame is dropped, not fatal. Ledger cost is amortized: one
+    ``varint_header`` record advancing the frame counter by N.
+    """
+    if not frames:
+        return []
+    ledger = get_cost_ledger()
+    t0 = time.perf_counter_ns() if ledger.enabled else 0
+    codec = get_codec()
+    if codec is not None:
+        parsed = codec.parse_frame_headers_batch(frames, skip_malformed)
+    else:
+        parsed = []
+        for i, data in enumerate(frames):
+            try:
+                decoder = Decoder(data)
+                name = decoder.read_var_string()
+                msg_type = decoder.read_var_uint()
+                parsed.append((name, msg_type, decoder.pos))
+            except (ValueError, EOFError, IndexError) as exc:
+                # normalize to the native path's error class: batch parity
+                # is ValueError on BOTH paths (the scalar Python path's
+                # EOFError/IndexError zoo stays as-is for compatibility)
+                if not skip_malformed:
+                    raise ValueError(
+                        f"malformed frame header at index {i}"
+                    ) from exc
+                parsed.append(None)
+            except TypeError:
+                # non-buffer input: strict mode propagates (native raises
+                # TypeError from the buffer protocol), skip mode drops
+                if not skip_malformed:
+                    raise
+                parsed.append(None)
+    if ledger.enabled:
+        ok = [p for p in parsed if p is not None]
+        if ok:
+            ledger.record_batch(
+                "varint_header",
+                _type_name(ok[0][1]),
+                time.perf_counter_ns() - t0,
+                len(ok),
+                sum(p[2] for p in ok),
+            )
+    return parsed
+
+
+def build_update_frames_batch(
+    items: "list[tuple[str, bytes] | tuple[str, bytes, bool]]",
+) -> "list[bytes]":
+    """Build N broadcast frames in ONE native call (frames laid out in a
+    single arena with the GIL released, then cut into per-frame bytes).
+    Ledger cost is amortized across the batch like the scalar path's
+    per-frame ``frame_encode`` records."""
+    if not items:
+        return []
+    ledger = get_cost_ledger()
+    t0 = time.perf_counter_ns() if ledger.enabled else 0
+    codec = get_codec()
+    if codec is not None:
+        built = codec.build_update_frames_batch(
+            [it if isinstance(it, tuple) else tuple(it) for it in items]
+        )
+    else:
+        from .message import MessageType
+
+        built = []
+        for it in items:
+            name, update = it[0], it[1]
+            reply = bool(it[2]) if len(it) > 2 else False
+            encoder = Encoder()
+            encoder.write_var_string(name)
+            encoder.write_var_uint(
+                MessageType.SyncReply if reply else MessageType.Sync
+            )
+            encoder.write_var_uint(MESSAGE_YJS_UPDATE)
+            encoder.write_var_uint8_array(update)
+            built.append(encoder.to_bytes())
+    if ledger.enabled:
+        ledger.record_batch(
+            "frame_encode",
+            "Sync",
+            time.perf_counter_ns() - t0,
+            len(built),
+            sum(len(f) for f in built),
+        )
+    return built
+
+
 def build_sync_status_frame(name: str, ok: bool) -> bytes:
     """[name][SyncStatus][0|1] — the per-update durability ack."""
     codec = get_codec()

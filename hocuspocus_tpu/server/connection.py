@@ -14,6 +14,7 @@ from ..protocol.close_events import (
     RESET_CONNECTION,
     TRY_AGAIN_LATER,
 )
+from ..protocol.frames import parse_frame_header
 from ..protocol.message import IncomingMessage, OutgoingMessage
 from . import logger
 from .document import Document
@@ -183,10 +184,15 @@ class Connection:
                         1.0, self._send_quota_heal
                     )
             return
-        message = IncomingMessage(data)
-        document_name = message.read_var_string()
+        # native header parse: one C++ call replaces the two Python
+        # varint/string reads (frames.parse_frame_header falls back to
+        # the Python decoder without the toolchain); the pre-read type
+        # is handed to MessageReceiver so it is never decoded twice
+        document_name, message_type, payload_off = parse_frame_header(data)
         if document_name != self.document.name:
             return
+        message = IncomingMessage(data)
+        message.decoder.pos = payload_off
         message.write_var_string(document_name)
         wire = get_wire_telemetry()
         tracer = get_tracer()
@@ -200,7 +206,9 @@ class Connection:
             mark = tracer.ingress_mark = time.perf_counter()
         try:
             await self.callbacks["before_handle_message"](self, data)
-            await MessageReceiver(message).apply(self.document, self)
+            await MessageReceiver(message).apply(
+                self.document, self, message_type=message_type
+            )
         except CloseError as error:
             if wire.enabled:
                 wire.record_error("close_error")

@@ -63,7 +63,7 @@ from typing import Any, Callable, Iterable, Optional
 from ..crdt import encode_state_as_update
 from ..observability.costs import get_cost_ledger
 from ..observability.wire import get_wire_telemetry
-from ..protocol.frames import build_update_frame
+from ..protocol.frames import build_update_frame, build_update_frames_batch
 from ..protocol.message import OutgoingMessage
 from ..protocol.sync import coalesce_updates
 from .overload import get_overload_controller
@@ -377,10 +377,11 @@ class DocumentFanout:
                     0 if update is None else len(update),
                 )
             if update is None:
-                # merge failure must not lose updates: per-update frames
-                per_update_frames = [
-                    build_update_frame(document.name, u) for u in pending
-                ]
+                # merge failure must not lose updates: per-update frames,
+                # built in ONE native batch call
+                per_update_frames = build_update_frames_batch(
+                    [(document.name, u) for u in pending]
+                )
             else:
                 frame = build_update_frame(document.name, update)
 

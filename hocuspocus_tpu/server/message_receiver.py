@@ -40,6 +40,8 @@ class MessageReceiver:
         document: Document,
         connection=None,
         reply: Optional[Callable[[bytes], None]] = None,
+        *,
+        message_type: Optional[int] = None,
     ) -> None:
         tracer = get_tracer()
         if tracer.enabled:
@@ -48,9 +50,9 @@ class MessageReceiver:
                 document=document.name,
                 bytes=len(self.message.decoder.buf),
             ) as span:
-                await self._apply(document, connection, reply, span)
+                await self._apply(document, connection, reply, span, message_type)
         else:
-            await self._apply(document, connection, reply, None)
+            await self._apply(document, connection, reply, None, message_type)
 
     async def _apply(
         self,
@@ -58,9 +60,11 @@ class MessageReceiver:
         connection=None,
         reply: Optional[Callable[[bytes], None]] = None,
         span=None,
+        message_type: Optional[int] = None,
     ) -> None:
         message = self.message
-        message_type = message.read_var_uint()
+        if message_type is None:
+            message_type = message.read_var_uint()
         if span is not None:
             span.set("type", int(message_type))
         wire = get_wire_telemetry()

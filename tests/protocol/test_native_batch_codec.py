@@ -1,0 +1,341 @@
+"""Native batch codec ≡ Python fallback, byte for byte.
+
+The three batch entry points added for the drain paths —
+`parse_frame_headers_batch`, `build_update_frames_batch`, and the
+native `coalesce_updates` — must produce byte-identical results on the
+native and pure-Python paths, INCLUDING malformed-input behavior
+(truncated varints and oversized length prefixes raise the same error
+class on both paths; skip mode yields None at the same slots). A final
+leg re-runs this suite's sibling protocol tests in a subprocess under
+HOCUSPOCUS_TPU_NO_NATIVE=1 so the fallback path stays covered by the
+whole tier-1 protocol surface, not just these differential tests.
+"""
+
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+import hocuspocus_tpu.protocol.frames as frames_mod
+from hocuspocus_tpu.crdt import Doc, apply_update, encode_state_as_update
+from hocuspocus_tpu.crdt.encoding import Decoder, Encoder
+from hocuspocus_tpu.crdt.update import merge_updates
+from hocuspocus_tpu.native import get_codec
+from hocuspocus_tpu.protocol.frames import (
+    build_update_frame,
+    build_update_frames_batch,
+    parse_frame_headers_batch,
+)
+from hocuspocus_tpu.edge import relay
+
+pytestmark = pytest.mark.skipif(
+    get_codec() is None, reason="native codec unavailable"
+)
+
+NAMES = ["doc", "", "näme/ünïcode-😀", "x" * 300, "doc"]  # repeat: dedup window
+
+
+def _forced_python(fn, *args, **kwargs):
+    """Run a frames.py batch helper with the native codec masked off."""
+    orig = frames_mod.get_codec
+    frames_mod.get_codec = lambda: None
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        frames_mod.get_codec = orig
+
+
+def _make_frames(rng, n=50):
+    out = []
+    for _ in range(n):
+        name = rng.choice(NAMES)
+        payload = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 64)))
+        out.append(build_update_frame(name, payload, rng.random() < 0.3))
+    return out
+
+
+# -- parse_frame_headers_batch ------------------------------------------------
+
+
+def test_parse_headers_batch_matches_python_fuzz():
+    rng = random.Random(20)
+    for _ in range(10):
+        frames = _make_frames(rng)
+        native = parse_frame_headers_batch(frames)
+        python = _forced_python(parse_frame_headers_batch, frames)
+        assert native == python
+
+
+def test_parse_headers_batch_dedups_name_objects():
+    frames = [build_update_frame("shared-doc", b"a"), build_update_frame("shared-doc", b"b")]
+    parsed = parse_frame_headers_batch(frames)
+    assert parsed[0][0] == parsed[1][0] == "shared-doc"
+    # consecutive identical names share ONE str object (native dedup)
+    assert parsed[0][0] is parsed[1][0]
+
+
+MALFORMED = [
+    b"",  # empty
+    b"\x80\x80\x80",  # truncated varint (continuation never ends)
+    b"\x80" * 9 + b"\x01",  # name length 2^63: oversized, must not OOM
+    b"\xff" * 10,  # oversized + garbage
+    b"\x05ab",  # name length 5, only 2 bytes present
+    b"\x02\xff\xfe\x00",  # invalid UTF-8 in the name
+    b"\x03abc",  # name fine, type varint missing
+]
+
+
+@pytest.mark.parametrize("bad", MALFORMED)
+def test_malformed_strict_raises_value_error_both_paths(bad):
+    good = build_update_frame("d", b"ok")
+    batch = [good, bad, good]
+    with pytest.raises(ValueError):
+        parse_frame_headers_batch(batch)
+    with pytest.raises(ValueError):
+        _forced_python(parse_frame_headers_batch, batch)
+
+
+def test_malformed_skip_yields_none_at_same_slots():
+    rng = random.Random(21)
+    good = _make_frames(rng, n=10)
+    batch = []
+    for i, frame in enumerate(good):
+        batch.append(frame)
+        batch.append(MALFORMED[i % len(MALFORMED)])
+    native = parse_frame_headers_batch(batch, skip_malformed=True)
+    python = _forced_python(parse_frame_headers_batch, batch, skip_malformed=True)
+    assert native == python
+    assert [i for i, p in enumerate(native) if p is None] == list(
+        range(1, len(batch), 2)
+    )
+
+
+def test_truncation_fuzz_parity():
+    """Random truncations/bit flips of valid frames: both paths agree on
+    parse-vs-None for every slot (skip mode) and never diverge on the
+    parsed values."""
+    rng = random.Random(22)
+    for _ in range(5):
+        frames = _make_frames(rng, n=30)
+        corrupted = []
+        for frame in frames:
+            roll = rng.random()
+            if roll < 0.33 and len(frame) > 1:
+                corrupted.append(frame[: rng.randrange(1, len(frame))])
+            elif roll < 0.66:
+                i = rng.randrange(len(frame))
+                corrupted.append(
+                    frame[:i] + bytes([frame[i] ^ (1 << rng.randrange(8))]) + frame[i + 1 :]
+                )
+            else:
+                corrupted.append(frame)
+        native = parse_frame_headers_batch(corrupted, skip_malformed=True)
+        python = _forced_python(
+            parse_frame_headers_batch, corrupted, skip_malformed=True
+        )
+        assert native == python
+
+
+# -- build_update_frames_batch ------------------------------------------------
+
+
+def test_build_frames_batch_matches_scalar_and_python():
+    rng = random.Random(23)
+    items = []
+    for _ in range(60):
+        name = rng.choice(NAMES)
+        update = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 200)))
+        items.append((name, update, rng.random() < 0.5))
+    native = build_update_frames_batch(items)
+    python = _forced_python(build_update_frames_batch, items)
+    scalar = [build_update_frame(*it) for it in items]
+    assert native == python == scalar
+
+
+def test_build_frames_batch_two_item_tuples_default_reply_false():
+    items = [("a", b"u1"), ("b", b"u2")]
+    assert build_update_frames_batch(items) == [
+        build_update_frame("a", b"u1", False),
+        build_update_frame("b", b"u2", False),
+    ]
+
+
+# -- native coalesce_updates --------------------------------------------------
+
+
+def test_native_coalesce_matches_python_merge_fuzz():
+    codec = get_codec()
+    rng = random.Random(24)
+    for _ in range(30):
+        docs = []
+        updates = []
+        for d in range(rng.randrange(2, 5)):
+            doc = Doc()
+            text = doc.get_text("t")
+            for _ in range(rng.randrange(1, 5)):
+                text.insert(
+                    rng.randrange(len(text) + 1),
+                    rng.choice(["ab", "xyz", "é€", "m"]),
+                )
+            updates.append(encode_state_as_update(doc))
+            docs.append(doc)
+        native = codec.coalesce_updates(updates)
+        if native is None:
+            continue  # bailed: Python path takes over — allowed, not a divergence
+        assert native == merge_updates(updates)
+
+
+def test_native_coalesce_corrupt_inputs_never_diverge():
+    """Corrupted updates: the native merge may bail (None) but must
+    never emit bytes different from the Python merge."""
+    codec = get_codec()
+    rng = random.Random(25)
+    doc = Doc()
+    doc.get_text("t").insert(0, "hello world")
+    base = encode_state_as_update(doc)
+    for _ in range(100):
+        u = bytearray(base)
+        i = rng.randrange(len(u))
+        u[i] ^= 1 << rng.randrange(8)
+        updates = [base, bytes(u)]
+        native = codec.coalesce_updates(updates)
+        if native is None:
+            continue
+        try:
+            python = merge_updates(updates)
+        except Exception:
+            pytest.fail("native merged bytes the Python merge rejects")
+        assert native == python
+
+
+def test_coalesced_update_applies_identically():
+    rng = random.Random(26)
+    updates = []
+    for d in range(3):
+        doc = Doc()
+        doc.get_text("t").insert(0, f"client-{d}-text")
+        updates.append(encode_state_as_update(doc))
+    merged = get_codec().coalesce_updates(updates)
+    if merged is None:
+        merged = merge_updates(updates)
+    a, b = Doc(), Doc()
+    for u in updates:
+        apply_update(a, u)
+    apply_update(b, merged)
+    assert a.get_text("t").to_string() == b.get_text("t").to_string()
+
+
+# -- envelope batch decode ----------------------------------------------------
+
+
+def test_envelope_batch_decode_matches_python():
+    raws = [
+        relay.encode_envelope(relay.FRAME, "sess-1", "", b"payload-a"),
+        relay.encode_envelope(relay.FRAME, "sess-1", "aux", b"payload-b"),
+        relay.encode_envelope(relay.CLOSED, "sess-2", "1000:bye", b""),
+    ]
+    native = relay.decode_envelopes_batch(raws)
+    codec = get_codec()
+    python = []
+    for raw in raws:
+        d = Decoder(raw)
+        python.append(
+            (d.read_var_uint(), d.read_var_string(), d.read_var_string(), d.read_var_uint8_array())
+        )
+    assert native == python
+    # consecutive envelopes of one session share ONE str object
+    assert native[0][1] is native[1][1]
+
+
+def test_envelope_batch_skip_malformed():
+    good = relay.encode_envelope(relay.FRAME, "s", "", b"x")
+    batch = [good, b"\x80\x80\x80", good, b""]
+    out = relay.decode_envelopes_batch(batch, skip_malformed=True)
+    assert out[0] == out[2]
+    assert out[1] is None and out[3] is None
+    with pytest.raises(ValueError):
+        relay.decode_envelopes_batch(batch)
+
+
+def test_envelope_view_round_trips():
+    segments = relay.encode_envelope_view(relay.FRAME, "sess", "aux", b"frame-bytes")
+    joined = b"".join(segments)
+    assert joined == relay.encode_envelope(relay.FRAME, "sess", "aux", b"frame-bytes")
+    assert relay.decode_envelope(joined) == (relay.FRAME, "sess", "aux", b"frame-bytes")
+
+
+# -- bulk varints -------------------------------------------------------------
+
+
+def test_bulk_varints_match_scalar_round_trip():
+    rng = random.Random(27)
+    values = [rng.randrange(0, 2**50) for _ in range(200)] + [0, 1, 127, 128, 2**31]
+    enc = Encoder()
+    enc.write_var_uints(values)
+    scalar = Encoder()
+    for v in values:
+        scalar.write_var_uint(v)
+    assert enc.to_bytes() == scalar.to_bytes()
+    dec = Decoder(enc.to_bytes())
+    assert list(dec.read_var_uints(len(values))) == values
+    assert not dec.has_content()
+
+
+def test_bulk_varint_truncation_raises_value_error_both_paths():
+    enc = Encoder()
+    enc.write_var_uints([1, 2, 300000])
+    data = enc.to_bytes()[:-1]
+    with pytest.raises(ValueError):
+        Decoder(data).read_var_uints(3)
+    # Python fallback: same error class
+    import hocuspocus_tpu.crdt.encoding as encoding_mod
+
+    orig = encoding_mod._bulk_codec
+    encoding_mod._bulk_codec = lambda: None
+    try:
+        with pytest.raises(ValueError):
+            Decoder(data).read_var_uints(3)
+    finally:
+        encoding_mod._bulk_codec = orig
+
+
+def test_bulk_varint_hostile_count_rejected_not_oom():
+    """A hostile count (e.g. a forged numRanges varint) must raise, not
+    attempt a multi-terabyte allocation."""
+    with pytest.raises(ValueError):
+        Decoder(b"\x01\x02\x03").read_var_uints(2**50)
+
+
+# -- the fallback leg: protocol suite under HOCUSPOCUS_TPU_NO_NATIVE ----------
+
+
+def test_protocol_suite_passes_without_native_codec():
+    """Run the sibling protocol tests in a subprocess with the native
+    codec disabled: the pure-Python fallback must carry the whole
+    protocol surface on its own (the tier-1 fallback leg)."""
+    env = dict(os.environ)
+    env["HOCUSPOCUS_TPU_NO_NATIVE"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/protocol",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            "--deselect",
+            "tests/protocol/test_native_batch_codec.py::"
+            "test_protocol_suite_passes_without_native_codec",
+            "-m",
+            "not slow",
+        ],
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        env=env,
+        capture_output=True,
+        timeout=300,
+    )
+    assert result.returncode == 0, result.stdout.decode() + result.stderr.decode()

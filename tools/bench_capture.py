@@ -53,6 +53,17 @@ def _log(msg: str) -> None:
     print(f"[bench_capture] {msg}", file=sys.stderr, flush=True)
 
 
+def _probe_codec_path() -> str:
+    """native|fallback|unknown: which wire codec this host resolves."""
+    try:
+        sys.path.insert(0, _REPO_DIR)
+        from hocuspocus_tpu.native import get_codec
+
+        return "native" if get_codec() is not None else "fallback"
+    except Exception:
+        return "unknown"
+
+
 def _git_rev() -> str:
     try:
         proc = subprocess.run(
@@ -480,6 +491,11 @@ def main(argv: "list[str] | None" = None) -> int:
             "headroom_frames_per_s": ws.get("headroom_frames_per_s"),
             "headroom_ratio": ws.get("headroom_ratio"),
             "headroom_within_2x": ws.get("headroom_within_2x"),
+            # which codec ran the round: a native-vs-fallback mismatch
+            # between rounds makes the frames/s comparison meaningless
+            # (pre-codec_path artifacts fall back to a live probe of
+            # this host's toolchain — same build the round used)
+            "codec_path": ws.get("codec_path") or _probe_codec_path(),
             "top_costs": ws.get("top_costs"),
         }
     manifest = {
